@@ -1,0 +1,41 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of the reference (roar090/Paddle), redesigned for XLA/JAX/Pallas.
+
+Top-level namespace mirrors the reference: ``paddle_tpu.nn``,
+``paddle_tpu.optimizer``, ``paddle_tpu.distributed`` (fleet),
+``paddle_tpu.amp``, ``paddle_tpu.io``, ``paddle_tpu.vision`` plus tensor ops
+re-exported at the root (``paddle_tpu.matmul`` etc. like ``paddle.matmul``).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from paddle_tpu import core, nn, ops
+from paddle_tpu.core.device import (
+    device_count,
+    get_device,
+    is_tpu,
+    set_device,
+)
+from paddle_tpu.core.dtypes import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from paddle_tpu.core.random import RngStream, next_key, seed
+from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
+from paddle_tpu.tensor import *  # noqa: F401,F403
+from paddle_tpu import jit as jit_module
+from paddle_tpu.jit import to_static, no_grad, grad
+
+jit = jit_module.jit

@@ -1,0 +1,7 @@
+from paddle_tpu.core import device, dtypes, random
+from paddle_tpu.core.module import (
+    Module,
+    combine,
+    partition_trainable,
+    value_and_grad,
+)

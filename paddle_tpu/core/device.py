@@ -1,0 +1,43 @@
+"""Device / place management (ref: ``paddle.set_device``, ``paddle/phi/common/place.h``).
+
+Paddle routes ops to a Place (CPUPlace/CUDAPlace/XPUPlace). Under JAX the
+platform is process-global and arrays carry their sharding, so "set_device"
+reduces to selecting the default platform and exposing topology queries used
+by the distributed layer.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def set_device(name: str) -> None:
+    """Accepts 'tpu', 'cpu', 'gpu' (ref signature). Affects default backend only."""
+    platform = {"xla": "tpu", "tpu": "tpu", "gpu": "gpu", "cpu": "cpu"}.get(name, name)
+    try:
+        jax.config.update("jax_default_device", jax.devices(platform)[0])
+    except RuntimeError:
+        pass  # platform not present (e.g. asking for tpu in CPU tests)
+
+
+def get_device() -> str:
+    return jax.default_backend()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_tpu() -> bool:
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,47 @@
+"""Dtype registry & defaults (ref: ``python/paddle/framework/dtype.py``).
+
+bfloat16 is a first-class citizen: it is the TPU compute dtype (MXU takes
+bf16 inputs with fp32 accumulate). Default parameter dtype stays float32 for
+reference parity; the AMP policy (paddle_tpu.amp) casts compute to bf16.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+
+_DEFAULT = {"dtype": jnp.float32}
+
+
+def set_default_dtype(dtype) -> None:
+    _DEFAULT["dtype"] = jnp.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+
+
+def get_default_dtype():
+    return _DEFAULT["dtype"]
+
+
+@contextlib.contextmanager
+def default_dtype(dtype):
+    old = _DEFAULT["dtype"]
+    set_default_dtype(dtype)
+    try:
+        yield
+    finally:
+        _DEFAULT["dtype"] = old
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)

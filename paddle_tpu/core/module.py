@@ -1,0 +1,314 @@
+"""Pytree-native Module system (the TPU-native answer to ``paddle.nn.Layer``).
+
+Reference: ``python/paddle/nn/layer/layers.py`` (class ``Layer``) — dygraph
+``Layer`` holds mutable parameters and an imperative forward. Here a Module
+IS a JAX pytree: parameters/buffers/submodules are leaves/children, any
+other attribute is static metadata. That makes every model directly usable
+with ``jax.jit`` / ``jax.grad`` / ``jax.tree_util`` — no tape, no engine.
+
+Key differences from the reference, by design:
+  * functional: calling a module never mutates it; randomness (dropout) is
+    passed in explicitly via ``rng=``.
+  * sharding-aware: every parameter may carry a ``PartitionSpec`` in
+    ``module.pspec(name)`` metadata, consumed by the distributed layer
+    (see paddle_tpu/distributed/).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ARRAY_TYPES = (jax.Array, np.ndarray)
+
+
+def _is_dynamic(value: Any) -> bool:
+    """True if `value` participates in the pytree (array / module / container of)."""
+    if isinstance(value, (Module, *_ARRAY_TYPES)):
+        return True
+    if isinstance(value, (list, tuple)):
+        return any(_is_dynamic(v) for v in value)
+    if isinstance(value, dict):
+        return any(_is_dynamic(v) for v in value.values())
+    return False
+
+
+def _hashable_static(value: Any) -> Any:
+    if isinstance(value, list):
+        return tuple(_hashable_static(v) for v in value)
+    if isinstance(value, dict):
+        return tuple(sorted((k, _hashable_static(v)) for k, v in value.items()))
+    if isinstance(value, set):
+        return tuple(sorted(value))
+    return value
+
+
+class _Static:
+    """Hashable wrapper for static attribute snapshots used as pytree aux data."""
+
+    __slots__ = ("names", "values", "dyn_names", "buffers", "pspecs", "cls")
+
+    def __init__(self, cls, names, values, dyn_names, buffers, pspecs):
+        self.cls = cls
+        self.names = names
+        self.values = values
+        self.dyn_names = dyn_names
+        self.buffers = buffers
+        self.pspecs = pspecs
+
+    def _key(self):
+        return (
+            self.cls,
+            self.names,
+            tuple(_hashable_static(v) for v in self.values),
+            self.dyn_names,
+            self.buffers,
+            self.pspecs,
+        )
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return isinstance(other, _Static) and self._key() == other._key()
+
+
+class Module:
+    """Base class for all layers/models. Subclasses register as pytrees."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        jax.tree_util.register_pytree_with_keys_class(cls)
+
+    # -- construction ------------------------------------------------------
+    def __init__(self):
+        object.__setattr__(self, "_buffers", set())
+        object.__setattr__(self, "_pspecs", {})
+        object.__setattr__(self, "_dyn_names", set())
+        object.__setattr__(self, "training", True)
+
+    def _ensure_meta(self):
+        if not hasattr(self, "_buffers"):
+            object.__setattr__(self, "_buffers", set())
+            object.__setattr__(self, "_pspecs", {})
+            object.__setattr__(self, "training", True)
+        if not hasattr(self, "_dyn_names"):
+            object.__setattr__(self, "_dyn_names", set())
+
+    def register_buffer(self, name: str, value) -> None:
+        """Non-trainable state (e.g. BatchNorm running stats). Ref Layer.register_buffer."""
+        self._ensure_meta()
+        self._buffers.add(name)
+        setattr(self, name, value)
+
+    def set_pspec(self, name: str, spec) -> None:
+        """Attach a ``PartitionSpec`` (or axis-name tuple) to parameter `name`."""
+        self._ensure_meta()
+        self._pspecs[name] = tuple(spec) if isinstance(spec, (list, tuple)) else spec
+
+    def pspec(self, name: str):
+        return getattr(self, "_pspecs", {}).get(name)
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten_with_keys(self):
+        self._ensure_meta()
+        dyn_names, children, st_names, st_values = [], [], [], []
+        for name, value in vars(self).items():
+            if name in ("_buffers", "_pspecs", "_dyn_names"):
+                continue
+            # sticky classification: once an attr held a dynamic value, it
+            # stays a pytree child even when a transform nulls it out, so
+            # treedefs stay compatible across partition/combine.
+            if _is_dynamic(value) or name in self._dyn_names:
+                dyn_names.append(name)
+                children.append(value)
+            else:
+                st_names.append(name)
+                st_values.append(value)
+        self._dyn_names.update(dyn_names)
+        aux = _Static(
+            type(self),
+            tuple(st_names),
+            tuple(st_values),
+            tuple(dyn_names),
+            tuple(sorted(self._buffers)),
+            tuple(sorted((k, v) for k, v in self._pspecs.items())),
+        )
+        keyed = [(jax.tree_util.GetAttrKey(n), c) for n, c in zip(dyn_names, children)]
+        return keyed, aux
+
+    def tree_flatten(self):
+        keyed, aux = self.tree_flatten_with_keys()
+        return [c for _, c in keyed], aux
+
+    @classmethod
+    def tree_unflatten(cls, aux: _Static, children):
+        obj = object.__new__(aux.cls)
+        object.__setattr__(obj, "_buffers", set(aux.buffers))
+        object.__setattr__(obj, "_pspecs", dict(aux.pspecs))
+        object.__setattr__(obj, "_dyn_names", set(aux.dyn_names))
+        for name, value in zip(aux.names, aux.values):
+            object.__setattr__(obj, name, value)
+        for name, child in zip(aux.dyn_names, children):
+            object.__setattr__(obj, name, child)
+        if not hasattr(obj, "training"):
+            object.__setattr__(obj, "training", True)
+        return obj
+
+    # -- traversal ---------------------------------------------------------
+    def _iter_named(self, prefix: str = "") -> Iterator[tuple[str, str, Any, "Module"]]:
+        """Yield (path, attr_name, value, owner) for every array leaf."""
+        for name, value in vars(self).items():
+            if name in ("_buffers", "_pspecs", "_dyn_names"):
+                continue
+            path = f"{prefix}{name}"
+            yield from _iter_value(path, name, value, self)
+
+    def named_parameters(self, include_buffers: bool = False):
+        for path, name, value, owner in self._iter_named():
+            if isinstance(value, _ARRAY_TYPES):
+                if include_buffers or name not in owner._buffers:
+                    yield path, value
+
+    def parameters(self):
+        for _, v in self.named_parameters():
+            yield v
+
+    def sublayers(self, include_self: bool = True) -> Iterator["Module"]:
+        if include_self:
+            yield self
+        for name, value in vars(self).items():
+            if name in ("_buffers", "_pspecs", "_dyn_names"):
+                continue
+            yield from _iter_modules(value)
+
+    def apply_to_sublayers(self, fn: Callable[["Module"], None]) -> "Module":
+        for m in self.sublayers():
+            fn(m)
+        return self
+
+    # -- train / eval ------------------------------------------------------
+    def train(self) -> "Module":
+        return self.apply_to_sublayers(lambda m: object.__setattr__(m, "training", True))
+
+    def eval(self) -> "Module":
+        return self.apply_to_sublayers(lambda m: object.__setattr__(m, "training", False))
+
+    # -- state dict (ref Layer.state_dict / set_state_dict) ---------------
+    def state_dict(self) -> dict[str, np.ndarray]:
+        return {p: np.asarray(v) for p, v in self.named_parameters(include_buffers=True)}
+
+    def set_state_dict(self, state: dict[str, Any]) -> None:
+        """In-place load. Keys are dotted paths as produced by state_dict()."""
+        remaining = dict(state)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self)
+        new_leaves = []
+        for path, leaf in flat:
+            pstr = _path_to_str(path)
+            if isinstance(leaf, _ARRAY_TYPES) and pstr in remaining:
+                new = jnp.asarray(remaining.pop(pstr), dtype=leaf.dtype)
+                if new.shape != leaf.shape:
+                    raise ValueError(f"shape mismatch for {pstr}: {new.shape} vs {leaf.shape}")
+                new_leaves.append(new)
+            else:
+                new_leaves.append(leaf)
+        if remaining:
+            raise KeyError(f"unexpected keys in state_dict: {sorted(remaining)[:8]}")
+        rebuilt = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        vars(self).update(vars(rebuilt))
+
+    def num_parameters(self) -> int:
+        return sum(int(np.prod(v.shape)) for _, v in self.named_parameters())
+
+    def __repr__(self):
+        return f"{type(self).__name__}(params={self.num_parameters():,})"
+
+
+def _iter_modules(value):
+    if isinstance(value, Module):
+        yield from value.sublayers(include_self=True)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _iter_modules(v)
+    elif isinstance(value, dict):
+        for v in value.values():
+            yield from _iter_modules(v)
+
+
+def _iter_value(path, name, value, owner):
+    if isinstance(value, _ARRAY_TYPES):
+        yield path, name, value, owner
+    elif isinstance(value, Module):
+        yield from value._iter_named(prefix=path + ".")
+    elif isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            yield from _iter_value(f"{path}.{i}", name, v, owner)
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            yield from _iter_value(f"{path}.{k}", name, v, owner)
+
+
+# ---------------------------------------------------------------------------
+# filtering: split trainable params from everything else (eqx-style)
+# ---------------------------------------------------------------------------
+
+def partition_trainable(module: Module):
+    """Split `module` into (params, skeleton): params has buffers/non-arrays
+    as None; skeleton has trainable params as None. combine() re-merges."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(module)
+    buffer_paths = _buffer_paths(module)
+    params_leaves, skel_leaves = [], []
+    for path, leaf in flat:
+        path_str = _path_to_str(path)
+        is_param = isinstance(leaf, _ARRAY_TYPES) and path_str not in buffer_paths
+        params_leaves.append(leaf if is_param else None)
+        skel_leaves.append(None if is_param else leaf)
+    params = jax.tree_util.tree_unflatten(treedef, params_leaves)
+    skel = jax.tree_util.tree_unflatten(treedef, skel_leaves)
+    return params, skel
+
+
+def combine(params: Module, skel: Module) -> Module:
+    return jax.tree_util.tree_map(
+        lambda a, b: a if a is not None else b, params, skel,
+        is_leaf=lambda x: x is None)
+
+
+def _buffer_paths(module: Module) -> set[str]:
+    out = set()
+    for path, name, value, owner in module._iter_named():
+        if isinstance(value, _ARRAY_TYPES) and name in owner._buffers:
+            out.add(path)
+    return out
+
+
+def _path_to_str(path) -> str:
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(p.name)
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def value_and_grad(fn, has_aux: bool = False):
+    """Like jax.value_and_grad but differentiates only trainable leaves of a
+    Module passed as the first argument."""
+
+    def wrapped(module: Module, *args, **kwargs):
+        params, skel = partition_trainable(module)
+
+        def inner(p, *a, **k):
+            return fn(combine(p, skel), *a, **k)
+
+        return jax.value_and_grad(inner, has_aux=has_aux)(params, *args, **kwargs)
+
+    return wrapped

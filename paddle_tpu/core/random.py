@@ -1,0 +1,51 @@
+"""Seeded, splittable RNG (TPU-native answer to ``paddle.seed`` / global RNG).
+
+Reference: ``python/paddle/base/framework.py`` global generators. Paddle uses
+stateful per-device generators; under XLA everything must be functional, so we
+keep ONE host-side root key for eager convenience (`seed`, `next_key`) and an
+explicit `RngStream` for use inside jitted training steps.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class _GlobalRng:
+    def __init__(self, seed: int = 0):
+        self.key = jax.random.PRNGKey(seed)
+
+    def split(self) -> jax.Array:
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+_global = _GlobalRng()
+
+
+def seed(value: int) -> None:
+    """Set the global seed (ref: ``paddle.seed``)."""
+    global _global
+    _global = _GlobalRng(value)
+
+
+def next_key() -> jax.Array:
+    """Eager-mode convenience: draw a fresh subkey from the global generator.
+
+    Never call inside jit — pass keys explicitly there (RngStream).
+    """
+    return _global.split()
+
+
+class RngStream:
+    """Explicit key folder for jitted code: deterministic per (step, name)."""
+
+    def __init__(self, key: jax.Array):
+        self.key = key
+
+    def fold(self, tag: int) -> "RngStream":
+        return RngStream(jax.random.fold_in(self.key, tag))
+
+    def take(self, n: int = 1):
+        keys = jax.random.split(self.key, n + 1)
+        self.key = keys[0]
+        return keys[1] if n == 1 else keys[1:]

@@ -1,0 +1,71 @@
+"""Graph capture (ref: ``python/paddle/jit/`` — ``to_static`` / SOT).
+
+The reference converts dygraph Python into a static Program via AST
+transforms and a bytecode tracer (SOT), then runs CINN. Under JAX the whole
+dichotomy collapses: ``jax.jit`` traces the function once per input shape
+and hands XLA the full graph. ``to_static`` is therefore a thin policy layer
+over ``jax.jit``: static-argument marking, buffer donation, and HLO dump
+hooks for debugging.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+
+
+def jit(fn: Callable = None, *, static_argnums=None, static_argnames=None,
+        donate_argnums=None, device=None) -> Callable:
+    if fn is None:
+        return functools.partial(jit, static_argnums=static_argnums,
+                                 static_argnames=static_argnames,
+                                 donate_argnums=donate_argnums, device=device)
+    return jax.jit(fn, static_argnums=static_argnums, static_argnames=static_argnames,
+                   donate_argnums=donate_argnums)
+
+
+def to_static(fn: Callable = None, **kwargs) -> Callable:
+    """Reference-named alias (``paddle.jit.to_static``)."""
+    return jit(fn, **kwargs)
+
+
+def no_grad(fn: Callable = None):
+    """Ref: ``paddle.no_grad`` — stop gradients through `fn` (or use as decorator)."""
+    if fn is None:
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+        return ctx()
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kw):
+        out = fn(*args, **kw)
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.stop_gradient(x) if isinstance(x, jax.Array) else x, out)
+    return wrapped
+
+
+def grad(fn: Callable, argnums=0, has_aux: bool = False) -> Callable:
+    """Ref: ``paddle.grad`` — functional gradient transform."""
+    return jax.grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def dump_hlo(fn: Callable, *args, **kwargs) -> str:
+    """Debug helper: lowered StableHLO text for `fn(*args)` (ref: Program.to_string)."""
+    return jax.jit(fn).lower(*args, **kwargs).as_text()
+
+
+def dump_jaxpr(fn: Callable, *args, **kwargs) -> str:
+    return str(jax.make_jaxpr(fn)(*args, **kwargs))
+
+
+def compiled_cost_analysis(fn: Callable, *args, **kwargs) -> dict:
+    """FLOPs/bytes estimates from XLA for MFU accounting."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    try:
+        return dict(compiled.cost_analysis())
+    except Exception:
+        return {}

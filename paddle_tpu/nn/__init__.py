@@ -1,0 +1,25 @@
+from paddle_tpu.core.module import Module as Layer  # reference name
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional, initializer
+from paddle_tpu.nn.layers import *  # noqa: F401,F403
+from paddle_tpu.nn.loss import (
+    BCELoss,
+    BCEWithLogitsLoss,
+    CosineEmbeddingLoss,
+    CrossEntropyLoss,
+    HingeEmbeddingLoss,
+    KLDivLoss,
+    L1Loss,
+    MarginRankingLoss,
+    MSELoss,
+    NLLLoss,
+    SmoothL1Loss,
+    TripletMarginLoss,
+)
+from paddle_tpu.nn.rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell
+from paddle_tpu.nn.transformer import (
+    MultiHeadAttention,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)

@@ -1,0 +1,608 @@
+"""Functional NN ops (ref: ``python/paddle/nn/functional/``).
+
+All pure functions; layers in paddle_tpu.nn wrap these. Convs/pools use
+``lax.conv_general_dilated`` / ``lax.reduce_window`` which XLA maps onto the
+MXU / vector unit directly. Data format default NCHW for reference parity
+(XLA transposes to its preferred layout internally on TPU).
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# -- activations (ref functional/activation.py) -----------------------------
+
+relu = jax.nn.relu
+relu6 = jax.nn.relu6
+sigmoid = jax.nn.sigmoid
+log_sigmoid = jax.nn.log_sigmoid
+softplus = jax.nn.softplus
+silu = jax.nn.silu
+swish = jax.nn.silu
+mish = lambda x: x * jnp.tanh(jax.nn.softplus(x))
+tanh = jnp.tanh
+hardswish = jax.nn.hard_swish
+hardsigmoid = jax.nn.hard_sigmoid
+hardtanh = lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max)
+elu = jax.nn.elu
+celu = jax.nn.celu
+selu = jax.nn.selu
+leaky_relu = lambda x, negative_slope=0.01: jax.nn.leaky_relu(x, negative_slope)
+prelu = lambda x, weight: jnp.where(x >= 0, x, weight * x)
+rrelu = lambda x, lower=1/8., upper=1/3., training=False: leaky_relu(x, (lower+upper)/2)
+softshrink = lambda x, threshold=0.5: jnp.sign(x) * jnp.maximum(jnp.abs(x) - threshold, 0)
+hardshrink = lambda x, threshold=0.5: jnp.where(jnp.abs(x) > threshold, x, 0)
+tanhshrink = lambda x: x - jnp.tanh(x)
+softsign = jax.nn.soft_sign
+thresholded_relu = lambda x, threshold=1.0: jnp.where(x > threshold, x, 0)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, *, rng):
+    g = jax.random.gumbel(rng, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:  # straight-through: hard one-hot forward, soft gradient
+        idx = jnp.argmax(y, axis=axis)
+        one = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        y = one + y - lax.stop_gradient(y)
+    return y
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def swiglu(x, y=None):
+    """Ref: paddle.incubate.nn.functional.swiglu (LLaMA MLP gate)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(jnp.reshape(x, new), axis=axis + 1)
+
+
+# -- linear / embedding -----------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """weight layout [in, out] — reference convention (paddle stores [in,out],
+    unlike torch's [out,in]); maps directly to x @ w on the MXU."""
+    y = x @ weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(x, weight, padding_idx=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2]
+    y = jnp.einsum("...i,oij,...j->...o", x1, weight, x2)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# -- dropout ----------------------------------------------------------------
+
+def dropout(x, p=0.5, training=True, *, rng=None, axis=None):
+    if not training or p == 0.0:
+        return x
+    if rng is None:
+        from paddle_tpu.core.random import next_key
+        rng = next_key()
+    keep = 1.0 - p
+    shape = list(x.shape)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    mask = jax.random.bernoulli(rng, keep, tuple(shape))
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, *, rng=None):
+    return dropout(x, p, training, rng=rng, axis=(0, 1))  # drop whole channels NCHW
+
+
+def alpha_dropout(x, p=0.5, training=True, *, rng=None):
+    if not training or p == 0.0:
+        return x
+    if rng is None:
+        from paddle_tpu.core.random import next_key
+        rng = next_key()
+    alpha = -1.7580993408473766
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    a = (keep + alpha ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha * (1 - keep)
+    return (a * jnp.where(mask, x, alpha) + b).astype(x.dtype)
+
+
+# -- normalization (ref functional/norm.py) ---------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(x.ndim - len(tuple(normalized_shape) if not isinstance(normalized_shape, int) else (normalized_shape,)), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Ref: paddle.incubate.nn.functional.fused_rms_norm — compute in fp32,
+    cast back (bf16-safe)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = x32 * lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    """Returns (out, new_mean, new_var). Reference semantics: momentum is the
+    decay on the RUNNING stat (new = m*old + (1-m)*batch)."""
+    caxis = 1 if data_format == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        n = x.size / x.shape[caxis]
+        unbiased = var * n / jnp.maximum(n - 1, 1)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * unbiased
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype), new_mean, new_var
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + epsilon)
+    out = xg.reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out.astype(x.dtype)
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+        if bias is not None:
+            out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    from paddle_tpu.tensor import norm as t_norm
+    n = t_norm(x, p=p, axis=axis, keepdim=True)
+    return x / jnp.maximum(n, epsilon)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[1] = (half, size - half - 1)
+    padded = jnp.pad(sq, pad_cfg)
+    win = sum(lax.slice_in_dim(padded, i, i + x.shape[1], axis=1) for i in range(size))
+    return x / jnp.power(k + alpha * win / size, beta)
+
+
+# -- conv (ref functional/conv.py) ------------------------------------------
+
+def _norm_tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """weight: [out_c, in_c/groups, kh, kw] (reference layout)."""
+    nd = 2
+    stride = _norm_tuple(stride, nd)
+    dilation = _norm_tuple(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _norm_tuple(padding, nd)
+        pad = [(pi, pi) for pi in p]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[1 if data_format == "NCHW" else -1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    x4 = x[..., None]
+    w4 = weight[..., None]
+    out = conv2d(x4, w4, bias,
+                 stride=(_norm_tuple(stride, 1)[0], 1),
+                 padding=((_norm_tuple(padding, 1)[0],) * 2, (0, 0)) if not isinstance(padding, str) else padding,
+                 dilation=(_norm_tuple(dilation, 1)[0], 1), groups=groups)
+    return out[..., 0]
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    nd = 3
+    stride = _norm_tuple(stride, nd)
+    dilation = _norm_tuple(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _norm_tuple(padding, nd)
+        pad = [(pi, pi) for pi in p]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(x, weight, stride, pad, rhs_dilation=dilation,
+                                   dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape((1, -1, 1, 1, 1))
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1):
+    """weight: [in_c, out_c/groups, kh, kw] (reference transpose-conv layout)."""
+    nd = 2
+    stride = _norm_tuple(stride, nd)
+    p = _norm_tuple(padding, nd)
+    op = _norm_tuple(output_padding, nd)
+    dilation = _norm_tuple(dilation, nd)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    # transpose conv = lhs-dilated conv with flipped kernel
+    w = jnp.flip(weight, axis=(-2, -1))
+    w = jnp.swapaxes(w, 0, 1)  # -> [out_c/g, in_c, kh, kw]; groups need reshape
+    if groups > 1:
+        ic = x.shape[1]
+        oc_g = weight.shape[1]
+        w = weight.reshape(groups, ic // groups, oc_g, kh, kw)
+        w = jnp.flip(w, axis=(-2, -1))
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * oc_g, ic // groups, kh, kw)
+    pad = [(dilation[i] * (k - 1) - p[i], dilation[i] * (k - 1) - p[i] + op[i])
+           for i, k in enumerate((kh, kw))]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(x, w, window_strides=(1, 1), padding=pad,
+                                   lhs_dilation=stride, rhs_dilation=dilation,
+                                   dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape((1, -1, 1, 1))
+    return out
+
+
+def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride, 2)
+    p = _norm_tuple(padding, 2)
+    d = _norm_tuple(dilation, 2)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+# -- pooling (ref functional/pooling.py) ------------------------------------
+
+def _pool(x, init, op, kernel, stride, padding, data_format="NCHW"):
+    nd = x.ndim - 2
+    kernel = _norm_tuple(kernel, nd)
+    stride = _norm_tuple(stride or kernel, nd)
+    p = _norm_tuple(padding, nd)
+    if data_format == "NCHW":
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    else:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((pi, pi) for pi in p) + ((0, 0),)
+    return lax.reduce_window(x, init, op, dims, strides, pads)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    return _pool(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                 lax.max, kernel_size, stride, padding, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW",
+               exclusive=True):
+    nd = x.ndim - 2
+    summed = _pool(x, 0.0, lax.add, kernel_size, stride, padding, data_format)
+    if exclusive and padding != 0:
+        ones = jnp.ones_like(x)
+        counts = _pool(ones, 0.0, lax.add, kernel_size, stride, padding, data_format)
+        return summed / counts
+    k = _norm_tuple(kernel_size, nd)
+    denom = 1
+    for ki in k:
+        denom *= ki
+    return summed / denom
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    return max_pool2d(x[..., None], (_norm_tuple(kernel_size, 1)[0], 1),
+                      (_norm_tuple(stride or kernel_size, 1)[0], 1),
+                      (_norm_tuple(padding, 1)[0], 0))[..., 0]
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0):
+    return avg_pool2d(x[..., None], (_norm_tuple(kernel_size, 1)[0], 1),
+                      (_norm_tuple(stride or kernel_size, 1)[0], 1),
+                      (_norm_tuple(padding, 1)[0], 0))[..., 0]
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    out = _norm_tuple(output_size, 2)
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    if h % out[0] == 0 and w % out[1] == 0:
+        xr = x.reshape(n, c, out[0], h // out[0], out[1], w // out[1])
+        y = xr.mean(axis=(3, 5))
+    else:
+        y = jax.image.resize(x, (n, c, out[0], out[1]), method="linear")
+    if data_format != "NCHW":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+def adaptive_max_pool2d(x, output_size):
+    out = _norm_tuple(output_size, 2)
+    n, c, h, w = x.shape
+    assert h % out[0] == 0 and w % out[1] == 0, "adaptive_max needs divisible sizes"
+    xr = x.reshape(n, c, out[0], h // out[0], out[1], w // out[1])
+    return xr.max(axis=(3, 5))
+
+
+# -- interpolate ------------------------------------------------------------
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _norm_tuple(scale_factor, 2)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    size = _norm_tuple(size, 2)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "linear": "linear", "area": "linear"}[mode]
+    y = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    if data_format != "NCHW":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+# -- losses (ref functional/loss.py) ----------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, label_smoothing=0.0):
+    """Reference: paddle.nn.functional.cross_entropy — input is logits."""
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    num_classes = input.shape[axis]
+    if soft_label:
+        target = label.astype(jnp.float32)
+    else:
+        target = jax.nn.one_hot(label, num_classes, axis=axis, dtype=jnp.float32)
+    if label_smoothing > 0.0:
+        target = target * (1 - label_smoothing) + label_smoothing / num_classes
+    loss = -jnp.sum(target * logp, axis=axis)
+    if weight is not None and not soft_label:
+        w = jnp.take(weight, jnp.clip(label, 0, num_classes - 1))
+        loss = loss * w
+    if not soft_label and ignore_index is not None:
+        mask = (label != ignore_index).astype(loss.dtype)
+        loss = loss * mask
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    ll = -jnp.take_along_axis(input, label[..., None], axis=-1)[..., 0]
+    if weight is not None:
+        ll = ll * jnp.take(weight, label)
+    mask = (label != ignore_index).astype(ll.dtype)
+    ll = ll * mask
+    if reduction == "mean":
+        return jnp.sum(ll) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _reduce(ll, reduction)
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps, None)) +
+             (1 - label) * jnp.log(jnp.clip(1 - input, eps, None)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    neg_abs = -jnp.abs(logit)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(neg_abs)) +
+                                              jnp.maximum(-logit, 0))
+    else:
+        loss = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1) + 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return _reduce(jnp.maximum(-label * (input - other) + margin, 0.0), reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0, reduction="mean"):
+    dp = jnp.linalg.norm(anchor - positive, ord=p, axis=-1)
+    dn = jnp.linalg.norm(anchor - negative, ord=p, axis=-1)
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+def label_smooth(label, epsilon=0.1):
+    k = label.shape[-1]
+    return label * (1 - epsilon) + epsilon / k
+
+
+def sigmoid_focal_loss(logit, label, alpha=0.25, gamma=2.0, reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    return _reduce(a_t * ((1 - p_t) ** gamma) * ce, reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+# -- attention (ref functional/flash_attention.py & fused kernels) ----------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 *, rng=None, scale=None):
+    """[B, S, H, D] layout (reference flash_attention convention).
+
+    Dispatches to the Pallas TPU flash kernel when available, else a fused
+    XLA path (softmax in fp32, MXU matmuls in input dtype).
+    """
+    from paddle_tpu.ops import attention as _attn
+    return _attn.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training, rng=rng, scale=scale)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    s = x.shape[-1]
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    x = jnp.where(mask, x, -1e9)
+    return jax.nn.softmax(x, axis=-1)
+
+
+# -- one-hot / sequence ------------------------------------------------------
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="bool"):
+    maxlen = maxlen or int(jnp.max(lengths))
+    row = jnp.arange(maxlen)
+    return (row[None, :] < lengths[:, None]).astype(dtype)

@@ -1,0 +1,139 @@
+"""Parameter initializers (ref: ``python/paddle/nn/initializer/``)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.random import next_key
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]  # [in, out] reference linear layout
+    rf = 1
+    for s in shape[2:]:
+        rf *= s
+    return shape[1] * rf, shape[0] * rf  # conv OIHW
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, key=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None, key=None):
+        return jnp.full(shape, self.value, dtype=dtype or get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        key = key if key is not None else next_key()
+        dtype = dtype or get_default_dtype()
+        return self.mean + self.std * jax.random.normal(key, shape, jnp.float32).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        key = key if key is not None else next_key()
+        dtype = dtype or get_default_dtype()
+        x = jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+        return (self.mean + self.std * x).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None, key=None):
+        key = key if key is not None else next_key()
+        dtype = dtype or get_default_dtype()
+        return jax.random.uniform(key, shape, jnp.float32, self.low, self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return Normal(0.0, std)(shape, dtype, key)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return Uniform(-limit, limit)(shape, dtype, key)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="relu"):
+        self.a = negative_slope
+
+    def __call__(self, shape, dtype=None, key=None):
+        fan_in, _ = _fans(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        return Normal(0.0, gain / math.sqrt(fan_in))(shape, dtype, key)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, negative_slope=0.0, nonlinearity="relu"):
+        self.a = negative_slope
+
+    def __call__(self, shape, dtype=None, key=None):
+        fan_in, _ = _fans(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        limit = gain * math.sqrt(3.0 / fan_in)
+        return Uniform(-limit, limit)(shape, dtype, key)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        key = key if key is not None else next_key()
+        dtype = dtype or get_default_dtype()
+        return self.gain * jax.nn.initializers.orthogonal()(key, shape, jnp.float32).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None, key=None):
+        arr = jnp.asarray(self.value, dtype=dtype or get_default_dtype())
+        assert arr.shape == tuple(shape), f"Assign shape {arr.shape} != {shape}"
+        return arr
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0

@@ -1,0 +1,508 @@
+"""Layer classes (ref: ``python/paddle/nn/layer/common.py``, ``conv.py``,
+``norm.py``, ``pooling.py``, ``activation.py``, ``container.py``).
+
+Every layer is a pytree Module: construction materialises parameters eagerly
+(reference dygraph behaviour) from the global seeded RNG; calls are pure.
+Layers with randomness (Dropout) take an optional ``rng=`` keyword — inside
+``jit`` you must pass it (the trainer threads an RngStream); in eager mode it
+falls back to the global generator.
+"""
+from __future__ import annotations
+
+import inspect
+import math
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+__all__ = [
+    "Linear", "Identity", "Bilinear", "Embedding", "Dropout", "Dropout2D",
+    "AlphaDropout", "Flatten", "Pad1D", "Pad2D", "Upsample", "PixelShuffle",
+    "Sequential", "LayerList", "LayerDict",
+    "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
+    "LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+    "BatchNorm3D", "GroupNorm", "InstanceNorm2D", "LocalResponseNorm",
+    "MaxPool1D", "MaxPool2D", "AvgPool1D", "AvgPool2D", "AdaptiveAvgPool2D",
+    "AdaptiveMaxPool2D",
+    "ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Mish", "Sigmoid", "Tanh",
+    "Softmax", "LogSoftmax", "LeakyReLU", "ELU", "SELU", "CELU", "Hardswish",
+    "Hardsigmoid", "Hardtanh", "PReLU", "Softplus", "Softshrink", "Hardshrink",
+    "Softsign", "Tanhshrink", "ThresholdedReLU", "Maxout", "GLU",
+]
+
+
+def _maybe_rng_call(layer, x, rng):
+    """Call `layer(x)` passing rng= only if the layer accepts it."""
+    sig = getattr(type(layer), "_accepts_rng", None)
+    if sig is None:
+        params = inspect.signature(type(layer).__call__).parameters
+        sig = "rng" in params
+        type(layer)._accepts_rng = sig
+    return layer(x, rng=rng) if sig else layer(x)
+
+
+# -- core layers ------------------------------------------------------------
+
+class Linear(Module):
+    """y = x @ W + b, W: [in, out] (reference layout, python/paddle/nn/layer/common.py:Linear)."""
+
+    def __init__(self, in_features: int, out_features: int, bias_attr=True,
+                 weight_init: Optional[I.Initializer] = None, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        init = weight_init or I.XavierNormal()
+        self.weight = init((in_features, out_features), dtype)
+        self.bias = I.Constant(0.0)((out_features,), dtype) if bias_attr else None
+        self.in_features, self.out_features = in_features, out_features
+
+    def __call__(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Identity(Module):
+    def __call__(self, x):
+        return x
+
+
+class Bilinear(Module):
+    def __init__(self, in1_features, in2_features, out_features, bias_attr=True):
+        super().__init__()
+        dtype = get_default_dtype()
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = I.Uniform(-bound, bound)((out_features, in1_features, in2_features), dtype)
+        self.bias = I.Constant(0.0)((out_features,), dtype) if bias_attr else None
+
+    def __call__(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Embedding(Module):
+    """Ref: python/paddle/nn/layer/common.py:Embedding. Dense gather on TPU
+    (no sparse grads — XLA scatters the cotangent)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, padding_idx=None,
+                 weight_init: Optional[I.Initializer] = None, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        init = weight_init or I.Normal(0.0, 1.0)
+        self.weight = init((num_embeddings, embedding_dim), dtype)
+        self.padding_idx = padding_idx
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+
+    def __call__(self, x):
+        return F.embedding(x, self.weight, self.padding_idx)
+
+
+class Dropout(Module):
+    def __init__(self, p=0.5, axis=None):
+        super().__init__()
+        self.p, self.axis = p, axis
+
+    def __call__(self, x, rng=None):
+        return F.dropout(x, self.p, training=self.training, rng=rng, axis=self.axis)
+
+
+class Dropout2D(Module):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def __call__(self, x, rng=None):
+        return F.dropout2d(x, self.p, training=self.training, rng=rng)
+
+
+class AlphaDropout(Module):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def __call__(self, x, rng=None):
+        return F.alpha_dropout(x, self.p, training=self.training, rng=rng)
+
+
+class Flatten(Module):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def __call__(self, x):
+        from paddle_tpu.tensor import flatten
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Pad1D(Module):
+    def __init__(self, padding, mode="constant", value=0.0):
+        super().__init__()
+        self.padding, self.mode, self.value = tuple(padding), mode, value
+
+    def __call__(self, x):
+        from paddle_tpu.tensor import pad
+        return pad(x, list(self.padding), mode=self.mode, value=self.value)
+
+
+class Pad2D(Pad1D):
+    pass
+
+
+class Upsample(Module):
+    def __init__(self, size=None, scale_factor=None, mode="nearest", align_corners=False):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+
+    def __call__(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode, self.align_corners)
+
+
+class PixelShuffle(Module):
+    def __init__(self, upscale_factor):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def __call__(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor)
+
+
+# -- containers (ref container.py) ------------------------------------------
+
+class Sequential(Module):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)):
+            layers = tuple(layers[0])
+        self.layers = list(layers)
+
+    def __call__(self, x, rng=None):
+        for i, layer in enumerate(self.layers):
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = _maybe_rng_call(layer, x, sub)
+        return x
+
+    def __getitem__(self, idx):
+        return self.layers[idx]
+
+    def __len__(self):
+        return len(self.layers)
+
+    def append(self, layer):
+        self.layers.append(layer)
+
+
+class LayerList(Module):
+    def __init__(self, layers=()):
+        super().__init__()
+        self.layers = list(layers)
+
+    def __getitem__(self, idx):
+        return self.layers[idx]
+
+    def __setitem__(self, idx, layer):
+        self.layers[idx] = layer
+
+    def __len__(self):
+        return len(self.layers)
+
+    def __iter__(self):
+        return iter(self.layers)
+
+    def append(self, layer):
+        self.layers.append(layer)
+
+    def extend(self, layers):
+        self.layers.extend(layers)
+
+
+class LayerDict(Module):
+    def __init__(self, layers=None):
+        super().__init__()
+        self.layers = dict(layers or {})
+
+    def __getitem__(self, k):
+        return self.layers[k]
+
+    def __setitem__(self, k, v):
+        self.layers[k] = v
+
+    def keys(self):
+        return self.layers.keys()
+
+    def values(self):
+        return self.layers.values()
+
+    def items(self):
+        return self.layers.items()
+
+
+# -- conv layers (ref conv.py) ----------------------------------------------
+
+class _ConvNd(Module):
+    def __init__(self, nd, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=True,
+                 weight_init=None, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        k = (kernel_size,) * nd if isinstance(kernel_size, int) else tuple(kernel_size)
+        shape = (out_channels, in_channels // groups) + k
+        init = weight_init or I.KaimingUniform()
+        self.weight = init(shape, dtype)
+        self.bias = I.Constant(0.0)((out_channels,), dtype) if bias_attr else None
+        self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
+        self.in_channels, self.out_channels = in_channels, out_channels
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(1, in_channels, out_channels, kernel_size, **kw)
+
+    def __call__(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(2, in_channels, out_channels, kernel_size, **kw)
+
+    def __call__(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(3, in_channels, out_channels, kernel_size, **kw)
+
+    def __call__(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups)
+
+
+class Conv2DTranspose(Module):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, bias_attr=True, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.weight = I.KaimingUniform()((in_channels, out_channels // groups) + k, dtype)
+        self.bias = I.Constant(0.0)((out_channels,), dtype) if bias_attr else None
+        self.stride, self.padding, self.output_padding = stride, padding, output_padding
+        self.dilation, self.groups = dilation, groups
+
+    def __call__(self, x):
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride, self.padding,
+                                  self.output_padding, self.dilation, self.groups)
+
+
+# -- norm layers (ref norm.py) ----------------------------------------------
+
+class LayerNorm(Module):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=True,
+                 bias_attr=True, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        shape = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+        self.weight = I.Constant(1.0)(shape, dtype) if weight_attr else None
+        self.bias = I.Constant(0.0)(shape, dtype) if bias_attr else None
+        self.normalized_shape, self.epsilon = shape, epsilon
+
+    def __call__(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.epsilon)
+
+
+class RMSNorm(Module):
+    """Ref: paddle.incubate.nn.FusedRMSNorm / LLaMA RMSNorm."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, dtype=None):
+        super().__init__()
+        self.weight = I.Constant(1.0)((hidden_size,), dtype or get_default_dtype())
+        self.epsilon = epsilon
+
+    def __call__(self, x):
+        from paddle_tpu.ops import fused_rms_norm
+        return fused_rms_norm(x, self.weight, self.epsilon)
+
+
+class _BatchNormBase(Module):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.weight = I.Constant(1.0)((num_features,), dtype)
+        self.bias = I.Constant(0.0)((num_features,), dtype)
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance", jnp.ones((num_features,), jnp.float32))
+        self.momentum, self.epsilon = momentum, epsilon
+
+    def __call__(self, x):
+        out, new_mean, new_var = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self.momentum, epsilon=self.epsilon)
+        if self.training:
+            # eager-mode stat update; under jit use functional batch_norm directly
+            try:
+                object.__setattr__(self, "_mean", new_mean)
+                object.__setattr__(self, "_variance", new_var)
+            except Exception:
+                pass
+        return out
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class GroupNorm(Module):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.weight = I.Constant(1.0)((num_channels,), dtype)
+        self.bias = I.Constant(0.0)((num_channels,), dtype)
+        self.num_groups, self.epsilon = num_groups, epsilon
+
+    def __call__(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias, self.epsilon)
+
+
+class InstanceNorm2D(Module):
+    def __init__(self, num_features, epsilon=1e-5, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.weight = I.Constant(1.0)((num_features,), dtype)
+        self.bias = I.Constant(0.0)((num_features,), dtype)
+        self.epsilon = epsilon
+
+    def __call__(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self.epsilon)
+
+
+class LocalResponseNorm(Module):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def __call__(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta, self.k)
+
+
+# -- pooling layers ---------------------------------------------------------
+
+class MaxPool2D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def __call__(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool2D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def __call__(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class MaxPool1D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def __call__(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool1D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def __call__(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AdaptiveAvgPool2D(Module):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Module):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+# -- activation layers ------------------------------------------------------
+
+def _act_layer(name, fn, **defaults):
+    def __init__(self, **kw):
+        Module.__init__(self)
+        for k, v in defaults.items():
+            setattr(self, k, kw.get(k, v))
+
+    def __call__(self, x):
+        kw = {k: getattr(self, k) for k in defaults}
+        return fn(x, **kw)
+
+    return type(name, (Module,), {"__init__": __init__, "__call__": __call__})
+
+
+ReLU = _act_layer("ReLU", lambda x: F.relu(x))
+ReLU6 = _act_layer("ReLU6", lambda x: F.relu6(x))
+GELU = _act_layer("GELU", F.gelu, approximate=False)
+SiLU = _act_layer("SiLU", lambda x: F.silu(x))
+Swish = _act_layer("Swish", lambda x: F.silu(x))
+Mish = _act_layer("Mish", lambda x: F.mish(x))
+Sigmoid = _act_layer("Sigmoid", lambda x: F.sigmoid(x))
+Tanh = _act_layer("Tanh", lambda x: F.tanh(x))
+Softmax = _act_layer("Softmax", F.softmax, axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax, axis=-1)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+ELU = _act_layer("ELU", lambda x, alpha=1.0: F.elu(x, alpha), alpha=1.0)
+SELU = _act_layer("SELU", lambda x: F.selu(x))
+CELU = _act_layer("CELU", lambda x, alpha=1.0: F.celu(x, alpha), alpha=1.0)
+Hardswish = _act_layer("Hardswish", lambda x: F.hardswish(x))
+Hardsigmoid = _act_layer("Hardsigmoid", lambda x: F.hardsigmoid(x))
+Hardtanh = _act_layer("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+Softplus = _act_layer("Softplus", lambda x: F.softplus(x))
+Softshrink = _act_layer("Softshrink", F.softshrink, threshold=0.5)
+Hardshrink = _act_layer("Hardshrink", F.hardshrink, threshold=0.5)
+Softsign = _act_layer("Softsign", lambda x: F.softsign(x))
+Tanhshrink = _act_layer("Tanhshrink", lambda x: F.tanhshrink(x))
+ThresholdedReLU = _act_layer("ThresholdedReLU", F.thresholded_relu, threshold=1.0)
+Maxout = _act_layer("Maxout", F.maxout, groups=2, axis=1)
+GLU = _act_layer("GLU", F.glu, axis=-1)
+
+
+class PReLU(Module):
+    def __init__(self, num_parameters=1, init=0.25, dtype=None):
+        super().__init__()
+        self.weight = I.Constant(init)((num_parameters,), dtype or get_default_dtype())
+
+    def __call__(self, x):
+        return F.prelu(x, self.weight)

@@ -1,0 +1,120 @@
+"""Loss layer classes (ref: ``python/paddle/nn/layer/loss.py``)."""
+from __future__ import annotations
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+
+
+class CrossEntropyLoss(Module):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, label_smoothing=0.0, axis=-1):
+        super().__init__()
+        self.weight = weight
+        self.ignore_index, self.reduction = ignore_index, reduction
+        self.soft_label, self.label_smoothing, self.axis = soft_label, label_smoothing, axis
+
+    def __call__(self, input, label):
+        return F.cross_entropy(input, label, self.weight, self.ignore_index,
+                               self.reduction, self.soft_label, self.axis,
+                               self.label_smoothing)
+
+
+class MSELoss(Module):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def __call__(self, input, label):
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Module):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def __call__(self, input, label):
+        return F.l1_loss(input, label, self.reduction)
+
+
+class SmoothL1Loss(Module):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def __call__(self, input, label):
+        return F.smooth_l1_loss(input, label, self.reduction, self.delta)
+
+
+class NLLLoss(Module):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean"):
+        super().__init__()
+        self.weight, self.ignore_index, self.reduction = weight, ignore_index, reduction
+
+    def __call__(self, input, label):
+        return F.nll_loss(input, label, self.weight, self.ignore_index, self.reduction)
+
+
+class BCELoss(Module):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def __call__(self, input, label):
+        return F.binary_cross_entropy(input, label, self.weight, self.reduction)
+
+
+class BCEWithLogitsLoss(Module):
+    def __init__(self, weight=None, reduction="mean", pos_weight=None):
+        super().__init__()
+        self.weight, self.reduction, self.pos_weight = weight, reduction, pos_weight
+
+    def __call__(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label, self.weight,
+                                                  self.reduction, self.pos_weight)
+
+
+class KLDivLoss(Module):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def __call__(self, input, label):
+        return F.kl_div(input, label, self.reduction)
+
+
+class CosineEmbeddingLoss(Module):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def __call__(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label, self.margin, self.reduction)
+
+
+class MarginRankingLoss(Module):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def __call__(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self.margin, self.reduction)
+
+
+class TripletMarginLoss(Module):
+    def __init__(self, margin=1.0, p=2.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.p, self.reduction = margin, p, reduction
+
+    def __call__(self, anchor, positive, negative):
+        return F.triplet_margin_loss(anchor, positive, negative, self.margin,
+                                     self.p, self.reduction)
+
+
+class HingeEmbeddingLoss(Module):
+    def __init__(self, margin=1.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def __call__(self, input, label):
+        return F.hinge_embedding_loss(input, label, self.margin, self.reduction)

@@ -1,0 +1,144 @@
+"""Recurrent layers (ref: ``python/paddle/nn/layer/rnn.py``).
+
+The reference runs cuDNN RNN kernels; on TPU the idiomatic lowering is a
+``lax.scan`` over time with the gate matmuls batched so each step is one
+MXU-friendly [B, 4H] GEMM. Layout: batch_first (B, T, C) like the reference
+default ``time_major=False``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+
+
+class _RNNCellBase(Module):
+    def __init__(self, input_size, hidden_size, gates, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        k = 1.0 / jnp.sqrt(jnp.array(hidden_size, jnp.float32))
+        init = I.Uniform(-float(k), float(k))
+        self.weight_ih = init((input_size, gates * hidden_size), dtype)
+        self.weight_hh = init((hidden_size, gates * hidden_size), dtype)
+        self.bias_ih = init((gates * hidden_size,), dtype)
+        self.bias_hh = init((gates * hidden_size,), dtype)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", dtype=None):
+        super().__init__(input_size, hidden_size, 1, dtype)
+        self.activation = activation
+
+    def __call__(self, x, h):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return act(x @ self.weight_ih + self.bias_ih + h @ self.weight_hh + self.bias_hh)
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, dtype=None):
+        super().__init__(input_size, hidden_size, 4, dtype)
+
+    def __call__(self, x, state):
+        h, c = state
+        gates = x @ self.weight_ih + self.bias_ih + h @ self.weight_hh + self.bias_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, dtype=None):
+        super().__init__(input_size, hidden_size, 3, dtype)
+
+    def __call__(self, x, h):
+        gi = x @ self.weight_ih + self.bias_ih
+        gh = h @ self.weight_hh + self.bias_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        return (1 - z) * n + z * h
+
+
+class _RNNBase(Module):
+    cell_cls = None
+    is_lstm = False
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 dtype=None, **cell_kw):
+        super().__init__()
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirectional else 1
+        cells = []
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * ndir
+            cells.append(self.cell_cls(in_size, hidden_size, dtype=dtype, **cell_kw))
+            if self.bidirectional:
+                cells.append(self.cell_cls(in_size, hidden_size, dtype=dtype, **cell_kw))
+        self.cells = cells
+        self.num_layers, self.hidden_size = num_layers, hidden_size
+
+    def _zero_state(self, cell, batch, dtype):
+        h = jnp.zeros((batch, cell.hidden_size), dtype)
+        return (h, jnp.zeros_like(h)) if self.is_lstm else h
+
+    def _run_cell(self, cell, x_tbc, init_state, reverse=False):
+        if reverse:
+            x_tbc = jnp.flip(x_tbc, axis=0)
+
+        def step(state, xt):
+            if self.is_lstm:
+                h, state = cell(xt, state)
+            else:
+                state = cell(xt, state)
+                h = state
+            return state, h
+
+        final, ys = lax.scan(step, init_state, x_tbc)
+        if reverse:
+            ys = jnp.flip(ys, axis=0)
+        return final, ys
+
+    def __call__(self, x, initial_states=None):
+        # x: [B, T, C] -> scan over T
+        x_tbc = jnp.swapaxes(x, 0, 1)
+        ndir = 2 if self.bidirectional else 1
+        finals = []
+        for layer in range(self.num_layers):
+            cell_f = self.cells[layer * ndir]
+            st = (initial_states[layer * ndir] if initial_states is not None
+                  else self._zero_state(cell_f, x.shape[0], x.dtype))
+            final_f, ys_f = self._run_cell(cell_f, x_tbc, st)
+            if self.bidirectional:
+                cell_b = self.cells[layer * ndir + 1]
+                st_b = (initial_states[layer * ndir + 1] if initial_states is not None
+                        else self._zero_state(cell_b, x.shape[0], x.dtype))
+                final_b, ys_b = self._run_cell(cell_b, x_tbc, st_b, reverse=True)
+                x_tbc = jnp.concatenate([ys_f, ys_b], axis=-1)
+                finals += [final_f, final_b]
+            else:
+                x_tbc = ys_f
+                finals.append(final_f)
+        return jnp.swapaxes(x_tbc, 0, 1), finals
+
+
+class SimpleRNN(_RNNBase):
+    cell_cls = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    cell_cls = LSTMCell
+    is_lstm = True
+
+
+class GRU(_RNNBase):
+    cell_cls = GRUCell

@@ -1,0 +1,114 @@
+"""Attention ops (ref: ``paddle/phi/kernels/fusion/flash_attn`` +
+``python/paddle/nn/functional/flash_attention.py``).
+
+Layout convention matches the reference flash_attention API: [B, S, H, D].
+Dispatch order on TPU: Pallas flash kernel (paddle_tpu.ops.pallas) → fused
+XLA path. The XLA path is itself MXU-friendly: two batched matmuls with a
+fp32 softmax that XLA fuses into the surrounding computation.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -2.3819763e38  # most-negative bf16-representable; avoids nan from -inf - -inf
+
+
+def _use_pallas(q) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    head_dim = q.shape[-1]
+    seq = q.shape[1]
+    return head_dim % 128 == 0 and seq % 128 == 0
+
+
+def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None,
+                  dropout_p=0.0, training=True, rng=None):
+    """Reference-semantics attention in pure XLA. [B,S,H,D]."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    kv_heads = key.shape[2]
+    if kv_heads != h:  # GQA: repeat KV heads
+        rep = h // kv_heads
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    q = jnp.swapaxes(query, 1, 2)  # [B,H,S,D]
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(causal, scores, _NEG_INF)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, _NEG_INF)
+        else:
+            scores = scores + attn_mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and training:
+        if rng is None:
+            from paddle_tpu.core.random import next_key
+            rng = next_key()
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    probs = probs.astype(v.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, rng=None, scale=None):
+    if (attn_mask is None and dropout_p == 0.0 and _use_pallas(query)
+            and query.shape[2] == key.shape[2]):
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(query, key, value, causal=is_causal, scale=scale)
+        except Exception:
+            pass
+    return xla_attention(query, key, value, attn_mask=attn_mask, is_causal=is_causal,
+                         scale=scale, dropout_p=dropout_p, training=training, rng=rng)
+
+
+flash_attention = scaled_dot_product_attention
+
+
+# -- rotary embedding (ref: paddle.incubate.nn.functional.fused_rotary_position_embedding)
+
+def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32, position_ids=None):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None else position_ids
+    freqs = jnp.outer(pos, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B,S,H,D]; cos/sin: [S, D/2]. NeoX-style rotate-half (LLaMA)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def fused_rotary_position_embedding(q, k, seq_len=None, base=10000.0, position_ids=None):
+    s = seq_len or q.shape[1]
+    cos, sin = rope_cos_sin(s, q.shape[-1], base=base, dtype=jnp.float32,
+                            position_ids=position_ids)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+
+
+# -- fused residual chains (ref fused_bias_dropout_residual_layer_norm) -----
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None, ln_scale=None,
+                                           ln_bias=None, dropout_rate=0.0,
+                                           epsilon=1e-5, training=True, rng=None):
+    from paddle_tpu.nn import functional as F
+    y = x if bias is None else x + bias
+    y = F.dropout(y, dropout_rate, training=training, rng=rng)
+    y = y + residual
+    return F.layer_norm(y, y.shape[-1], ln_scale, ln_bias, epsilon)

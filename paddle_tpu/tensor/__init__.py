@@ -1,0 +1,847 @@
+"""Tensor op surface with reference naming/semantics.
+
+Reference: ``python/paddle/tensor/`` (creation.py, math.py, manipulation.py,
+linalg.py, search.py, logic.py, stat.py). Each op here keeps Paddle's name
+and argument conventions (``axis=`` etc.) but lowers straight to jnp/lax so
+XLA owns fusion and MXU tiling. Ops are pure functions of jax.Arrays — there
+is deliberately no Tensor wrapper class: jax.Array IS the tensor type.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtypes import get_default_dtype
+
+# -- creation (ref python/paddle/tensor/creation.py) ------------------------
+
+def to_tensor(data, dtype=None):
+    return jnp.asarray(data, dtype=dtype)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype=dtype or get_default_dtype())
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype=dtype or get_default_dtype())
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, dtype=dtype or get_default_dtype())
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+def arange(start, end=None, step=1, dtype=None):
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=dtype or get_default_dtype())
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=dtype or get_default_dtype())
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, dtype=dtype or get_default_dtype())
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def clone(x):
+    return jnp.array(x, copy=True)
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+# -- math (ref python/paddle/tensor/math.py) --------------------------------
+
+add = jnp.add
+subtract = jnp.subtract
+multiply = jnp.multiply
+divide = jnp.divide
+floor_divide = jnp.floor_divide
+mod = remainder = jnp.remainder
+pow = jnp.power
+negative = jnp.negative
+abs = jnp.abs
+sign = jnp.sign
+sqrt = jnp.sqrt
+rsqrt = lax.rsqrt
+square = jnp.square
+exp = jnp.exp
+expm1 = jnp.expm1
+log = jnp.log
+log2 = jnp.log2
+log10 = jnp.log10
+log1p = jnp.log1p
+sin = jnp.sin
+cos = jnp.cos
+tan = jnp.tan
+asin = jnp.arcsin
+acos = jnp.arccos
+atan = jnp.arctan
+atan2 = jnp.arctan2
+sinh = jnp.sinh
+cosh = jnp.cosh
+tanh = jnp.tanh
+asinh = jnp.arcsinh
+acosh = jnp.arccosh
+atanh = jnp.arctanh
+ceil = jnp.ceil
+floor = jnp.floor
+round = jnp.round
+trunc = jnp.trunc
+reciprocal = jnp.reciprocal
+erf = jax.scipy.special.erf
+erfinv = jax.scipy.special.erfinv
+lgamma = jax.scipy.special.gammaln
+digamma = jax.scipy.special.digamma
+isnan = jnp.isnan
+isinf = jnp.isinf
+isfinite = jnp.isfinite
+maximum = jnp.maximum
+minimum = jnp.minimum
+fmax = jnp.fmax
+fmin = jnp.fmin
+hypot = jnp.hypot
+nan_to_num = jnp.nan_to_num
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def multiply_(x, y):  # alias: no in-place under XLA, returns new array
+    return jnp.multiply(x, y)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+# -- reductions (ref math.py / stat.py) -------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def cummax(x, axis=-1):
+    return lax.associative_scan(jnp.maximum, x, axis=axis)
+
+
+def cummin(x, axis=-1):
+    return lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+# -- linalg (ref python/paddle/tensor/linalg.py) ----------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def t(x):
+    return jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, axes=perm)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or p == 2:
+        return jnp.linalg.norm(x, ord=None if axis is None else 2, axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def dist(x, y, p=2):
+    return norm(x - y, p=p)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    return jnp.linalg.slogdet(x)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def cholesky(x, upper=False):
+    c = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(c, -1, -2) if upper else c
+
+
+def eigh(x):
+    return jnp.linalg.eigh(x)
+
+
+def solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+def lstsq(a, b):
+    return jnp.linalg.lstsq(a, b)
+
+
+def triangular_solve(a, b, upper=True):
+    return jax.scipy.linalg.solve_triangular(a, b, lower=not upper)
+
+
+def matrix_rank(x, tol=None):
+    return jnp.linalg.matrix_rank(x, tol)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    rng = None if min == 0 and max == 0 else (min, max)
+    return jnp.histogram(x, bins=bins, range=rng)[0]
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+# -- manipulation (ref python/paddle/tensor/manipulation.py) ----------------
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if stop_axis < 0:
+        stop_axis += nd
+    if start_axis < 0:
+        start_axis += nd
+    shape = x.shape[:start_axis] + (-1,) + x.shape[stop_axis + 1:]
+    return jnp.reshape(x, shape)
+
+
+def concat(x, axis=0):
+    return jnp.concatenate(x, axis=axis)
+
+
+def stack(x, axis=0):
+    return jnp.stack(x, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    # paddle allows one -1 meaning "the rest"
+    if -1 in sections:
+        known = builtins_sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    idx = jnp.cumsum(jnp.array(sections))[:-1]
+    return jnp.split(x, [int(i) for i in idx], axis=axis)
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def expand(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis):
+    return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_add(x, index, axis, value):
+    return _index_add(x, index, axis, value)
+
+
+def _index_add(x, index, axis, value):
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(value, axis, 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def slice(x, axes, starts, ends):
+    idx = [builtins_slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins_slice(s, e)
+    return x[tuple(idx)]
+
+
+def builtins_slice(*a):
+    import builtins
+    return builtins.slice(*a)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins_slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins_slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def unbind(x, axis=0):
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def unstack(x, axis=0):
+    return unbind(x, axis)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """Paddle pad: `pad` is per-axis (low, high) pairs from the LAST axis
+    backwards when len(pad) < 2*ndim (torch convention adopted by paddle)."""
+    if len(pad) == 2 * x.ndim:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        n = len(pad) // 2
+        cfg = [(0, 0)] * (x.ndim - n) + [
+            (pad[2 * i], pad[2 * i + 1]) for i in range(n)][::1]
+        # paddle orders pad pairs starting from the last spatial dims
+        cfg[-n:] = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)][::-1]
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(x, offset=0):
+    n = x.shape[-1] + builtins_abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        return base.at[..., idx, idx + offset].set(x)
+    return base.at[..., idx - offset, idx].set(x)
+
+
+def builtins_abs(v):
+    import builtins
+    return builtins.abs(v)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    return jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+
+
+def unique_consecutive(x, axis=None):
+    if axis is None:
+        x = x.ravel()
+        keep = jnp.concatenate([jnp.array([True]), x[1:] != x[:-1]])
+        return x[keep]
+    raise NotImplementedError("axis != None requires static shapes")
+
+
+# -- search / sort (ref python/paddle/tensor/search.py) ---------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype)
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if not largest:
+        values, indices = lax.top_k(jnp.moveaxis(-x, axis, -1), k)
+        values = -values
+    else:
+        values, indices = lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    return jnp.moveaxis(values, -1, axis), jnp.moveaxis(indices, -1, axis)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    sorted_x = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_x, k - 1, axis=axis)
+    inds = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        inds = jnp.expand_dims(inds, axis)
+    return vals, inds
+
+
+def mode(x, axis=-1, keepdim=False):
+    raise NotImplementedError("mode requires dynamic shapes; use host path")
+
+
+def nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return nz
+    return jnp.stack(nz, axis=-1)
+
+
+def searchsorted(sorted_sequence, values, right=False):
+    return jnp.searchsorted(sorted_sequence, values, side="right" if right else "left")
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+# -- logic (ref python/paddle/tensor/logic.py) ------------------------------
+
+equal = jnp.equal
+not_equal = jnp.not_equal
+greater_than = jnp.greater
+greater_equal = jnp.greater_equal
+less_than = jnp.less
+less_equal = jnp.less_equal
+logical_and = jnp.logical_and
+logical_or = jnp.logical_or
+logical_not = jnp.logical_not
+logical_xor = jnp.logical_xor
+bitwise_and = jnp.bitwise_and
+bitwise_or = jnp.bitwise_or
+bitwise_xor = jnp.bitwise_xor
+bitwise_not = jnp.bitwise_not
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def is_empty(x):
+    return x.size == 0
+
+
+# -- random sampling (ref python/paddle/tensor/random.py) -------------------
+# Eager-mode convenience using the global seed; inside jit pass keys to the
+# keyed variants (suffix `_with_key`).
+
+def _k():
+    from paddle_tpu.core.random import next_key
+    return next_key()
+
+
+def rand(shape, dtype=None):
+    return jax.random.uniform(_k(), shape, dtype=dtype or get_default_dtype())
+
+
+def randn(shape, dtype=None):
+    return jax.random.normal(_k(), shape, dtype=dtype or get_default_dtype())
+
+
+def randint(low, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_k(), shape, low, high, dtype=dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return jax.random.uniform(_k(), shape, dtype=dtype or get_default_dtype(),
+                              minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    return mean + std * jax.random.normal(_k(), shape, dtype=get_default_dtype())
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(_k(), n).astype(dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(_k(), logits, shape=x.shape[:-1] + (num_samples,))
+    return jax.random.choice(_k(), x.shape[-1], shape=(num_samples,), replace=False,
+                             p=x / x.sum())
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(_k(), x).astype(get_default_dtype())
+
+
+# -- misc -------------------------------------------------------------------
+
+def numel(x):
+    return x.size
+
+
+def shape(x):
+    return jnp.array(x.shape, dtype=jnp.int32)
+
+
+def item(x):
+    return x.item()
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def bucketize(x, sorted_sequence, right=False):
+    return jnp.searchsorted(sorted_sequence, x, side="right" if right else "left")
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def cdist(x, y, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    return norm(diff, p=p, axis=-1)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot_ / jnp.maximum(n1 * n2, eps)
