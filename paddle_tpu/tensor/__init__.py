@@ -600,14 +600,11 @@ def cast(x, dtype):
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     """Paddle pad: `pad` is per-axis (low, high) pairs from the LAST axis
     backwards when len(pad) < 2*ndim (torch convention adopted by paddle)."""
-    if len(pad) == 2 * x.ndim:
-        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
-    else:
-        n = len(pad) // 2
-        cfg = [(0, 0)] * (x.ndim - n) + [
-            (pad[2 * i], pad[2 * i + 1]) for i in range(n)][::1]
-        # paddle orders pad pairs starting from the last spatial dims
-        cfg[-n:] = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)][::-1]
+    n = len(pad) // 2
+    # pairs apply from the LAST axis backwards: pad[0:2]→axis -1, pad[2:4]→axis -2, ...
+    cfg = [(0, 0)] * x.ndim
+    for i in range(n):
+        cfg[x.ndim - 1 - i] = (pad[2 * i], pad[2 * i + 1])
     if mode == "constant":
         return jnp.pad(x, cfg, constant_values=value)
     jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]

@@ -1,0 +1,27 @@
+"""Test config: force an 8-device virtual CPU mesh so distributed tests run
+without TPU hardware (SURVEY.md §4).
+
+Note: the axon TPU-tunnel plugin is registered by sitecustomize at
+interpreter startup (it imports jax internals), so JAX_PLATFORMS in the
+environment is already consumed — the override must go through
+jax.config.update, and XLA_FLAGS must be set before the CPU client is
+instantiated (it is created lazily, so doing it here is early enough).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+    pt.seed(0)
+    yield

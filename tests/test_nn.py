@@ -1,0 +1,250 @@
+"""Layer fwd/bwd semantics; golden checks vs torch CPU where APIs are 1:1
+(SURVEY.md §4; ref test/legacy_test/test_layers.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_matches_torch():
+    import torch
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    lin = nn.Linear(8, 3)
+    w = np.asarray(lin.weight)
+    b = np.asarray(lin.bias)
+    t = torch.nn.functional.linear(torch.tensor(x), torch.tensor(w.T), torch.tensor(b))
+    np.testing.assert_allclose(np.asarray(lin(jnp.asarray(x))), t.numpy(), rtol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    import torch
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    conv = nn.Conv2D(3, 5, 3, stride=2, padding=1)
+    t = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(np.asarray(conv.weight)),
+                                   torch.tensor(np.asarray(conv.bias)), stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(conv(jnp.asarray(x))), t.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_conv_groups_dilation():
+    import torch
+    x = np.random.RandomState(1).randn(1, 4, 10, 10).astype(np.float32)
+    conv = nn.Conv2D(4, 8, 3, groups=2, dilation=2)
+    t = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(np.asarray(conv.weight)),
+                                   torch.tensor(np.asarray(conv.bias)), groups=2, dilation=2)
+    np.testing.assert_allclose(np.asarray(conv(jnp.asarray(x))), t.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_matches_torch():
+    import torch
+    x = np.random.RandomState(2).randn(1, 4, 7, 7).astype(np.float32)
+    ct = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1, output_padding=1)
+    t = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(np.asarray(ct.weight)),
+        torch.tensor(np.asarray(ct.bias)), stride=2, padding=1, output_padding=1)
+    np.testing.assert_allclose(np.asarray(ct(jnp.asarray(x))), t.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_matches_torch():
+    import torch
+    x = np.random.RandomState(0).randn(4, 6, 16).astype(np.float32)
+    ln = nn.LayerNorm(16)
+    t = torch.nn.functional.layer_norm(torch.tensor(x), (16,),
+                                       torch.tensor(np.asarray(ln.weight)),
+                                       torch.tensor(np.asarray(ln.bias)))
+    np.testing.assert_allclose(np.asarray(ln(jnp.asarray(x))), t.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_and_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3, 5, 5).astype(np.float32))
+    y = bn(x)  # training: normalised by batch stats
+    np.testing.assert_allclose(np.asarray(y.mean(axis=(0, 2, 3))), np.zeros(3), atol=1e-5)
+    assert not np.allclose(np.asarray(bn._mean), 0.0)  # running stats updated
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_rms_norm():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 32).astype(np.float32))
+    rn = nn.RMSNorm(32)
+    y = rn(x)
+    expected = np.asarray(x) / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_group_norm_matches_torch():
+    import torch
+    x = np.random.RandomState(0).randn(2, 8, 4, 4).astype(np.float32)
+    gn = nn.GroupNorm(2, 8)
+    t = torch.nn.functional.group_norm(torch.tensor(x), 2,
+                                       torch.tensor(np.asarray(gn.weight)),
+                                       torch.tensor(np.asarray(gn.bias)))
+    np.testing.assert_allclose(np.asarray(gn(jnp.asarray(x))), t.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(jnp.array([[0, 1, 2]]))
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.zeros(4))
+    assert not np.allclose(np.asarray(out[0, 1]), 0.0)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y = d(x, rng=jax.random.PRNGKey(0))
+    frac = float((y == 0).mean())
+    assert 0.4 < frac < 0.6
+    d.eval()
+    np.testing.assert_allclose(np.asarray(d(x)), np.asarray(x))
+
+
+def test_pools_match_torch():
+    import torch
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    mp = F.max_pool2d(jnp.asarray(x), 2)
+    t = torch.nn.functional.max_pool2d(torch.tensor(x), 2)
+    np.testing.assert_allclose(np.asarray(mp), t.numpy(), rtol=1e-5, atol=1e-6)
+    ap = F.avg_pool2d(jnp.asarray(x), 2)
+    t2 = torch.nn.functional.avg_pool2d(torch.tensor(x), 2)
+    np.testing.assert_allclose(np.asarray(ap), t2.numpy(), rtol=1e-5, atol=1e-6)
+    aa = F.adaptive_avg_pool2d(jnp.asarray(x), 2)
+    t3 = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 2)
+    np.testing.assert_allclose(np.asarray(aa), t3.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_activations_match_torch():
+    import torch
+    x = np.linspace(-3, 3, 50).astype(np.float32)
+    tx = torch.tensor(x)
+    jx = jnp.asarray(x)
+    pairs = [
+        (F.relu, torch.nn.functional.relu),
+        (F.silu, torch.nn.functional.silu),
+        (lambda v: F.gelu(v), lambda v: torch.nn.functional.gelu(v)),
+        (F.softplus, torch.nn.functional.softplus),
+        (F.sigmoid, torch.sigmoid),
+        (lambda v: F.leaky_relu(v, 0.1), lambda v: torch.nn.functional.leaky_relu(v, 0.1)),
+        (F.hardswish, torch.nn.functional.hardswish),
+        (F.mish, torch.nn.functional.mish),
+        (lambda v: F.elu(v), torch.nn.functional.elu),
+    ]
+    for jf, tf in pairs:
+        np.testing.assert_allclose(np.asarray(jf(jx)), tf(tx).numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+    logits = np.random.RandomState(0).randn(8, 10).astype(np.float32)
+    labels = np.random.RandomState(1).randint(0, 10, (8,))
+    got = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    want = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    # ignore_index
+    labels2 = labels.copy()
+    labels2[:4] = -100
+    got2 = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels2))
+    want2 = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels2))
+    np.testing.assert_allclose(float(got2), float(want2), rtol=1e-5)
+    # label smoothing
+    got3 = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels), label_smoothing=0.1)
+    want3 = torch.nn.functional.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                                              label_smoothing=0.1)
+    np.testing.assert_allclose(float(got3), float(want3), rtol=1e-5)
+
+
+def test_bce_losses_match_torch():
+    import torch
+    logits = np.random.RandomState(0).randn(8).astype(np.float32)
+    labels = np.random.RandomState(1).randint(0, 2, (8,)).astype(np.float32)
+    got = F.binary_cross_entropy_with_logits(jnp.asarray(logits), jnp.asarray(labels))
+    want = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(logits), torch.tensor(labels))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_mha_causal_matches_manual():
+    mha = nn.MultiHeadAttention(16, 2).eval()
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 6, 16).astype(np.float32))
+    out = mha(x, is_causal=True)
+    assert out.shape == (1, 6, 16)
+    # causal: changing future tokens must not affect past outputs
+    x2 = x.at[:, -1].set(99.0)
+    out2 = mha(x2, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :5]), np.asarray(out2[:, :5]), atol=1e-5)
+
+
+def test_mha_gqa():
+    mha = nn.MultiHeadAttention(16, 4, num_kv_heads=2).eval()
+    x = jnp.ones((2, 5, 16))
+    assert mha(x).shape == (2, 5, 16)
+
+
+def test_rnn_shapes_and_grad():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = jnp.ones((2, 6, 4))
+    out, states = lstm(x)
+    assert out.shape == (2, 6, 8)
+
+    def loss(m, x):
+        return jnp.sum(m(x)[0] ** 2)
+
+    _, g = pt.value_and_grad(loss)(lstm, x)
+    gl = [l for l in jax.tree_util.tree_leaves(g) if l is not None]
+    assert all(np.isfinite(np.asarray(l)).all() for l in gl)
+
+
+def test_gru_matches_torch():
+    import torch
+    gru = nn.GRU(3, 5)
+    cell = gru.cells[0]
+    x = np.random.RandomState(0).randn(2, 4, 3).astype(np.float32)
+    tg = torch.nn.GRU(3, 5, batch_first=True)
+    with torch.no_grad():
+        tg.weight_ih_l0.copy_(torch.tensor(np.asarray(cell.weight_ih).T))
+        tg.weight_hh_l0.copy_(torch.tensor(np.asarray(cell.weight_hh).T))
+        tg.bias_ih_l0.copy_(torch.tensor(np.asarray(cell.bias_ih)))
+        tg.bias_hh_l0.copy_(torch.tensor(np.asarray(cell.bias_hh)))
+        want, _ = tg(torch.tensor(x))
+    got, _ = gru(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_and_state_dict():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert len(sd) == 4
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(sd)
+    x = jnp.ones((1, 4))
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), rtol=1e-6)
+
+
+def test_transformer_encoder_decoder():
+    enc = nn.TransformerEncoder(lambda: nn.TransformerEncoderLayer(16, 2, 32), 2).eval()
+    x = jnp.ones((2, 5, 16))
+    assert enc(x).shape == (2, 5, 16)
+
+
+def test_initializers():
+    import paddle_tpu.nn.initializer as I
+    w = I.XavierUniform()((100, 100))
+    fan = 100
+    limit = np.sqrt(6.0 / (fan + fan))
+    assert float(jnp.max(jnp.abs(w))) <= limit + 1e-6
+    k = I.KaimingNormal()((64, 64))
+    assert 0.05 < float(jnp.std(k)) < 0.35
+    c = I.Constant(3.0)((4,))
+    np.testing.assert_allclose(np.asarray(c), 3.0)
+
+
+def test_interpolate_modes():
+    x = jnp.ones((1, 2, 4, 4))
+    assert F.interpolate(x, scale_factor=2, mode="nearest").shape == (1, 2, 8, 8)
+    assert F.interpolate(x, size=(6, 6), mode="bilinear").shape == (1, 2, 6, 6)
+    assert F.pixel_shuffle(jnp.ones((1, 8, 2, 2)), 2).shape == (1, 2, 4, 4)

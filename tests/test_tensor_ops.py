@@ -1,0 +1,111 @@
+"""Op semantics vs numpy golden values (SURVEY.md §4; ref test/legacy_test/)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_creation():
+    assert pt.zeros([2, 3]).shape == (2, 3)
+    assert pt.ones([4]).dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(pt.arange(0, 10, 2)), np.arange(0, 10, 2))
+    assert pt.full([2], 7.0)[0] == 7.0
+    assert pt.eye(3)[1, 1] == 1.0
+    np.testing.assert_allclose(np.asarray(pt.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+
+
+def test_math_golden():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    j = jnp.asarray(x)
+    np.testing.assert_allclose(np.asarray(pt.exp(j)), np.exp(x), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.log(jnp.abs(j))), np.log(np.abs(x)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.rsqrt(jnp.abs(j) + 1)), 1 / np.sqrt(np.abs(x) + 1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.clip(j, -0.5, 0.5)), np.clip(x, -0.5, 0.5))
+    np.testing.assert_allclose(np.asarray(pt.lerp(j, j + 1, 0.5)), x + 0.5, rtol=1e-6)
+
+
+def test_reductions():
+    x = np.random.RandomState(1).rand(2, 5).astype(np.float32)
+    j = jnp.asarray(x)
+    np.testing.assert_allclose(np.asarray(pt.sum(j, axis=1)), x.sum(1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.mean(j, axis=0, keepdim=True)), x.mean(0, keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.std(j)), x.std(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.logsumexp(j, axis=1)),
+                               np.log(np.exp(x).sum(1)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.cumsum(j, axis=1)), x.cumsum(1), rtol=1e-6)
+
+
+def test_matmul_and_linalg():
+    a = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+    b = np.random.RandomState(3).randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pt.matmul(jnp.asarray(a), jnp.asarray(b))),
+                               a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(pt.matmul(jnp.asarray(a), jnp.asarray(b.T), transpose_y=True)),
+        a @ b, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.einsum("ij,jk->ik", jnp.asarray(a), jnp.asarray(b))),
+                               a @ b, rtol=1e-5)
+    sq = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(pt.inverse(jnp.asarray(sq))) @ sq,
+                               np.eye(3), atol=1e-4)
+
+
+def test_manipulation():
+    x = jnp.arange(24).reshape(2, 3, 4)
+    assert pt.reshape(x, [6, 4]).shape == (6, 4)
+    assert pt.flatten(x, 1).shape == (2, 12)
+    assert pt.squeeze(pt.unsqueeze(x, 0), 0).shape == x.shape
+    assert pt.concat([x, x], axis=1).shape == (2, 6, 4)
+    parts = pt.split(x, [1, -1], axis=1)
+    assert parts[0].shape == (2, 1, 4) and parts[1].shape == (2, 2, 4)
+    assert pt.transpose(x, [2, 0, 1]).shape == (4, 2, 3)
+    assert pt.tile(x, [2, 1, 1]).shape == (4, 3, 4)
+    assert len(pt.unbind(x, axis=0)) == 2
+    assert pt.gather(x, jnp.array([0, 0, 1]), axis=0).shape == (3, 3, 4)
+
+
+def test_search_sort():
+    x = jnp.asarray([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v, i = pt.topk(x, 2)
+    np.testing.assert_allclose(np.asarray(v), [[3, 2], [5, 4]])
+    assert int(pt.argmax(x, axis=1)[0]) == 0
+    np.testing.assert_allclose(np.asarray(pt.sort(x, axis=1)), np.sort(np.asarray(x), 1))
+    assert pt.nonzero(jnp.array([0, 1, 1])).shape == (2, 1)
+
+
+def test_logic():
+    x = jnp.array([1, 2, 3])
+    assert bool(pt.equal_all(x, x))
+    assert bool(pt.allclose(x.astype(jnp.float32), x.astype(jnp.float32) + 1e-9))
+    assert bool(pt.any(pt.greater_than(x, 2)))
+
+
+def test_random_reproducible():
+    pt.seed(42)
+    a = pt.rand([3, 3])
+    pt.seed(42)
+    b = pt.rand([3, 3])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert pt.randn([2, 2]).shape == (2, 2)
+    assert pt.randint(0, 10, [5]).dtype == jnp.int64 or pt.randint(0, 10, [5]).dtype == jnp.int32
+    p = pt.randperm(10)
+    assert sorted(np.asarray(p).tolist()) == list(range(10))
+
+
+def test_pad_and_where():
+    x = jnp.ones((2, 3))
+    assert pt.pad(x, [1, 1], value=0.0).shape == (2, 5)
+    assert pt.pad(x, [1, 1, 2, 2], value=0.0).shape == (6, 5)
+    out = pt.where(x > 0, x, -x)
+    np.testing.assert_allclose(np.asarray(out), np.ones((2, 3)))
+    np.testing.assert_allclose(np.asarray(pt.masked_fill(x, x > 0, 5.0)), np.full((2, 3), 5.0))
+
+
+def test_scatter_gather_nd():
+    x = jnp.zeros((4, 3))
+    out = pt.scatter(x, jnp.array([1, 3]), jnp.ones((2, 3)))
+    assert float(out[1, 0]) == 1.0 and float(out[0, 0]) == 0.0
+    idx = jnp.array([[0, 1], [2, 2]])
+    g = pt.gather_nd(jnp.arange(9.0).reshape(3, 3), idx)
+    np.testing.assert_allclose(np.asarray(g), [1.0, 8.0])
