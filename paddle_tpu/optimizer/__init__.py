@@ -1,0 +1,453 @@
+"""Optimizers (ref: ``python/paddle/optimizer/``).
+
+Design: functional, optax-style. An optimizer owns no parameters; its state
+is a pytree mirroring the param tree, so the whole (params, opt_state) pair
+shards with the same PartitionSpecs — this is what makes ZeRO/GroupSharded
+(paddle_tpu.distributed.sharded) fall out for free on the fsdp mesh axis.
+
+Reference parity features kept:
+  * ``multi_precision`` — fp32 master weights while params are bf16
+    (ref: paddle.optimizer.AdamW(multi_precision=True))
+  * ``grad_clip`` — ClipGradByValue / ByNorm / ByGlobalNorm objects
+  * LRScheduler objects with ``step()``/``get_lr()``
+  * param update API: ``opt.step(params, grads)`` returns new params
+    (no in-place mutation under XLA; ``minimize`` drives value_and_grad).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module, partition_trainable, value_and_grad
+from paddle_tpu.optimizer.lr import (  # noqa: F401
+    CosineAnnealingDecay,
+    CyclicLR,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LambdaDecay,
+    LinearWarmup,
+    LRScheduler,
+    MultiStepDecay,
+    NaturalExpDecay,
+    NoamDecay,
+    OneCycleLR,
+    PiecewiseDecay,
+    PolynomialDecay,
+    ReduceOnPlateau,
+    StepDecay,
+)
+
+_FLOAT_TYPES = (jnp.float32, jnp.float16, jnp.bfloat16)
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(
+        f, *trees, is_leaf=lambda x: x is None)
+
+
+def _map_params(f, params, *rest):
+    """Map over float param leaves, passing through None / int leaves."""
+    def g(p, *r):
+        if p is None or not hasattr(p, "dtype") or not jnp.issubdtype(p.dtype, jnp.floating):
+            return p
+        return f(p, *r)
+    return _tree_map(g, params, *rest)
+
+
+
+def _pluck(pairs, i):
+    """Extract element i from tuple-leaves produced by a multi-output update."""
+    return jax.tree_util.tree_map(
+        lambda x: x[i] if isinstance(x, tuple) else x, pairs,
+        is_leaf=lambda x: x is None or isinstance(x, tuple))
+
+# -- grad clipping (ref python/paddle/nn/clip.py) ---------------------------
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return _map_params(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm:
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+        return _map_params(clip, grads)
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = [g for g in jax.tree_util.tree_leaves(grads)
+                  if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)]
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return _map_params(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def global_norm(grads):
+    leaves = [g for g in jax.tree_util.tree_leaves(grads)
+              if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)]
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+# -- base -------------------------------------------------------------------
+
+class Optimizer:
+    """State layout: dict of pytrees, each mirroring the param tree."""
+
+    def __init__(self, learning_rate=0.001, grad_clip=None, weight_decay=0.0,
+                 multi_precision=False, apply_decay_param_fun=None):
+        self.learning_rate = learning_rate
+        self.grad_clip = grad_clip
+        self.weight_decay = weight_decay
+        self.multi_precision = multi_precision
+        # ref: AdamW(apply_decay_param_fun=...) — name-based decay masking
+        self.apply_decay_param_fun = apply_decay_param_fun
+
+    # -- state --------------------------------------------------------------
+    def init(self, params) -> dict:
+        state = {"step": jnp.zeros((), jnp.int32)}
+        if self.multi_precision:
+            state["master"] = _map_params(lambda p: p.astype(jnp.float32), params)
+        state.update(self._init_slots(params))
+        return state
+
+    def _init_slots(self, params) -> dict:
+        return {}
+
+    # -- lr -----------------------------------------------------------------
+    def _lr(self, state):
+        lr = self.learning_rate
+        if isinstance(lr, LRScheduler):
+            return lr.value_at(state["step"])
+        return jnp.asarray(lr, jnp.float32)
+
+    def get_lr(self, state=None):
+        if isinstance(self.learning_rate, LRScheduler):
+            if state is not None:
+                return float(self.learning_rate.value_at(state["step"]))
+            return self.learning_rate.get_lr()
+        return self.learning_rate
+
+    # -- update -------------------------------------------------------------
+    def step(self, params, grads, state):
+        """Returns (new_params, new_state). Pure — safe under jit/donation."""
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        lr = self._lr(state)
+        compute = state.get("master", params) if self.multi_precision else params
+        new_compute, new_state = self._update(compute, grads, state, lr)
+        new_state["step"] = state["step"] + 1
+        if self.multi_precision:
+            new_state["master"] = new_compute
+            new_params = _tree_map(
+                lambda p, m: m.astype(p.dtype) if m is not None and hasattr(p, "dtype") else p,
+                params, new_compute)
+        else:
+            new_params = new_compute
+        return new_params, new_state
+
+    def _update(self, params, grads, state, lr):
+        raise NotImplementedError
+
+    # -- convenience: stateful eager API (reference ergonomics) -------------
+    def minimize(self, loss_fn, module: Module, *args):
+        if not hasattr(self, "_eager_state"):
+            self._eager_state = self.init(module)
+        loss, grads = value_and_grad(loss_fn)(module, *args)
+        new_mod, self._eager_state = self.step(module, grads, self._eager_state)
+        return loss, new_mod
+
+    def _decay_mask(self, params):
+        """weight-decay mask honouring apply_decay_param_fun (by param path)."""
+        if self.apply_decay_param_fun is None:
+            return None
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            params, is_leaf=lambda x: x is None)
+        from paddle_tpu.core.module import _path_to_str
+        mask = [self.apply_decay_param_fun(_path_to_str(p)) for p, _ in flat]
+        return jax.tree_util.tree_unflatten(treedef, mask)
+
+
+# -- SGD / Momentum (ref sgd.py, momentum.py) -------------------------------
+
+class SGD(Optimizer):
+    def _update(self, params, grads, state, lr):
+        def upd(p, g):
+            u = g.astype(p.dtype)
+            if self.weight_decay:
+                u = u + self.weight_decay * p
+            return (p - lr * u).astype(p.dtype)
+        return _map_params(upd, params, grads), dict(state)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _init_slots(self, params):
+        return {"velocity": _map_params(jnp.zeros_like, params)}
+
+    def _update(self, params, grads, state, lr):
+        mu = self.momentum
+
+        def upd(p, g, v):
+            g = g.astype(p.dtype)
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            v_new = mu * v + g
+            if self.use_nesterov:
+                p_new = p - lr * (g + mu * v_new)
+            else:
+                p_new = p - lr * v_new
+            return p_new.astype(p.dtype), v_new
+
+        pairs = _map_params(lambda p, g, v: upd(p, g, v), params, grads, state["velocity"])
+        return _pluck(pairs, 0), {**state, "velocity": _pluck(pairs, 1)}
+
+
+# -- Adagrad / RMSProp / Adadelta -------------------------------------------
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.epsilon = epsilon
+        self.init_acc = initial_accumulator_value
+
+    def _init_slots(self, params):
+        return {"moment": _map_params(
+            lambda p: jnp.full_like(p, self.init_acc, dtype=jnp.float32), params)}
+
+    def _update(self, params, grads, state, lr):
+        def upd(p, g, m):
+            g32 = g.astype(jnp.float32)
+            m_new = m + g32 * g32
+            p_new = p - lr * g32 / (jnp.sqrt(m_new) + self.epsilon)
+            return p_new.astype(p.dtype), m_new
+
+        pairs = _map_params(upd, params, grads, state["moment"])
+        return _pluck(pairs, 0), {**state, "moment": _pluck(pairs, 1)}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon, self.momentum, self.centered = rho, epsilon, momentum, centered
+
+    def _init_slots(self, params):
+        slots = {"mean_square": _map_params(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+                 "velocity": _map_params(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)}
+        if self.centered:
+            slots["mean_grad"] = _map_params(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return slots
+
+    def _update(self, params, grads, state, lr):
+        rho, eps, mu = self.rho, self.epsilon, self.momentum
+
+        def upd(p, g, ms, v, mg=None):
+            g32 = g.astype(jnp.float32)
+            if self.weight_decay:
+                g32 = g32 + self.weight_decay * p.astype(jnp.float32)
+            ms_new = rho * ms + (1 - rho) * g32 * g32
+            if self.centered:
+                mg_new = rho * mg + (1 - rho) * g32
+                denom = jnp.sqrt(ms_new - mg_new * mg_new + eps)
+            else:
+                mg_new = None
+                denom = jnp.sqrt(ms_new + eps)
+            v_new = mu * v + lr * g32 / denom
+            return (p - v_new).astype(p.dtype), ms_new, v_new, mg_new
+
+        if self.centered:
+            pairs = _map_params(upd, params, grads, state["mean_square"],
+                                state["velocity"], state["mean_grad"])
+        else:
+            pairs = _map_params(upd, params, grads, state["mean_square"], state["velocity"])
+        get = lambda i: _pluck(pairs, i)
+        new_state = {**state, "mean_square": get(1), "velocity": get(2)}
+        if self.centered:
+            new_state["mean_grad"] = get(3)
+        return get(0), new_state
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {"avg_sq_grad": _map_params(z, params), "avg_sq_update": _map_params(z, params)}
+
+    def _update(self, params, grads, state, lr):
+        rho, eps = self.rho, self.epsilon
+
+        def upd(p, g, asg, asu):
+            g32 = g.astype(jnp.float32)
+            asg_new = rho * asg + (1 - rho) * g32 * g32
+            update = g32 * jnp.sqrt(asu + eps) / jnp.sqrt(asg_new + eps)
+            asu_new = rho * asu + (1 - rho) * update * update
+            return (p - lr * update).astype(p.dtype), asg_new, asu_new
+
+        pairs = _map_params(upd, params, grads, state["avg_sq_grad"], state["avg_sq_update"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "avg_sq_grad": get(1), "avg_sq_update": get(2)}
+
+
+# -- Adam family (ref adam.py / adamw.py / adamax.py / lamb.py) -------------
+
+class Adam(Optimizer):
+    decoupled_wd = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment1": _map_params(z, params), "moment2": _map_params(z, params)}
+
+    def _update(self, params, grads, state, lr):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = state["step"].astype(jnp.float32) + 1.0
+        bc1 = 1.0 - b1 ** t
+        bc2 = 1.0 - b2 ** t
+        mask = self._decay_mask(params)
+
+        def upd(p, g, m, v, do_decay=True):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay and not self.decoupled_wd:
+                g32 = g32 + self.weight_decay * p32
+            m_new = b1 * m + (1 - b1) * g32
+            v_new = b2 * v + (1 - b2) * g32 * g32
+            update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if self.decoupled_wd and self.weight_decay and do_decay:
+                update = update + self.weight_decay * p32
+            return (p32 - lr * update).astype(p.dtype), m_new, v_new
+
+        if mask is None:
+            pairs = _map_params(upd, params, grads, state["moment1"], state["moment2"])
+        else:
+            pairs = _map_params(lambda p, g, m, v, dm: upd(p, g, m, v, dm),
+                                params, grads, state["moment1"], state["moment2"], mask)
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "moment1": get(1), "moment2": get(2)}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref adamw.py). Default wd 0.01."""
+    decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 weight_decay=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon,
+                         weight_decay=weight_decay, **kw)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment": _map_params(z, params), "inf_norm": _map_params(z, params)}
+
+    def _update(self, params, grads, state, lr):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = state["step"].astype(jnp.float32) + 1.0
+        bc1 = 1.0 - b1 ** t
+
+        def upd(p, g, m, u):
+            g32 = g.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g32
+            u_new = jnp.maximum(b2 * u, jnp.abs(g32))
+            p_new = p.astype(jnp.float32) - lr / bc1 * m_new / (u_new + eps)
+            return p_new.astype(p.dtype), m_new, u_new
+
+        pairs = _map_params(upd, params, grads, state["moment"], state["inf_norm"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "moment": get(1), "inf_norm": get(2)}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments for large-batch training (ref lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lamb_weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lamb_weight_decay = lamb_weight_decay
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment1": _map_params(z, params), "moment2": _map_params(z, params)}
+
+    def _update(self, params, grads, state, lr):
+        b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.lamb_weight_decay
+        t = state["step"].astype(jnp.float32) + 1.0
+        bc1, bc2 = 1.0 - b1 ** t, 1.0 - b2 ** t
+
+        def upd(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g32
+            v_new = b2 * v + (1 - b2) * g32 * g32
+            r = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * p32
+            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            r_norm = jnp.sqrt(jnp.sum(r * r))
+            trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            return (p32 - lr * trust * r).astype(p.dtype), m_new, v_new
+
+        pairs = _map_params(upd, params, grads, state["moment1"], state["moment2"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "moment1": get(1), "moment2": get(2)}
+
+
+class Lion(Optimizer):
+    """Sign-momentum optimizer (ref paddle.incubate.optimizer). Half the
+    optimizer memory of Adam — attractive on HBM-limited TPU training."""
+
+    def __init__(self, learning_rate=1e-4, beta1=0.9, beta2=0.99, weight_decay=0.0, **kw):
+        super().__init__(learning_rate, weight_decay=weight_decay, **kw)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def _init_slots(self, params):
+        return {"moment": _map_params(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)}
+
+    def _update(self, params, grads, state, lr):
+        b1, b2 = self.beta1, self.beta2
+
+        def upd(p, g, m):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            update = jnp.sign(b1 * m + (1 - b1) * g32)
+            if self.weight_decay:
+                update = update + self.weight_decay * p32
+            m_new = b2 * m + (1 - b2) * g32
+            return (p32 - lr * update).astype(p.dtype), m_new
+
+        pairs = _map_params(upd, params, grads, state["moment"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "moment": get(1)}

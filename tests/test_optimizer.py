@@ -1,0 +1,198 @@
+"""Optimizer semantics vs torch reference steps (SURVEY.md §4;
+ref test/legacy_test/test_adamw_op.py etc.)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _quadratic_setup():
+    m = nn.Linear(4, 4, bias_attr=True)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    y = jnp.asarray(np.random.RandomState(1).randn(8, 4).astype(np.float32))
+
+    def loss_fn(mod, x, y):
+        return jnp.mean((mod(x) - y) ** 2)
+
+    return m, x, y, loss_fn
+
+
+def _run_steps(optimizer, n=20):
+    m, x, y, loss_fn = _quadratic_setup()
+    state = optimizer.init(m)
+    losses = []
+    for _ in range(n):
+        loss, grads = pt.value_and_grad(loss_fn)(m, x, y)
+        m, state = optimizer.step(m, grads, state)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("optimizer,factor", [
+    (opt.SGD(learning_rate=0.1), 0.7),
+    (opt.Momentum(learning_rate=0.05, momentum=0.9), 0.7),
+    (opt.Adam(learning_rate=0.05), 0.7),
+    (opt.AdamW(learning_rate=0.05, weight_decay=0.01), 0.7),
+    (opt.Adagrad(learning_rate=0.3), 0.7),
+    (opt.RMSProp(learning_rate=0.01), 0.7),
+    (opt.Adadelta(learning_rate=1.0, rho=0.9), 0.95),  # slow starter by design
+    (opt.Adamax(learning_rate=0.05), 0.7),
+    (opt.Lamb(learning_rate=0.05), 0.7),
+    (opt.Lion(learning_rate=0.01), 0.7),
+], ids=lambda o: type(o).__name__ if isinstance(o, opt.Optimizer) else "")
+def test_loss_decreases(optimizer, factor):
+    losses = _run_steps(optimizer)
+    assert losses[-1] < losses[0] * factor, losses
+
+
+def _torch_compare(make_jax_opt, make_torch_opt, n=5, rtol=1e-4):
+    import torch
+    w0 = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    g_seq = [np.random.RandomState(i + 1).randn(4, 3).astype(np.float32) for i in range(n)]
+
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = make_torch_opt([tw])
+    for g in g_seq:
+        tw.grad = torch.tensor(g)
+        topt.step()
+
+    jw = {"w": jnp.asarray(w0)}
+    jopt = make_jax_opt()
+    state = jopt.init(jw)
+    for g in g_seq:
+        jw, state = jopt.step(jw, {"w": jnp.asarray(g)}, state)
+    np.testing.assert_allclose(np.asarray(jw["w"]), tw.detach().numpy(), rtol=rtol, atol=1e-5)
+
+
+def test_sgd_matches_torch():
+    import torch
+    _torch_compare(lambda: opt.SGD(0.1), lambda p: torch.optim.SGD(p, lr=0.1))
+
+
+def test_adam_matches_torch():
+    import torch
+    _torch_compare(lambda: opt.Adam(0.01),
+                   lambda p: torch.optim.Adam(p, lr=0.01))
+
+
+def test_adamw_matches_torch():
+    import torch
+    _torch_compare(lambda: opt.AdamW(0.01, weight_decay=0.1),
+                   lambda p: torch.optim.AdamW(p, lr=0.01, weight_decay=0.1))
+
+
+def test_momentum_matches_torch():
+    import torch
+    _torch_compare(lambda: opt.Momentum(0.1, momentum=0.9),
+                   lambda p: torch.optim.SGD(p, lr=0.1, momentum=0.9))
+
+
+def test_adamax_matches_torch():
+    import torch
+    _torch_compare(lambda: opt.Adamax(0.01),
+                   lambda p: torch.optim.Adamax(p, lr=0.01))
+
+
+def test_multi_precision_master_weights():
+    m = nn.Linear(4, 4, dtype=jnp.bfloat16)
+    x = jnp.ones((2, 4), jnp.bfloat16)
+
+    def loss_fn(mod, x):
+        return jnp.mean(mod(x).astype(jnp.float32) ** 2)
+
+    o = opt.AdamW(learning_rate=1e-3, multi_precision=True)
+    state = o.init(m)
+    masters = [l for l in jax.tree_util.tree_leaves(state["master"]) if l is not None]
+    assert all(l.dtype == jnp.float32 for l in masters)
+    loss, grads = pt.value_and_grad(loss_fn)(m, x)
+    m2, state = o.step(m, grads, state)
+    assert m2.weight.dtype == jnp.bfloat16
+
+
+def test_grad_clip_global_norm():
+    grads = {"a": jnp.full((10,), 10.0), "b": jnp.full((10,), 10.0)}
+    clipped = opt.ClipGradByGlobalNorm(1.0)(grads)
+    n = float(opt.global_norm(clipped))
+    np.testing.assert_allclose(n, 1.0, rtol=1e-5)
+    # under the clip threshold -> unchanged
+    small = {"a": jnp.full((2,), 0.01)}
+    out = opt.ClipGradByGlobalNorm(1.0)(small)
+    np.testing.assert_allclose(np.asarray(out["a"]), 0.01, rtol=1e-6)
+
+
+def test_grad_clip_value_and_norm():
+    g = {"a": jnp.array([5.0, -5.0, 0.5])}
+    out = opt.ClipGradByValue(1.0)(g)
+    np.testing.assert_allclose(np.asarray(out["a"]), [1.0, -1.0, 0.5])
+    out2 = opt.ClipGradByNorm(1.0)(g)
+    np.testing.assert_allclose(float(jnp.linalg.norm(out2["a"])), 1.0, rtol=1e-5)
+
+
+def test_apply_decay_param_fun():
+    m = nn.Linear(4, 4)
+    o = opt.AdamW(0.1, weight_decay=0.5,
+                  apply_decay_param_fun=lambda name: "bias" not in name)
+    state = o.init(m)
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros_like(p) if p is not None else None,
+        pt.partition_trainable(m)[0], is_leaf=lambda x: x is None)
+    # step with zero grads: only decayed params change
+    m2, _ = o.step(m, pt.combine(zero_grads, pt.partition_trainable(m)[1]), state)
+    assert not np.allclose(np.asarray(m2.weight), np.asarray(m.weight))
+    np.testing.assert_allclose(np.asarray(m2.bias), np.asarray(m.bias))
+
+
+def test_schedulers_pure_values():
+    s = opt.NoamDecay(d_model=512, warmup_steps=100)
+    v1 = float(s.value_at(jnp.asarray(50)))
+    v2 = float(s.value_at(jnp.asarray(100)))
+    v3 = float(s.value_at(jnp.asarray(10000)))
+    assert v1 < v2 and v3 < v2
+    c = opt.CosineAnnealingDecay(1.0, T_max=100)
+    np.testing.assert_allclose(float(c.value_at(jnp.asarray(0))), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(c.value_at(jnp.asarray(100))), 0.0, atol=1e-6)
+    w = opt.LinearWarmup(opt.CosineAnnealingDecay(1.0, 100), warmup_steps=10, start_lr=0.0)
+    assert float(w.value_at(jnp.asarray(0))) == 0.0
+    np.testing.assert_allclose(float(w.value_at(jnp.asarray(10))), 1.0, rtol=1e-5)
+    p = opt.PiecewiseDecay([3, 6], [1.0, 0.5, 0.1])
+    assert float(p.value_at(jnp.asarray(0))) == 1.0
+    assert float(p.value_at(jnp.asarray(4))) == 0.5
+    assert float(p.value_at(jnp.asarray(9))) == pytest.approx(0.1)
+
+
+def test_scheduler_stateful_api():
+    s = opt.StepDecay(1.0, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s.get_lr())
+        s.step()
+    np.testing.assert_allclose(lrs, [1.0, 1.0, 0.5, 0.5, 0.25], rtol=1e-6)
+
+
+def test_scheduler_in_jit_train_step():
+    m, x, y, loss_fn = _quadratic_setup()
+    sched = opt.LinearWarmup(0.1, warmup_steps=5)
+    o = opt.Adam(learning_rate=sched)
+    state = o.init(m)
+
+    @pt.jit
+    def step(mod, st, x, y):
+        loss, grads = pt.value_and_grad(loss_fn)(mod, x, y)
+        mod, st = o.step(mod, grads, st)
+        return mod, st, loss
+
+    for _ in range(8):
+        m, state, loss = step(m, state, x, y)
+    assert int(state["step"]) == 8
+
+
+def test_reduce_on_plateau():
+    s = opt.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    s.step(1.0)
+    s.step(1.0)
+    s.step(1.0)  # no improvement for > patience steps -> halve
+    assert s.get_lr() == 0.5
