@@ -1,0 +1,105 @@
+"""Headline bench: LLaMA-architecture causal-LM training step, single chip.
+
+Metric matches BASELINE.json ("tokens/sec/chip + MFU at LLaMA"): we time the
+fused train step (fwd+bwd+AdamW, bf16 params, fp32 master weights, remat)
+and report MFU against the chip's peak bf16 FLOPs. vs_baseline is MFU/0.50 —
+the reference's own A100 LLaMA MFU ballpark from BASELINE.json.
+
+Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK_BF16 = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,
+}
+
+
+def chip_peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "")
+    for k, v in PEAK_BF16.items():
+        if kind.startswith(k) or k in kind:
+            return v
+    return 197e12  # assume v5e-class
+
+
+def main():
+    import paddle_tpu as pt
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, num_flops_per_token
+    from paddle_tpu.train import make_train_step
+    from paddle_tpu.train.step import TrainState, init_state
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                          num_hidden_layers=12, num_attention_heads=16,
+                          num_key_value_heads=16, max_position_embeddings=2048,
+                          dtype=jnp.bfloat16, remat=True)
+        batch, seq, iters = 4, 2048, 20
+    else:  # CPU smoke: same code path, tiny shapes
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters = 2, 64, 3
+
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-4, weight_decay=0.1,
+                          grad_clip=opt.ClipGradByGlobalNorm(1.0),
+                          multi_precision=on_tpu)
+    state = init_state(model, optimizer)
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.concatenate([ids[:, 1:], -100 * jnp.ones((batch, 1), ids.dtype)], axis=1)
+
+    def loss_fn(m, ids, labels):
+        return m.loss(ids, labels)
+
+    step = make_train_step(loss_fn, optimizer)
+
+    # warmup / compile
+    state, loss = step(state, ids, labels)
+    jax.block_until_ready(loss)
+    state, loss = step(state, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = batch * seq / dt
+    flops_per_token = num_flops_per_token(cfg, seq)
+    achieved = tokens_per_sec * flops_per_token
+    peak = chip_peak_flops(jax.devices()[0]) if on_tpu else 0.0
+    mfu = achieved / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "llama-0.8b bf16 train step tokens/sec/chip (MFU in extra)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.50, 3) if peak else 0.0,
+        "extra": {
+            "mfu": round(mfu, 4),
+            "step_ms": round(dt * 1e3, 2),
+            "params": model.num_parameters(),
+            "batch": batch, "seq": seq,
+            "loss": float(loss),
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

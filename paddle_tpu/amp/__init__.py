@@ -1,0 +1,142 @@
+"""Mixed precision (ref: ``python/paddle/amp/`` — auto_cast, GradScaler).
+
+TPU-native stance: bf16 is the native MXU input dtype and needs NO loss
+scaling (same exponent range as fp32). So:
+  * O1 ("auto_cast"): cast op inputs to bf16 for allow-listed ops — here a
+    Policy object that casts params/activations at module boundaries.
+  * O2 ("pure"): hold params in bf16, master fp32 weights in the optimizer
+    (``multi_precision=True``) — the reference's O2 + master-grad recipe.
+  * GradScaler: full state machine kept for fp16 parity (scale, growth,
+    inf-skip), a no-op in bf16 mode.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+
+_FP = (jnp.float32, jnp.float16, jnp.bfloat16)
+
+
+class Policy:
+    """Dtype policy: param/compute/output dtypes (jmp-style, reference O-levels)."""
+
+    def __init__(self, param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                 output_dtype=None):
+        self.param_dtype = jnp.dtype(param_dtype)
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self.output_dtype = jnp.dtype(output_dtype) if output_dtype else self.compute_dtype
+
+    def cast_to_compute(self, tree):
+        return _cast_floats(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floats(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floats(tree, self.output_dtype)
+
+
+def O1(dtype=jnp.bfloat16) -> Policy:
+    return Policy(param_dtype=jnp.float32, compute_dtype=dtype)
+
+
+def O2(dtype=jnp.bfloat16) -> Policy:
+    return Policy(param_dtype=dtype, compute_dtype=dtype)
+
+
+def _cast_floats(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree, is_leaf=lambda x: x is None)
+
+
+def decorate(model: Module, level: str = "O1", dtype=jnp.bfloat16) -> Module:
+    """Ref: ``paddle.amp.decorate`` — O2 casts the model's params."""
+    if level == "O2":
+        return _cast_floats(model, dtype)
+    return model
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, level="O1", dtype="bfloat16"):
+    """Reference context-manager API. Under a functional framework the cast
+    happens on values, so this sets the default dtype for the block."""
+    from paddle_tpu.core.dtypes import default_dtype
+    if not enable:
+        yield
+        return
+    with default_dtype(jnp.dtype(dtype) if level == "O2" else jnp.float32):
+        yield
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref: ``python/paddle/amp/grad_scaler.py``).
+
+    Functional: carry ``scaler.init()`` state through the train step.
+    In bf16 (enable=False) every method is the identity.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5,
+                 incr_every_n_steps=2000, decr_every_n_nan_or_inf=1):
+        self.enable = enable
+        self.init_scale = init_loss_scaling
+        self.incr_ratio, self.decr_ratio = incr_ratio, decr_ratio
+        self.incr_every = incr_every_n_steps
+        self.decr_every = decr_every_n_nan_or_inf
+
+    def init(self):
+        return {"scale": jnp.asarray(self.init_scale, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32),
+                "bad_steps": jnp.zeros((), jnp.int32)}
+
+    def scale(self, loss, state):
+        if not self.enable:
+            return loss
+        return loss * state["scale"]
+
+    def unscale(self, grads, state):
+        if not self.enable:
+            return grads
+        inv = 1.0 / state["scale"]
+        return jax.tree_util.tree_map(
+            lambda g: g * inv if g is not None and hasattr(g, "dtype")
+            and jnp.issubdtype(g.dtype, jnp.floating) else g,
+            grads, is_leaf=lambda x: x is None)
+
+    def found_inf(self, grads):
+        leaves = [g for g in jax.tree_util.tree_leaves(grads)
+                  if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)]
+        if not leaves:
+            return jnp.asarray(False)
+        return jnp.logical_not(
+            jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves])))
+
+    def update(self, state, found_inf):
+        """Returns new scaler state (pure, jit-safe)."""
+        if not self.enable:
+            return state
+        good = jnp.where(found_inf, 0, state["good_steps"] + 1)
+        bad = jnp.where(found_inf, state["bad_steps"] + 1, 0)
+        scale = state["scale"]
+        scale = jnp.where(bad >= self.decr_every, scale * self.decr_ratio, scale)
+        bad = jnp.where(bad >= self.decr_every, 0, bad)
+        scale = jnp.where(good >= self.incr_every, scale * self.incr_ratio, scale)
+        good = jnp.where(good >= self.incr_every, 0, good)
+        return {"scale": scale, "good_steps": good, "bad_steps": bad}
+
+    def step_or_skip(self, params, new_params, found_inf):
+        """Skip the update when grads overflowed (ref: scaler.step skips)."""
+        if not self.enable:
+            return new_params
+        return jax.tree_util.tree_map(
+            lambda old, new: jnp.where(found_inf, old, new)
+            if old is not None and hasattr(old, "dtype") else old,
+            params, new_params, is_leaf=lambda x: x is None)

@@ -1,0 +1,51 @@
+from paddle_tpu.distributed import collective
+from paddle_tpu.distributed.collective import (
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    permute,
+    reduce_scatter,
+    shift,
+)
+from paddle_tpu.distributed.mesh import (
+    AXES,
+    HybridMesh,
+    current_mesh,
+    make_mesh,
+    single_device_mesh,
+)
+from paddle_tpu.distributed.sharded import (
+    maybe_shard,
+    opt_state_specs,
+    partition_specs,
+    shard_module,
+    with_sharding_constraint,
+)
+from paddle_tpu.distributed.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    parallel_cross_entropy,
+)
+
+
+def init_parallel_env():
+    """Ref: paddle.distributed.init_parallel_env — multi-host bring-up.
+    Single-process is a no-op; multi-host uses jax.distributed."""
+    import jax
+    if jax.process_count() > 1:
+        return  # already initialised by launcher
+    return
+
+
+def get_world_size():
+    import jax
+    return jax.device_count()
+
+
+def get_rank():
+    import jax
+    return jax.process_index()

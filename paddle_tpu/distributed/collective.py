@@ -1,0 +1,80 @@
+"""Collective ops (ref: ``python/paddle/distributed/communication/`` —
+all_reduce, all_gather, reduce_scatter, alltoall, broadcast, send/recv over
+ProcessGroupNCCL, ``paddle/fluid/distributed/collective/process_group_nccl.cc``).
+
+TPU-native: these are thin wrappers over lax collectives, valid INSIDE
+``shard_map``/``pmap`` where a mesh axis name is bound. Outside shard_map,
+GSPMD inserts collectives automatically from shardings — prefer that; use
+these only where the schedule must be explicit (pipeline, ring attention,
+MoE all-to-all).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ReduceOp parity (ref communication/reduce.py)
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def all_reduce(x, op: str = ReduceOp.SUM, *, axis_name: str):
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis_name)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis_name)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis_name)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, axis_name)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    raise ValueError(op)
+
+
+def all_gather(x, *, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, *, axis_name: str, axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, *, axis_name: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, src: int = 0, *, axis_name: str):
+    """Every member gets member `src`'s value."""
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    sel = jnp.where(jnp.arange(n) == src, 1.0, 0.0).astype(x.dtype)
+    gathered = lax.all_gather(x, axis_name, axis=0)
+    return jnp.tensordot(sel, gathered, axes=([0], [0])).astype(x.dtype)
+
+
+def permute(x, perm: list[tuple[int, int]], *, axis_name: str):
+    """Point-to-point send/recv pattern (ref send/recv): perm = [(src,dst)...]."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def shift(x, offset: int = 1, *, axis_name: str):
+    """Ring shift: member i's value goes to member (i+offset) % n."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def barrier(*, axis_name: str):
+    """Collectives are compiler-ordered on TPU; a psum serves as sync point."""
+    return lax.psum(jnp.zeros((), jnp.float32), axis_name)

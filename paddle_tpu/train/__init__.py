@@ -1,0 +1,1 @@
+from paddle_tpu.train.step import make_train_step, TrainState

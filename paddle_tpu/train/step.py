@@ -1,0 +1,68 @@
+"""Canonical fused training step (ref: the reference's Fleet training loop —
+forward/backward/allreduce/optimizer as separate phases; here ONE jitted,
+donated XLA program: grads, collectives, optimizer update and LR schedule all
+fuse, params stay resident in HBM in their sharded layout).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
+from paddle_tpu.distributed.mesh import HybridMesh
+from paddle_tpu.distributed.sharded import partition_specs, shard_module
+
+
+@jax.tree_util.register_pytree_node_class
+class TrainState:
+    """(model, opt_state, step) bundle that flattens as one pytree."""
+
+    def __init__(self, model, opt_state, rng=None):
+        self.model = model
+        self.opt_state = opt_state
+        self.rng = rng
+
+    def tree_flatten(self):
+        return (self.model, self.opt_state, self.rng), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def step(self):
+        return self.opt_state["step"]
+
+
+def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[HybridMesh] = None,
+                    donate: bool = True, with_rng: bool = False):
+    """loss_fn(model, *batch[, rng]) -> scalar. Returns jitted
+    step(state, *batch) -> (state, loss)."""
+
+    def step(state: TrainState, *batch):
+        if with_rng:
+            rng, sub = jax.random.split(state.rng)
+            loss, grads = value_and_grad(loss_fn)(state.model, *batch, sub)
+        else:
+            rng = state.rng
+            loss, grads = value_and_grad(loss_fn)(state.model, *batch)
+        model, opt_state = optimizer.step(state.model, grads, state.opt_state)
+        return TrainState(model, opt_state, rng), loss
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def init_state(model: Module, optimizer, mesh: Optional[HybridMesh] = None,
+               seed: int = 0) -> TrainState:
+    if mesh is not None:
+        model = shard_module(model, mesh)
+    opt_state = optimizer.init(model)
+    if mesh is not None:
+        # slots inherit param shardings automatically (they are created by
+        # tree_map over sharded params under the mesh context)
+        pass
+    return TrainState(model, opt_state, jax.random.PRNGKey(seed))
