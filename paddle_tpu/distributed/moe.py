@@ -1,0 +1,105 @@
+"""Mixture-of-Experts with expert parallelism (ref: ``python/paddle/
+incubate/distributed/models/moe/`` — MoELayer, gate, dispatcher using
+``c_alltoall`` over the expert-parallel NCCL group).
+
+TPU-native: GShard/Switch dense-dispatch formulation. Tokens are combined
+with a capacity-limited one-hot dispatch tensor via einsum; expert weights
+carry a leading expert axis sharded on the data axes (experts ride the same
+chips as data parallelism, the reference's ``ep on dp`` layout). Under
+GSPMD the dispatch/combine einsums lower to the SAME all_to_all pattern the
+reference hand-codes — but fused and overlapped by XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+
+
+def top_k_gate(logits, k: int, capacity: int, *, jitter_rng=None):
+    """Top-k gating with capacity (ref gate/naive_gate.py + GShard aux loss).
+
+    logits: [T, E]. Returns (dispatch [T, E, C] bool, combine [T, E, C] float,
+    aux_loss scalar).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
+    # renormalise the k gates
+    gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    # GShard position computation: queue slot per token per choice
+    dispatch = jnp.zeros((t, e, capacity), bool)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    offset = jnp.zeros((e,), jnp.int32)  # slots consumed by earlier choices
+    for j in range(k):
+        choice = jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.int32)  # [T,E]
+        pos_in_e = jnp.cumsum(choice, axis=0) - 1 + offset[None, :]
+        within = (pos_in_e < capacity) & (choice > 0)
+        pos = jnp.sum(jnp.where(within, pos_in_e, 0), axis=1)  # [T]
+        oh_pos = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        d_j = within[..., None] & (oh_pos[:, None, :] > 0.5)
+        dispatch = dispatch | d_j
+        combine = combine + d_j.astype(jnp.float32) * gate_vals[:, j][:, None, None]
+        offset = offset + jnp.sum(choice, axis=0)
+
+    # load-balancing aux loss (Switch): E * sum_e (frac_tokens_e * mean_prob_e)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(me * ce)
+    return dispatch, combine, aux
+
+
+class ExpertMLP(Module):
+    """E SwiGLU expert MLPs with a leading expert axis, ep-sharded."""
+
+    def __init__(self, num_experts, hidden, intermediate, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        init = I.Normal(0.0, 0.02)
+        self.gate_up = init((num_experts, hidden, 2 * intermediate), dtype)
+        self.down = init((num_experts, intermediate, hidden), dtype)
+        # experts across the data axes = expert parallelism on (dp, fsdp)
+        self.set_pspec("gate_up", P(("dp", "fsdp"), None, None))
+        self.set_pspec("down", P(("dp", "fsdp"), None, None))
+
+    def __call__(self, x_e):
+        """x_e: [E, C, H] — per-expert token slots."""
+        gu = jnp.einsum("ech,ehm->ecm", x_e, self.gate_up)
+        gate, up = jnp.split(gu, 2, axis=-1)
+        act = jax.nn.silu(gate) * up
+        return jnp.einsum("ecm,emh->ech", act, self.down)
+
+
+class MoELayer(Module):
+    """Drop-in MLP replacement (ref MoELayer). combine/dispatch einsums are
+    the all_to_all; aux loss is returned for the trainer to add."""
+
+    def __init__(self, hidden, intermediate, num_experts, k=2,
+                 capacity_factor=1.25, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.gate_w = I.Normal(0.0, 0.02)((hidden, num_experts), jnp.float32)
+        self.experts = ExpertMLP(num_experts, hidden, intermediate, dtype)
+        self.num_experts, self.k, self.capacity_factor = num_experts, k, capacity_factor
+
+    def __call__(self, x, return_aux=True):
+        b, s, h = x.shape
+        t = b * s
+        e = self.num_experts
+        cap = int(self.capacity_factor * self.k * t / e + 0.999)
+        cap = max(cap, 4)
+        xt = x.reshape(t, h)
+        logits = xt.astype(jnp.float32) @ self.gate_w
+        dispatch, combine, aux = top_k_gate(logits, self.k, cap)
+        # dispatch: [T,E,C] — route tokens to expert slots (≙ all_to_all)
+        x_e = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+        y_e = self.experts(x_e)
+        # combine back (≙ reverse all_to_all)
+        yt = jnp.einsum("tec,ech->th", combine.astype(x.dtype), y_e)
+        y = yt.reshape(b, s, h)
+        return (y, aux) if return_aux else y

@@ -1,0 +1,151 @@
+"""Pipeline parallelism (ref: ``python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py`` — PipelineLayer + 1F1B scheduler).
+
+The reference runs an imperative per-rank scheduler exchanging activations
+with NCCL send/recv. TPU-native formulation: SPMD over the ``pp`` mesh axis —
+stage weights live stacked on a leading pp dimension sharded P("pp", ...),
+the microbatch loop is a ``lax.scan``, and the stage handoff is a
+``ppermute`` ring. XLA overlaps the permute with the next microbatch's
+compute (fill-drain/GPipe schedule; the backward pass is derived by autodiff
+through the scan+ppermute, which replays the ring in reverse — activations
+are rematerialised per-stage via ``jax.checkpoint`` so pipeline memory
+matches 1F1B's working set rather than storing every microbatch).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.module import Module
+
+
+def stack_layers(layers: list[Module]) -> Module:
+    """Stack N structurally-identical layer pytrees on a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *layers)
+
+
+def unstack_layers(stacked: Module, n: int) -> list[Module]:
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def pipeline_apply(stacked_stage_params, layer_fn: Callable, x_microbatches,
+                   *, axis_name: str = "pp", layers_per_stage: int = 1,
+                   remat: bool = True):
+    """Run microbatches through the pp-stage ring. Call inside shard_map.
+
+    stacked_stage_params: this stage's layers stacked [layers_per_stage, ...]
+      (globally [pp * layers_per_stage, ...] sharded on the leading axis).
+    layer_fn(layer_params, x) -> x: applies ONE layer.
+    x_microbatches: [M, mb, ...] — every stage receives the same microbatch
+      stream; non-first stages ignore it (they consume the ring instead).
+    Returns [M, mb, ...]: last stage's outputs (valid on the last stage;
+      other stages hold garbage — psum/broadcast outside if needed).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m_total = x_microbatches.shape[0]
+    ticks = m_total + n_stages - 1
+
+    def apply_stage(params, x):
+        def body(h, lyr):
+            return layer_fn(lyr, h), None
+        if remat:
+            run = jax.checkpoint(lambda p, v: lax.scan(body, v, p)[0])
+        else:
+            run = lambda p, v: lax.scan(body, v, p)[0]
+        return run(params, x)
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    mb_shape = x_microbatches.shape[1:]
+    out_buf = jnp.zeros((m_total,) + mb_shape, x_microbatches.dtype)
+    ring0 = jnp.zeros(mb_shape, x_microbatches.dtype)
+
+    def tick(carry, t):
+        ring, out_buf = carry
+        # stage 0 feeds microbatch t (clamped); others take the ring value
+        mb_idx = jnp.clip(t, 0, m_total - 1)
+        feed = lax.dynamic_index_in_dim(x_microbatches, mb_idx, 0, keepdims=False)
+        x_in = jnp.where(stage == 0, feed, ring)
+        y = apply_stage(stacked_stage_params, x_in)
+        # last stage: tick t produced microbatch t-(n_stages-1)
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(stage == n_stages - 1,
+                                jnp.logical_and(out_idx >= 0, out_idx < m_total))
+        updated = lax.dynamic_update_index_in_dim(
+            out_buf, y.astype(out_buf.dtype), jnp.clip(out_idx, 0, m_total - 1), 0)
+        out_buf = jnp.where(valid, updated, out_buf)
+        ring_next = lax.ppermute(y, axis_name, fwd_perm)
+        return (ring_next, out_buf), None
+
+    # initial carry must be marked pp-varying (the loop makes it so)
+    try:
+        ring0 = lax.pvary(ring0, (axis_name,))
+        out_buf = lax.pvary(out_buf, (axis_name,))
+    except Exception:
+        pass
+    (_, out_buf), _ = lax.scan(tick, (ring0, out_buf), jnp.arange(ticks))
+    return out_buf
+
+
+class PipelineLayer(Module):
+    """Reference-named wrapper: partitions identical blocks over pp stages.
+
+    Single-program: under a mesh with pp>1 the stacked weights shard
+    P("pp", ...); without a mesh it runs the plain sequential loop.
+    """
+
+    def __init__(self, layers: list[Module], num_stages: int,
+                 num_microbatches: int = 1, remat: bool = True):
+        super().__init__()
+        assert len(layers) % num_stages == 0, "layers must divide stages"
+        self.stacked = stack_layers(layers)
+        self.template = layers[0]
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.layers_per_stage = len(layers) // num_stages
+        self.n_layers = len(layers)
+        self.remat = remat
+        # leading axis is the stage axis
+        flat, _ = jax.tree_util.tree_flatten(self.stacked)
+
+    def stage_specs(self):
+        """PartitionSpecs: leading (layer) axis on pp."""
+        def spec(leaf):
+            return P(*(("pp",) + (None,) * (leaf.ndim - 1)))
+        return jax.tree_util.tree_map(spec, self.stacked)
+
+    def __call__(self, x, layer_call: Callable = None, mesh=None):
+        layer_call = layer_call or (lambda lyr, h: lyr(h))
+        if mesh is None or mesh.pp == 1:
+            def body(h, lyr_params):
+                return layer_call(lyr_params, h), None
+            out, _ = lax.scan(body, x, self.stacked)
+            return out
+        from jax import shard_map
+        mb = self.num_microbatches
+        b = x.shape[0]
+        assert b % mb == 0, "batch must divide microbatches"
+        xm = x.reshape((mb, b // mb) + x.shape[1:])
+
+        pspec = self.stage_specs()
+        data_spec = P(*((None,) * xm.ndim))
+
+        @functools.partial(
+            shard_map, mesh=mesh.mesh,
+            in_specs=(pspec, data_spec), out_specs=data_spec)
+        def run(stage_params, xm):
+            out = pipeline_apply(stage_params, layer_call, xm,
+                                 axis_name="pp",
+                                 layers_per_stage=self.layers_per_stage,
+                                 remat=self.remat)
+            # broadcast last stage's result to all pp members so downstream
+            # (loss) is replicated over pp: zero elsewhere + psum
+            n = lax.axis_size("pp")
+            is_last = (lax.axis_index("pp") == n - 1).astype(out.dtype)
+            return lax.psum(out * is_last, "pp")
+        return run(self.stacked, xm).reshape(x.shape)

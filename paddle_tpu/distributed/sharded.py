@@ -32,7 +32,12 @@ def _pspec_of_leaf(path_str: str, leaf, module: Module, min_size: int,
     spec = list(explicit) if explicit is not None else [None] * leaf.ndim
     while len(spec) < leaf.ndim:
         spec.append(None)
-    if leaf.size >= min_size:
+    used = set()
+    for s in spec:
+        for a in (s if isinstance(s, tuple) else (s,)):
+            if a is not None:
+                used.add(a)
+    if leaf.size >= min_size and "fsdp" not in used:
         # largest unsharded, fsdp-divisible dim
         cand = sorted(range(leaf.ndim), key=lambda i: -leaf.shape[i])
         for i in cand:
