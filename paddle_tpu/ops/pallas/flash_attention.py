@@ -1,0 +1,277 @@
+"""Pallas TPU flash attention (ref capability: ``paddle/phi/kernels/fusion/
+flash_attn`` — the CUDA flash-attention kernel family).
+
+TPU-first design (not a CUDA translation):
+  * grid (batch*heads, q_blocks, kv_blocks) with the kv dimension iterated
+    fastest — the output tile stays resident in VMEM across the kv sweep
+    (Pallas keeps revisited blocks live), so the online-softmax accumulator
+    never round-trips HBM.
+  * fp32 accumulation in VMEM scratch; bf16 inputs feed the MXU directly.
+  * backward = two kernels (dq-major and dkv-major sweeps) from saved
+    (O, logsumexp), the standard flash-2 recomputation strategy.
+  * causal blocks that are fully masked are skipped with @pl.when — the
+    sweep does ~half the FLOPs for causal attention.
+
+Layout: [B, S, H, D] at the API (reference flash_attention convention);
+kernels run on [B*H, S, D].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
+                *, scale, causal, block_q, block_k, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    def compute():
+        q = q_ref[0]  # [Bq, D]
+        k = k_ref[0]  # [Bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+        m_prev = m_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_sc[:, 0] = l_sc[:, 0] * corr + jnp.sum(p, axis=1)
+        m_sc[:, 0] = m_new
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * corr[:, None] + pv
+
+    if causal:
+        # block (i, j) has any unmasked entry iff j*Bk <= i*Bq + Bq - 1
+        pl.when(j * block_k <= i * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[:, 0], 1e-30)
+        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_sc[:, 0] + jnp.log(l)).astype(lse_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
+    grid = (bh, nq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, nk=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+               *, scale, causal, block_q, block_k, nk):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(j * block_k <= i * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_acc, dv_acc, *, scale, causal, block_q, block_k, nq):
+    j, i = pl.program_id(1), pl.program_id(2)  # kv-major: q iterated fastest
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # [Bq, Bk]
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale  # [Bq, Bk]
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(j * block_k <= i * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [BH,S]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool | None = None):
+    """q,k,v: [B, S, H, D] (reference flash_attention layout). Same-heads only
+    (GQA callers repeat KV first)."""
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else d ** -0.5
+    bq = min(block_q, s)
+    bk = min(block_k, sk)
+
+    def to_bh(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, bq, bk, interpret)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

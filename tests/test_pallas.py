@@ -1,0 +1,67 @@
+"""Pallas kernels vs XLA reference, interpret mode on CPU (SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.attention import apply_rope, rope_cos_sin, xla_attention
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+from paddle_tpu.ops.pallas.norms import rms_norm
+from paddle_tpu.ops.pallas.rope import fused_rope
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq", [128, 256])
+def test_flash_fwd_matches_xla(causal, seq):
+    rs = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rs.randn(2, seq, 2, 64).astype(np.float32)) for _ in range(3))
+    ref = xla_attention(q, k, v, is_causal=causal)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_matches_xla(causal):
+    rs = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rs.randn(1, 128, 2, 32).astype(np.float32)) for _ in range(3))
+    ref = jax.grad(lambda *a: jnp.sum(xla_attention(*a, is_causal=causal) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal=causal, interpret=True) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bf16():
+    rs = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rs.randn(1, 128, 2, 64)).astype(jnp.bfloat16) for _ in range(3))
+    ref = xla_attention(q, k, v, is_causal=True)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(ref, dtype=np.float32), rtol=2e-2, atol=2e-2)
+
+
+def test_rms_norm_kernel():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 8, 256).astype(np.float32))
+    w = jnp.asarray(rs.rand(256).astype(np.float32) + 0.5)
+    ref = x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
+    got = rms_norm(x, w, 1e-6, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    # grads
+    rg = jax.grad(lambda x, w: jnp.sum(
+        (x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w) ** 2),
+        argnums=(0, 1))(x, w)
+    gg = jax.grad(lambda x, w: jnp.sum(rms_norm(x, w, 1e-6, True) ** 2),
+                  argnums=(0, 1))(x, w)
+    for r, g in zip(rg, gg):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4, atol=1e-4)
+
+
+def test_fused_rope_matches_reference():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 16, 4, 64).astype(np.float32))
+    cos, sin = rope_cos_sin(16, 64)
+    ref = apply_rope(x, cos, sin)
+    got = fused_rope(x, cos, sin, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
