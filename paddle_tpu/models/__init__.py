@@ -1,0 +1,17 @@
+from paddle_tpu.models.bert import (
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+)
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, LlamaModel
+from paddle_tpu.models.moe_llm import MoEConfig, MoEForCausalLM
+from paddle_tpu.models.resnet import (
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)

@@ -1,0 +1,121 @@
+"""GPT-3 style decoder (ref: PaddleNLP ``paddlenlp/transformers/gpt/
+modeling.py`` + the reference's ``llm/gpt-3`` Fleet TensorParallel config).
+
+Pre-LN GPT with learned positions, GELU MLP, fused-attention dispatch; qkv
+and mlp projections carry tp PartitionSpecs like LLaMA so the GPT-3 1.3B
+TensorParallel baseline config maps straight onto the mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 8192
+    max_position_embeddings: int = 2048
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = jnp.bfloat16
+    remat: bool = True
+
+    @staticmethod
+    def gpt3_1p3b(**kw):
+        return GPTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        return GPTConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                   num_hidden_layers=2, num_attention_heads=2,
+                                   intermediate_size=64, max_position_embeddings=64,
+                                   dtype=jnp.float32, remat=False), **kw})
+
+
+class GPTBlock(Module):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.ln1 = LayerNorm(h, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+        self.qkv = init((h, 3 * h), cfg.dtype)
+        self.qkv_bias = jnp.zeros((3 * h,), cfg.dtype)
+        self.proj = init((h, h), cfg.dtype)
+        self.proj_bias = jnp.zeros((h,), cfg.dtype)
+        self.ln2 = LayerNorm(h, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+        self.fc1 = init((h, cfg.intermediate_size), cfg.dtype)
+        self.fc1_bias = jnp.zeros((cfg.intermediate_size,), cfg.dtype)
+        self.fc2 = init((cfg.intermediate_size, h), cfg.dtype)
+        self.fc2_bias = jnp.zeros((h,), cfg.dtype)
+        self.set_pspec("qkv", P(None, "tp"))
+        self.set_pspec("qkv_bias", P("tp"))
+        self.set_pspec("proj", P("tp", None))
+        self.set_pspec("fc1", P(None, "tp"))
+        self.set_pspec("fc1_bias", P("tp"))
+        self.set_pspec("fc2", P("tp", None))
+        self.num_heads = cfg.num_attention_heads
+        self.dropout = Dropout(cfg.dropout)
+
+    def __call__(self, x, rng=None):
+        b, s, h = x.shape
+        nh = self.num_heads
+        d = h // nh
+        r1, r2 = (None, None) if rng is None else tuple(jax.random.split(rng))
+        y = self.ln1(x)
+        qkv = y @ self.qkv + self.qkv_bias
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, nh, d)
+        k = k.reshape(b, s, nh, d)
+        v = v.reshape(b, s, nh, d)
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              training=self.training, rng=r1)
+        x = x + self.dropout(attn.reshape(b, s, h) @ self.proj + self.proj_bias, rng=r1)
+        y = self.ln2(x)
+        y = F.gelu(y @ self.fc1 + self.fc1_bias, approximate=True) @ self.fc2 + self.fc2_bias
+        return x + self.dropout(y, rng=r2)
+
+
+class GPTForCausalLM(Module):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.wte = init((cfg.vocab_size, cfg.hidden_size), cfg.dtype)
+        self.wpe = init((cfg.max_position_embeddings, cfg.hidden_size), cfg.dtype)
+        self.set_pspec("wte", P("tp", None))
+        self.blocks = [GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)]
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+
+    def __call__(self, input_ids, rng=None):
+        s = input_ids.shape[1]
+        from paddle_tpu.distributed.sharded import maybe_shard
+        x = jnp.take(self.wte, input_ids, axis=0) + self.wpe[None, :s]
+        x = maybe_shard(x, ("dp", "fsdp"), "sp", None)
+        blk_fn = (jax.checkpoint(lambda blk, h, r: blk(h, rng=r))
+                  if self.cfg.remat else (lambda blk, h, r: blk(h, rng=r)))
+        for i, blk in enumerate(self.blocks):
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = blk_fn(blk, x, sub)
+        x = self.ln_f(x)
+        return x @ self.wte.T  # tied lm head
+
+    def loss(self, input_ids, labels, rng=None):
+        from paddle_tpu.distributed.tensor_parallel import parallel_cross_entropy
+        logits = self(input_ids, rng=rng)
+        per_tok = parallel_cross_entropy(logits, jnp.maximum(labels, 0))
+        mask = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,101 @@
+"""MoE decoder LM — the ERNIE-MoE-class expert-parallel model family
+(ref: the reference's ERNIE-MoE baseline config exercising ``c_alltoall``;
+``paddle/incubate/distributed/models/moe``).
+
+A LLaMA-style decoder whose MLP is a top-2 MoELayer every `moe_every` layers;
+experts ride the (dp, fsdp) axes (expert parallel), attention stays tp-sharded.
+The gate aux loss is summed into the LM loss.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.distributed.moe import MoELayer
+from paddle_tpu.models.llama import (
+    LlamaAttention,
+    LlamaConfig,
+    LlamaMLP,
+    LlamaRMSNorm,
+)
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops import attention as A
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass
+class MoEConfig:
+    base: LlamaConfig = None
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2          # every k-th layer is MoE
+    aux_loss_weight: float = 0.01
+
+    @staticmethod
+    def tiny(**kw):
+        return MoEConfig(base=LlamaConfig.tiny(), **kw)
+
+
+class MoEDecoderLayer(Module):
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        b = cfg.base
+        self.input_layernorm = LlamaRMSNorm(b.hidden_size, b.rms_norm_eps, b.dtype)
+        self.self_attn = LlamaAttention(b)
+        self.post_attention_layernorm = LlamaRMSNorm(b.hidden_size, b.rms_norm_eps, b.dtype)
+        self.moe = MoELayer(b.hidden_size, b.intermediate_size, cfg.num_experts,
+                            k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+                            dtype=b.dtype)
+
+    def __call__(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        y, aux = self.moe(self.post_attention_layernorm(x))
+        return x + y, aux
+
+
+class MoEForCausalLM(Module):
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        self.cfg = cfg
+        b = cfg.base
+        init = I.Normal(0.0, b.initializer_range)
+        self.embed_tokens = init((b.vocab_size, b.hidden_size), b.dtype)
+        self.set_pspec("embed_tokens", P("tp", None))
+        self.layers = []
+        from paddle_tpu.models.llama import LlamaDecoderLayer
+        for i in range(b.num_hidden_layers):
+            if (i + 1) % cfg.moe_every == 0:
+                self.layers.append(MoEDecoderLayer(cfg))
+            else:
+                self.layers.append(LlamaDecoderLayer(b))
+        self.norm = LlamaRMSNorm(b.hidden_size, b.rms_norm_eps, b.dtype)
+        self.lm_head = init((b.hidden_size, b.vocab_size), b.dtype)
+        self.set_pspec("lm_head", P(None, "tp"))
+
+    def __call__(self, input_ids):
+        b_cfg = self.cfg.base
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        cos, sin = A.rope_cos_sin(input_ids.shape[1],
+                                  b_cfg.hidden_size // b_cfg.num_attention_heads,
+                                  base=b_cfg.rope_theta)
+        aux_total = jnp.zeros((), jnp.float32)
+        for lyr in self.layers:
+            if isinstance(lyr, MoEDecoderLayer):
+                x, aux = lyr(x, cos, sin)
+                aux_total = aux_total + aux
+            else:
+                x = lyr(x, cos, sin)
+        x = self.norm(x)
+        return x @ self.lm_head, aux_total
+
+    def loss(self, input_ids, labels):
+        from paddle_tpu.distributed.tensor_parallel import parallel_cross_entropy
+        logits, aux = self(input_ids)
+        per_tok = parallel_cross_entropy(logits, jnp.maximum(labels, 0))
+        mask = (labels >= 0).astype(jnp.float32)
+        lm = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return lm + self.cfg.aux_loss_weight * aux
