@@ -1,0 +1,16 @@
+from paddle_tpu.io.dataset import (
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from paddle_tpu.io.sampler import (
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+)
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.token_bin import TokenBinDataset

@@ -1,0 +1,90 @@
+"""DataLoader (ref: ``python/paddle/io/dataloader/dataloader_iter.py``).
+
+The reference spawns multiprocessing workers feeding a pinned-memory queue.
+TPU-native host pipeline: a thread pool (numpy collation releases the GIL
+for the heavy copies) + a bounded prefetch queue, overlapping host batch
+prep with device steps. For token-LM training prefer the native C++ reader
+(paddle_tpu.io.token_bin.TokenBinDataset) which does mmap + prefetch in C++.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset, IterableDataset
+from paddle_tpu.io.sampler import BatchSampler
+
+
+def default_collate_fn(samples):
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(default_collate_fn([s[i] for s in samples])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate_fn([s[k] for s in samples]) for k in first}
+    return np.stack([np.asarray(s) for s in samples], axis=0)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Optional[Callable] = None,
+                 num_workers: int = 0, prefetch_factor: int = 2,
+                 batch_sampler: Optional[BatchSampler] = None, seed=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.iterable = isinstance(dataset, IterableDataset)
+        if self.iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last, seed=seed)
+
+    def __len__(self):
+        if self.iterable:
+            raise TypeError("IterableDataset has no __len__")
+        return len(self.batch_sampler)
+
+    def _batches(self):
+        if self.iterable:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for idxs in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            yield from self._batches()
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        _END = object()
+
+        def producer():
+            try:
+                for b in self._batches():
+                    q.put(b)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            yield item
+        t.join()

@@ -1,0 +1,92 @@
+"""IO tests: loader determinism, sharding, native reader (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.io import (
+    BatchSampler,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    RandomSampler,
+    Subset,
+    TensorDataset,
+    TokenBinDataset,
+    random_split,
+)
+
+
+class _Square(Dataset):
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        return np.asarray([i, i * i])
+
+
+def test_tensor_dataset_and_loader():
+    xs = np.arange(20).reshape(10, 2)
+    ys = np.arange(10)
+    dl = DataLoader(TensorDataset(xs, ys), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[-1][0].shape == (2, 2)
+    dl2 = DataLoader(TensorDataset(xs, ys), batch_size=4, drop_last=True)
+    assert len(list(dl2)) == 2
+
+
+def test_shuffle_deterministic_by_seed():
+    dl_a = DataLoader(_Square(), batch_size=2, shuffle=True, seed=7)
+    dl_b = DataLoader(_Square(), batch_size=2, shuffle=True, seed=7)
+    a = [b[0].tolist() for b in dl_a]
+    b = [b[0].tolist() for b in dl_b]
+    # note: RandomSampler advances epoch per-iteration; same seed, epoch 0
+    assert a == b
+
+
+def test_random_split_and_subset():
+    parts = random_split(_Square(), [7, 3])
+    assert len(parts[0]) == 7 and len(parts[1]) == 3
+    all_firsts = sorted(int(parts[0][i][0]) for i in range(7)) + \
+        sorted(int(parts[1][i][0]) for i in range(3))
+    assert sorted(all_firsts) == list(range(10))
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = _Square()
+    seen = []
+    for rank in range(2):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=rank)
+        for batch in s:
+            seen += batch
+    assert sorted(seen) == list(range(10))
+
+
+def test_worker_prefetch_loader():
+    dl = DataLoader(_Square(), batch_size=3, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    flat = np.concatenate([b[:, 0] for b in batches])
+    assert sorted(flat.tolist()) == list(range(10))
+
+
+def test_native_token_bin(tmp_path):
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(0, 5000, 100_000).astype(np.uint16)
+    path = tmp_path / "toks.bin"
+    tokens.tofile(path)
+    ds = TokenBinDataset(str(path), batch_size=4, seq_len=64, seed=3,
+                         num_batches=5)
+    assert ds.num_tokens == 100_000
+    batches = list(ds)
+    assert len(batches) == 5
+    for x, y in batches:
+        assert x.shape == (4, 64) and y.shape == (4, 64)
+        # label is input shifted by one within the same window
+        np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+        assert x.min() >= 0 and x.max() < 5000
+    # windows must come from the file
+    x0 = batches[0][0][0]
+    joined = tokens.astype(np.int32)
+    pos = np.where(joined == x0[0])[0]
+    assert any((joined[p:p + 64] == x0).all() for p in pos if p + 64 <= len(joined))
