@@ -1,0 +1,84 @@
+"""Fleet facade (ref: ``python/paddle/distributed/fleet/fleet.py`` —
+``fleet.init(is_collective=True, strategy=DistributedStrategy())`` and the
+hybrid-parallel config dict).
+
+Maps the reference's strategy knobs onto a HybridMesh + sharding levels so
+reference training scripts translate line-for-line:
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                               "pp_degree": 2, "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_hybrid_communicate_group()
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+from paddle_tpu.distributed.mesh import HybridMesh
+
+
+@dataclass
+class DistributedStrategy:
+    hybrid_configs: dict = field(default_factory=dict)
+    # reference knobs kept for parity; consumed where meaningful
+    amp: bool = False
+    amp_configs: dict = field(default_factory=dict)
+    recompute: bool = False
+    sharding: bool = False
+    sharding_configs: dict = field(default_factory=dict)
+    gradient_merge: bool = False
+    gradient_merge_configs: dict = field(default_factory=dict)
+
+
+_STATE: dict = {"mesh": None, "strategy": None}
+
+
+def init(is_collective: bool = True, strategy: Optional[DistributedStrategy] = None,
+         devices=None) -> HybridMesh:
+    """Build the mesh from the strategy's hybrid_configs (ref fleet.init)."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    n = len(devices) if devices is not None else jax.device_count()
+    dp = int(hc.get("dp_degree", 0)) or 0
+    tp = int(hc.get("mp_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    sd = int(hc.get("sharding_degree", 1))
+    sep = int(hc.get("sep_degree", 1))
+    if dp == 0:  # infer dp as the remainder, reference behaviour
+        denom = tp * pp * sd * sep
+        assert n % denom == 0, (n, hc)
+        dp = n // denom
+    mesh = HybridMesh(dp=dp, fsdp=sd, pp=pp, tp=tp, sp=sep, devices=devices)
+    _STATE["mesh"] = mesh
+    _STATE["strategy"] = strategy
+    return mesh
+
+
+def get_hybrid_communicate_group() -> Optional[HybridMesh]:
+    return _STATE["mesh"]
+
+
+def distributed_model(model, min_size: int = 2 ** 16):
+    """Ref: fleet.distributed_model — places params on the mesh (ZeRO-3 layout
+    honouring tp pspecs). Sharding stage comes from strategy.sharding_configs."""
+    from paddle_tpu.distributed.sharded import shard_module
+    mesh = _STATE["mesh"]
+    if mesh is None:
+        return model
+    strategy = _STATE["strategy"]
+    stage = 3
+    if strategy and strategy.sharding_configs:
+        stage = int(strategy.sharding_configs.get("stage", 3))
+    return shard_module(model, mesh, stage=stage, min_size=min_size)
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    return jax.process_count()

@@ -1,0 +1,158 @@
+"""Checkpoint save/load (ref: ``paddle.save``/``paddle.load`` +
+Fleet sharded checkpoints / auto-parallel ``dist_saver``).
+
+Two backends:
+  * numpy .npz — dependency-free, host-gathered (fine single-host)
+  * orbax — sharded, async-capable, multi-host (preferred on pods)
+
+State layout: {model, opt_state, rng, step, meta}. Restore is EXACT —
+optimizer slots, RNG key, LR-schedule step all round-trip (SURVEY.md §2.9).
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.module import Module, _path_to_str
+
+
+def _flatten_with_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: x is None)
+    return [(_path_to_str(p), l) for p, l in flat], treedef
+
+
+def save(state: Any, path: str) -> None:
+    """paddle.save equivalent: any pytree (Module, TrainState, dict) → one file."""
+    path = Path(path)
+    if path.suffix != ".npz":
+        path = Path(str(path) + ".npz")
+    path.parent.mkdir(parents=True, exist_ok=True)
+    flat, _ = _flatten_with_paths(state)
+    arrays = {}
+    meta = {"leaves": []}
+    for i, (p, leaf) in enumerate(flat):
+        if leaf is None:
+            meta["leaves"].append({"path": p, "kind": "none"})
+        elif isinstance(leaf, (jax.Array, np.ndarray)):
+            key = f"a{i}"
+            arrays[key] = np.asarray(leaf)
+            meta["leaves"].append({"path": p, "kind": "array", "key": key,
+                                   "dtype": str(np.asarray(leaf).dtype)})
+        else:
+            meta["leaves"].append({"path": p, "kind": "py", "value": leaf})
+    np.savez(path, __meta__=json.dumps(meta), **arrays)
+
+
+def load(path: str, target: Any = None) -> Any:
+    """paddle.load equivalent. With `target`, restores into the target's
+    structure (exact dtypes/shapes checked); without, returns {path: array}."""
+    p = str(path)
+    if not p.endswith(".npz"):
+        p = p + ".npz"
+    with np.load(p, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        leaves_meta = meta["leaves"]
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    by_path = {}
+    for lm in leaves_meta:
+        if lm["kind"] == "array":
+            by_path[lm["path"]] = arrays[lm["key"]]
+        elif lm["kind"] == "py":
+            by_path[lm["path"]] = lm["value"]
+        else:
+            by_path[lm["path"]] = None
+    if target is None:
+        return by_path
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        target, is_leaf=lambda x: x is None)
+    new_leaves = []
+    for p, leaf in flat:
+        ps = _path_to_str(p)
+        if ps not in by_path:
+            raise KeyError(f"checkpoint missing leaf {ps}")
+        val = by_path[ps]
+        if isinstance(leaf, (jax.Array, np.ndarray)):
+            arr = jnp.asarray(val, dtype=leaf.dtype)
+            if arr.shape != leaf.shape:
+                raise ValueError(f"{ps}: shape {arr.shape} != {leaf.shape}")
+            # preserve sharding of the target leaf
+            if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+                arr = jax.device_put(arr, leaf.sharding)
+            new_leaves.append(arr)
+        else:
+            new_leaves.append(val if val is not None else leaf)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (ref Fleet auto ckpt)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3, use_orbax: bool = False):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.use_orbax = use_orbax
+        if use_orbax:
+            import orbax.checkpoint as ocp
+            self._mgr = ocp.CheckpointManager(
+                self.dir, options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def _step_path(self, step: int) -> Path:
+        return self.dir / f"ckpt_{step:08d}.npz"
+
+    def save(self, step: int, state) -> None:
+        if self.use_orbax:
+            import orbax.checkpoint as ocp
+            self._mgr.save(step, args=ocp.args.StandardSave(
+                jax.tree_util.tree_map(np.asarray, state,
+                                       is_leaf=lambda x: x is None)))
+            self._mgr.wait_until_finished()
+            return
+        save(state, self._step_path(step))
+        self._gc()
+
+    def latest_step(self) -> Optional[int]:
+        if self.use_orbax:
+            return self._mgr.latest_step()
+        steps = sorted(int(p.stem.split("_")[1]) for p in self.dir.glob("ckpt_*.npz"))
+        return steps[-1] if steps else None
+
+    def restore(self, state_like, step: Optional[int] = None):
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        if self.use_orbax:
+            import orbax.checkpoint as ocp
+            restored = self._mgr.restore(step, args=ocp.args.StandardRestore(
+                jax.tree_util.tree_map(np.asarray, state_like,
+                                       is_leaf=lambda x: x is None)))
+            flat_new = jax.tree_util.tree_leaves(restored, is_leaf=lambda x: x is None)
+            _, treedef = jax.tree_util.tree_flatten(state_like, is_leaf=lambda x: x is None)
+            return jax.tree_util.tree_unflatten(treedef, [
+                jnp.asarray(n, dtype=o.dtype) if isinstance(o, (jax.Array, np.ndarray)) else n
+                for n, o in zip(flat_new, jax.tree_util.tree_leaves(
+                    state_like, is_leaf=lambda x: x is None))])
+        return load(self._step_path(step), target=state_like)
+
+    def _gc(self):
+        ckpts = sorted(self.dir.glob("ckpt_*.npz"))
+        while len(ckpts) > self.max_to_keep:
+            ckpts.pop(0).unlink()
+
+
+def save_state_dict(module: Module, path: str):
+    """paddle-style: save only the state dict."""
+    save(dict(module.state_dict()), path)
+
+
+def load_state_dict(module: Module, path: str):
+    sd = load(path)
+    module.set_state_dict(sd)
+    return module

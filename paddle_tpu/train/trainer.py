@@ -1,0 +1,146 @@
+"""Trainer (ref: PaddleNLP ``Trainer`` / the reference's Fleet training loop).
+
+One fused jitted step (grads+clip+optimizer+schedule), gradient accumulation
+via an inner ``lax.scan``-free accumulation (accumulate in fp32 and apply on
+the boundary — keeps one compiled program), watchdog/NaN sentinel hooks, MFU
+logging, checkpoint/resume.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
+from paddle_tpu.train.checkpoint import CheckpointManager
+from paddle_tpu.train.step import TrainState, init_state
+
+
+@dataclass
+class TrainerArgs:
+    max_steps: int = 1000
+    log_every: int = 10
+    ckpt_every: int = 0                   # 0 = disabled
+    ckpt_dir: str = "checkpoints"
+    grad_accum_steps: int = 1
+    flops_per_token: float = 0.0          # for MFU logging
+    peak_flops: float = 197e12
+    nan_guard: bool = True                # skip update & count on non-finite loss
+    max_bad_steps: int = 25               # trip watchdog after this many
+
+
+class Trainer:
+    def __init__(self, model: Module, optimizer, loss_fn: Callable,
+                 args: TrainerArgs = None, mesh=None, hooks=None):
+        self.args = args or TrainerArgs()
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.state = init_state(model, optimizer, mesh)
+        self.hooks = hooks or []
+        self._step_fn = self._build_step()
+        self.history: list[dict] = []
+        self._bad_steps = 0
+
+    def _build_step(self):
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        accum = self.args.grad_accum_steps
+        nan_guard = self.args.nan_guard
+
+        def step(state: TrainState, *batches):
+            if accum == 1:
+                loss, grads = value_and_grad(loss_fn)(state.model, *batches[0])
+            else:
+                def acc_body(carry, batch):
+                    loss_sum, grads_sum = carry
+                    loss, grads = value_and_grad(loss_fn)(state.model, *batch)
+                    grads_sum = jax.tree_util.tree_map(
+                        lambda a, g: a if g is None else a + g.astype(jnp.float32),
+                        grads_sum, grads, is_leaf=lambda x: x is None)
+                    return (loss_sum + loss, grads_sum), None
+
+                zero = jax.tree_util.tree_map(
+                    lambda p: None if p is None else jnp.zeros(p.shape, jnp.float32),
+                    partition_trainable(state.model)[0], is_leaf=lambda x: x is None)
+                (loss, grads), _ = jax.lax.scan(
+                    acc_body, (jnp.zeros((), jnp.float32), zero),
+                    jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches))
+                loss = loss / accum
+                grads = jax.tree_util.tree_map(
+                    lambda g: None if g is None else g / accum,
+                    grads, is_leaf=lambda x: x is None)
+            new_model, new_opt = optimizer.step(state.model, grads, state.opt_state)
+            if nan_guard:
+                ok = jnp.isfinite(loss)
+                new_model = jax.tree_util.tree_map(
+                    lambda new, old: old if new is None else jnp.where(ok, new, old),
+                    new_model, state.model, is_leaf=lambda x: x is None)
+                new_opt = jax.tree_util.tree_map(
+                    lambda new, old: old if new is None else jnp.where(ok, new, old),
+                    new_opt, state.opt_state, is_leaf=lambda x: x is None)
+            return TrainState(new_model, new_opt, state.rng), loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def resume(self):
+        mgr = CheckpointManager(self.args.ckpt_dir)
+        restored = mgr.restore(self.state)
+        if restored is not None:
+            self.state = restored
+        return self
+
+    def fit(self, data_iter, eval_fn: Optional[Callable] = None):
+        args = self.args
+        mgr = CheckpointManager(args.ckpt_dir) if args.ckpt_every else None
+        accum = args.grad_accum_steps
+        t_last = time.perf_counter()
+        tokens_since = 0
+        start_step = int(self.state.step)
+        it = iter(data_iter)
+        for _ in range(start_step, args.max_steps):
+            micro = [self._to_batch(next(it)) for _ in range(accum)]
+            self.state, loss = self._step_fn(self.state, *micro)
+            step_no = int(self.state.step)
+            loss_val = float(loss)
+
+            if args.nan_guard:
+                if not np.isfinite(loss_val):
+                    self._bad_steps += 1
+                    if self._bad_steps >= args.max_bad_steps:
+                        from paddle_tpu.utils.watchdog import WatchdogTrip
+                        raise WatchdogTrip(
+                            f"{self._bad_steps} consecutive non-finite losses")
+                else:
+                    self._bad_steps = 0
+
+            tokens_since += sum(int(np.prod(b[0].shape[:2])) for b in micro
+                                if hasattr(b[0], "shape") and b[0].ndim >= 2)
+            if args.log_every and step_no % args.log_every == 0:
+                now = time.perf_counter()
+                dt = now - t_last
+                rec = {"step": step_no, "loss": loss_val,
+                       "steps_per_sec": args.log_every / dt if dt > 0 else 0.0,
+                       "lr": self.optimizer.get_lr(self.state.opt_state)}
+                if args.flops_per_token and tokens_since:
+                    rec["tokens_per_sec"] = tokens_since / dt
+                    rec["mfu"] = (tokens_since / dt) * args.flops_per_token / args.peak_flops
+                self.history.append(rec)
+                for h in self.hooks:
+                    h(rec)
+                t_last, tokens_since = now, 0
+            if mgr and step_no % args.ckpt_every == 0:
+                mgr.save(step_no, self.state)
+            if eval_fn and args.log_every and step_no % (args.log_every * 10) == 0:
+                eval_fn(self.state.model)
+        return self.state
+
+    @staticmethod
+    def _to_batch(b):
+        if isinstance(b, (tuple, list)):
+            return tuple(jnp.asarray(x) for x in b)
+        return (jnp.asarray(b),)

@@ -1,0 +1,8 @@
+from paddle_tpu.utils.profiler import (
+    Profiler,
+    StepTimer,
+    device_memory_stats,
+    dump_cost_analysis,
+    record_event,
+)
+from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip, check_finite

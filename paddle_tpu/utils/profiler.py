@@ -1,0 +1,104 @@
+"""Profiling & tracing (ref: ``python/paddle/profiler/`` — Profiler,
+RecordEvent, chrome-trace export; SURVEY.md §2.9).
+
+TPU-native: wraps ``jax.profiler`` (XLA's own tracer → TensorBoard/perfetto
+trace with per-op HLO timings, HBM usage, ICI traffic) plus a host-side
+step-timer with MFU accounting, and HLO/jaxpr dump helpers for graph debug.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+
+class Profiler:
+    """Reference-shaped API: Profiler(targets=...) ... start/stop/export."""
+
+    def __init__(self, log_dir: str = "profile_out"):
+        self.log_dir = log_dir
+        self._active = False
+
+    def start(self):
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+        return self
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """Ref: paddle.profiler.RecordEvent — annotates the XLA trace."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory_stats() -> dict:
+    """Per-device HBM usage (ref: paddle.device.cuda.memory_allocated)."""
+    out = {}
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats()
+            out[str(d)] = {"bytes_in_use": s.get("bytes_in_use"),
+                           "peak_bytes_in_use": s.get("peak_bytes_in_use"),
+                           "bytes_limit": s.get("bytes_limit")}
+        except Exception:
+            out[str(d)] = {}
+    return out
+
+
+@dataclass
+class StepTimer:
+    """Host-side step timing + MFU meter."""
+    flops_per_token: float = 0.0
+    peak_flops: float = 197e12
+    _t0: float = field(default=0.0, repr=False)
+    records: list = field(default_factory=list)
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, tokens: int = 0) -> dict:
+        dt = time.perf_counter() - self._t0
+        rec = {"step_s": dt}
+        if tokens:
+            rec["tokens_per_sec"] = tokens / dt
+            if self.flops_per_token:
+                rec["mfu"] = tokens / dt * self.flops_per_token / self.peak_flops
+        self.records.append(rec)
+        return rec
+
+
+def dump_cost_analysis(fn, *args) -> dict:
+    """XLA FLOPs/bytes estimate for `fn(*args)` (feeds MFU accounting)."""
+    compiled = jax.jit(fn).lower(*args).compile()
+    try:
+        return dict(compiled.cost_analysis())
+    except Exception:
+        return {}
+
+
+def compiled_memory_analysis(fn, *args) -> dict:
+    compiled = jax.jit(fn).lower(*args).compile()
+    try:
+        m = compiled.memory_analysis()
+        return {"temp_size": m.temp_size_in_bytes,
+                "argument_size": m.argument_size_in_bytes,
+                "output_size": m.output_size_in_bytes,
+                "generated_code_size": m.generated_code_size_in_bytes}
+    except Exception:
+        return {}
