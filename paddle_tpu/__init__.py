@@ -10,7 +10,7 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from paddle_tpu import core, nn, ops
+from paddle_tpu import amp, core, io, nn, ops, optimizer, utils
 from paddle_tpu.core.device import (
     device_count,
     get_device,
@@ -37,5 +37,16 @@ from paddle_tpu.core.module import Module, combine, partition_trainable, value_a
 from paddle_tpu.tensor import *  # noqa: F401,F403
 from paddle_tpu import jit as jit_module
 from paddle_tpu.jit import to_static, no_grad, grad
+from paddle_tpu.train.checkpoint import load, save
 
 jit = jit_module.jit
+
+
+def __getattr__(name):
+    # lazy heavy subpackages (distributed pulls mesh/jax topology; models the zoo)
+    if name in ("distributed", "models", "train", "vision"):
+        import importlib
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

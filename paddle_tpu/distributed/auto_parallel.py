@@ -1,0 +1,82 @@
+"""Auto-parallel annotation API (ref: ``python/paddle/distributed/
+auto_parallel/`` — ``shard_tensor``, ``ProcessMesh``, ``Shard``/``Replicate``
+placements).
+
+On TPU this IS the native programming model: annotations become
+NamedSharding/with_sharding_constraint and GSPMD propagates the rest — the
+reference's cost-model planner is XLA's sharding propagation pass.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ProcessMesh:
+    """Ref ProcessMesh([[0,1],[2,3]], dim_names=["x","y"])."""
+
+    def __init__(self, mesh, dim_names=None):
+        arr = np.asarray(mesh)
+        dim_names = tuple(dim_names or [f"d{i}" for i in range(arr.ndim)])
+        devices = np.asarray(jax.devices())[arr]
+        self.mesh = Mesh(devices, dim_names)
+        self.dim_names = dim_names
+
+    @property
+    def shape(self):
+        return tuple(self.mesh.shape.values())
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard(dim) — shard tensor dim over the corresponding mesh dim."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+
+class Replicate(Placement):
+    pass
+
+
+class Partial(Placement):
+    """Pending-reduction placement; materialised by the next collective."""
+
+
+def _placements_to_spec(ndim, mesh: ProcessMesh, placements):
+    spec = [None] * ndim
+    for mesh_dim, placement in enumerate(placements):
+        if isinstance(placement, Shard):
+            axis = mesh.dim_names[mesh_dim]
+            if spec[placement.dim] is None:
+                spec[placement.dim] = axis
+            elif isinstance(spec[placement.dim], tuple):
+                spec[placement.dim] = spec[placement.dim] + (axis,)
+            else:
+                spec[placement.dim] = (spec[placement.dim], axis)
+    return P(*spec)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements):
+    """Ref dist.shard_tensor — place `x` per placements on the mesh."""
+    spec = _placements_to_spec(np.ndim(x), mesh, placements)
+    return jax.device_put(x, NamedSharding(mesh.mesh, spec))
+
+
+def reshard(x, mesh: ProcessMesh, placements):
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_op(fn, mesh: ProcessMesh, in_placements=None, out_placements=None):
+    """Ref dist.shard_op — constrain a function's outputs onto the mesh."""
+    def wrapped(*args):
+        out = fn(*args)
+        if out_placements is not None:
+            spec = _placements_to_spec(np.ndim(out), mesh, out_placements)
+            out = jax.lax.with_sharding_constraint(out, NamedSharding(mesh.mesh, spec))
+        return out
+    return wrapped

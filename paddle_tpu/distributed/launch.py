@@ -1,0 +1,54 @@
+"""Multi-host launcher (ref: ``python/paddle/distributed/launch`` —
+``python -m paddle.distributed.launch --nnodes=...``).
+
+On TPU pods there is no per-GPU process spawning: ONE process per host, all
+chips of the host driven by that process, cross-host wiring via
+``jax.distributed.initialize`` (coordinator = host 0). This module is the
+equivalent entrypoint:
+
+    python -m paddle_tpu.distributed.launch train.py --args...
+
+Env contract (set by the TPU runtime or the user):
+  COORDINATOR_ADDRESS host:port of process 0
+  NUM_PROCESSES / PROCESS_ID  (optional; auto-detected on Cloud TPU)
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def initialize_cluster():
+    """Bring up the JAX distributed runtime across hosts (idempotent)."""
+    import jax
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    nproc = os.environ.get("NUM_PROCESSES")
+    pid = os.environ.get("PROCESS_ID")
+    if coord is None and nproc is None:
+        # Cloud TPU pods auto-detect via metadata; single host is a no-op
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass
+        return
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(nproc) if nproc else None,
+        process_id=int(pid) if pid else None)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 1
+    initialize_cluster()
+    script, *rest = argv
+    sys.argv = [script] + rest
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+from paddle_tpu.vision import models, transforms

@@ -1,0 +1,137 @@
+"""Vision model zoo (ref: ``python/paddle/vision/models/``) — ResNet family
+re-exported plus VGG and MobileNetV2."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layers import (
+    AdaptiveAvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Linear,
+    MaxPool2D,
+    Sequential,
+)
+
+_VGG_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512,
+         "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Module):
+    def __init__(self, depth=16, num_classes=1000, batch_norm=True):
+        super().__init__()
+        layers = []
+        in_c = 3
+        for v in _VGG_CFGS[depth]:
+            if v == "M":
+                layers.append(MaxPool2D(2, 2))
+            else:
+                layers.append(Conv2D(in_c, v, 3, padding=1, bias_attr=not batch_norm))
+                if batch_norm:
+                    layers.append(BatchNorm2D(v))
+                from paddle_tpu.nn.layers import ReLU
+                layers.append(ReLU())
+                in_c = v
+        self.features = Sequential(*layers)
+        self.avgpool = AdaptiveAvgPool2D(7)
+        self.classifier = Sequential(
+            Linear(512 * 7 * 7, 4096), _relu(), Dropout(0.5),
+            Linear(4096, 4096), _relu(), Dropout(0.5),
+            Linear(4096, num_classes))
+
+    def __call__(self, x, rng=None):
+        x = self.features(x)
+        x = self.avgpool(x)
+        return self.classifier(x.reshape(x.shape[0], -1), rng=rng)
+
+
+def _relu():
+    from paddle_tpu.nn.layers import ReLU
+    return ReLU()
+
+
+def vgg16(num_classes=1000, **kw):
+    return VGG(16, num_classes, **kw)
+
+
+def vgg19(num_classes=1000, **kw):
+    return VGG(19, num_classes, **kw)
+
+
+class _InvertedResidual(Module):
+    def __init__(self, in_c, out_c, stride, expand):
+        super().__init__()
+        hidden = in_c * expand
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand != 1:
+            layers += [Conv2D(in_c, hidden, 1, bias_attr=False), BatchNorm2D(hidden)]
+        layers += [Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                          groups=hidden, bias_attr=False), BatchNorm2D(hidden)]
+        self.expand_layers = layers
+        self.project = Conv2D(hidden, out_c, 1, bias_attr=False)
+        self.project_bn = BatchNorm2D(out_c)
+
+    def __call__(self, x):
+        y = x
+        i = 0
+        layers = self.expand_layers
+        while i < len(layers):
+            y = layers[i](y)       # conv
+            y = layers[i + 1](y)   # bn
+            y = F.relu6(y)
+            i += 2
+        y = self.project_bn(self.project(y))
+        return x + y if self.use_res else y
+
+
+class MobileNetV2(Module):
+    def __init__(self, num_classes=1000, width_mult=1.0):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        c0 = int(32 * width_mult)
+        self.stem = Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False)
+        self.stem_bn = BatchNorm2D(c0)
+        blocks = []
+        in_c = c0
+        for t, c, n, s in cfg:
+            out_c = int(c * width_mult)
+            for i in range(n):
+                blocks.append(_InvertedResidual(in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        self.blocks = blocks
+        last = int(1280 * max(1.0, width_mult))
+        self.head = Conv2D(in_c, last, 1, bias_attr=False)
+        self.head_bn = BatchNorm2D(last)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(last, num_classes)
+
+    def __call__(self, x):
+        x = F.relu6(self.stem_bn(self.stem(x)))
+        for b in self.blocks:
+            x = b(x)
+        x = F.relu6(self.head_bn(self.head(x)))
+        x = self.pool(x)
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+def mobilenet_v2(num_classes=1000, **kw):
+    return MobileNetV2(num_classes, **kw)

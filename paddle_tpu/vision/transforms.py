@@ -1,0 +1,110 @@
+"""Vision transforms (ref: ``python/paddle/vision/transforms/``).
+
+Host-side numpy transforms (they run in the input pipeline, not on TPU);
+Normalize/Resize also accept jax arrays for on-device use. Images are HWC
+uint8/float; ToTensor converts to CHW float32 like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        return np.transpose(arr, (2, 0, 1))
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW"):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            return (img - self.mean[:, None, None]) / self.std[:, None, None]
+        return (img - self.mean) / self.std
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[-1]
+        if chw:
+            h_axis, shape = 1, (arr.shape[0],) + self.size
+        else:
+            h_axis, shape = 0, self.size + (arr.shape[-1],) if arr.ndim == 3 else self.size
+        method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[self.interpolation]
+        out = jax.image.resize(jnp.asarray(arr, jnp.float32), shape, method=method)
+        return np.asarray(out)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[-1]
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        return arr[:, i:i + th, j:j + tw] if chw else arr[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0, seed=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[-1]
+        if self.padding:
+            p = self.padding
+            pad = ((0, 0), (p, p), (p, p)) if chw else ((p, p), (p, p), (0, 0))[:arr.ndim]
+            arr = np.pad(arr, pad[:arr.ndim], mode="constant")
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        i = self.rng.randint(0, h - th + 1)
+        j = self.rng.randint(0, w - tw + 1)
+        return arr[:, i:i + th, j:j + tw] if chw else arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, seed=None):
+        self.prob = prob
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.rng.rand() < self.prob:
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[-1]
+            return arr[:, :, ::-1].copy() if chw else arr[:, ::-1].copy()
+        return arr
