@@ -45,7 +45,7 @@ def main():
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
                           num_hidden_layers=12, num_attention_heads=16,
                           num_key_value_heads=16, max_position_embeddings=2048,
-                          dtype=jnp.bfloat16, remat=True)
+                          dtype=jnp.bfloat16, remat=True, scan_layers=True)
         batch, seq, iters = 4, 2048, 20
     else:  # CPU smoke: same code path, tiny shapes
         cfg = LlamaConfig.tiny()

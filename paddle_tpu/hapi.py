@@ -1,0 +1,96 @@
+"""High-level API (ref: ``python/paddle/hapi/model.py`` — ``paddle.Model``
+with prepare/fit/evaluate/predict/save/load).
+
+A thin orchestration layer over the fused train step: same ergonomics as the
+reference, but each epoch runs ONE compiled program per step and the loop
+overlaps host batching with device compute (async dispatch).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.module import Module, value_and_grad
+from paddle_tpu.train.checkpoint import load_state_dict, save_state_dict
+from paddle_tpu.train.step import TrainState, init_state
+
+
+class Model:
+    def __init__(self, network: Module):
+        self.network = network
+        self.optimizer = None
+        self.loss = None
+        self.metrics: Sequence = ()
+        self._state = None
+        self._step_fn = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = metrics or ()
+        if optimizer is not None:
+            self._state = init_state(self.network, optimizer)
+
+            def step(state, x, y):
+                def loss_fn(m, x, y):
+                    return self.loss(m(x), y)
+                lv, grads = value_and_grad(loss_fn)(state.model, x, y)
+                model, opt_state = optimizer.step(state.model, grads, state.opt_state)
+                return TrainState(model, opt_state, state.rng), lv
+
+            self._step_fn = jax.jit(step, donate_argnums=(0,))
+        return self
+
+    def fit(self, train_data, eval_data=None, epochs=1, verbose=1, log_freq=50):
+        history = []
+        for epoch in range(epochs):
+            for i, batch in enumerate(train_data):
+                x, y = batch[0], batch[1]
+                self._state, lv = self._step_fn(self._state, jnp.asarray(x), jnp.asarray(y))
+                if verbose and i % log_freq == 0:
+                    rec = {"epoch": epoch, "step": i, "loss": float(lv)}
+                    history.append(rec)
+                    print(f"[epoch {epoch}] step {i} loss {rec['loss']:.4f}")
+            self.network = self._state.model
+            if eval_data is not None:
+                history.append({"epoch": epoch, **self.evaluate(eval_data, verbose=0)})
+        return history
+
+    def evaluate(self, eval_data, verbose=1):
+        for m in self.metrics:
+            m.reset()
+        model = (self._state.model if self._state is not None else self.network).eval()
+        fwd = jax.jit(lambda m, x: m(x))
+        losses = []
+        for batch in eval_data:
+            x, y = batch[0], batch[1]
+            out = fwd(model, jnp.asarray(x))
+            if self.loss is not None:
+                losses.append(float(self.loss(out, jnp.asarray(y))))
+            for m in self.metrics:
+                m.update(np.asarray(out), np.asarray(y))
+        res = {"eval_loss": float(np.mean(losses)) if losses else None}
+        for m in self.metrics:
+            res[f"eval_{m.name()}"] = m.accumulate()
+        if verbose:
+            print(res)
+        return res
+
+    def predict(self, test_data):
+        model = (self._state.model if self._state is not None else self.network).eval()
+        fwd = jax.jit(lambda m, x: m(x))
+        return [np.asarray(fwd(model, jnp.asarray(b[0] if isinstance(b, (tuple, list)) else b)))
+                for b in test_data]
+
+    def save(self, path):
+        net = self._state.model if self._state is not None else self.network
+        save_state_dict(net, path)
+
+    def load(self, path):
+        load_state_dict(self.network, path)
+        if self.optimizer is not None:
+            self._state = init_state(self.network, self.optimizer)
+        return self

@@ -1,0 +1,121 @@
+"""Metrics (ref: ``python/paddle/metric/metrics.py`` — Metric, Accuracy,
+Precision, Recall, Auc). Host-accumulated; updates accept jax or numpy."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return type(self).__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,)):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def reset(self):
+        self.correct = np.zeros(len(self.topk))
+        self.total = 0
+
+    def update(self, pred, label):
+        pred = np.asarray(pred)
+        label = np.asarray(label).reshape(-1)
+        k_max = max(self.topk)
+        top = np.argsort(-pred, axis=-1)[..., :k_max].reshape(len(label), k_max)
+        for i, k in enumerate(self.topk):
+            self.correct[i] += (top[:, :k] == label[:, None]).any(axis=1).sum()
+        self.total += len(label)
+        return self.accumulate()
+
+    def accumulate(self):
+        acc = self.correct / max(self.total, 1)
+        return float(acc[0]) if len(self.topk) == 1 else [float(a) for a in acc]
+
+
+class Precision(Metric):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, pred, label):
+        pred = (np.asarray(pred).reshape(-1) > 0.5).astype(np.int64)
+        label = np.asarray(label).reshape(-1).astype(np.int64)
+        self.tp += int(((pred == 1) & (label == 1)).sum())
+        self.fp += int(((pred == 1) & (label == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, pred, label):
+        pred = (np.asarray(pred).reshape(-1) > 0.5).astype(np.int64)
+        label = np.asarray(label).reshape(-1).astype(np.int64)
+        self.tp += int(((pred == 1) & (label == 1)).sum())
+        self.fn += int(((pred == 0) & (label == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """Riemann-sum ROC AUC over binned thresholds (ref Auc num_thresholds)."""
+
+    def __init__(self, num_thresholds=4095):
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1)
+        self._neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2:  # [N, 2] probs
+            preds = preds[:, 1]
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        np.add.at(self._pos, idx[labels == 1], 1)
+        np.add.at(self._neg, idx[labels == 0], 1)
+
+    def accumulate(self):
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tp = np.cumsum(self._pos[::-1])[::-1]
+        fp = np.cumsum(self._neg[::-1])[::-1]
+        tpr = np.concatenate([tp / tot_pos, [0.0]])
+        fpr = np.concatenate([fp / tot_neg, [0.0]])
+        return float(np.abs(np.trapezoid(tpr, fpr)))
+
+
+def accuracy(pred, label, k=1):
+    """Functional one-shot accuracy (ref paddle.metric.accuracy)."""
+    m = Accuracy(topk=(k,))
+    m.update(pred, label)
+    return m.accumulate()

@@ -44,6 +44,7 @@ class LlamaConfig:
     dtype: object = jnp.bfloat16
     remat: bool = True
     use_flash: bool = True
+    scan_layers: bool = False  # stack layers + lax.scan: O(1) compile depth
 
     @staticmethod
     def llama2_7b(**kw):
@@ -143,7 +144,16 @@ class LlamaModel(Module):
         init = I.Normal(0.0, cfg.initializer_range)
         self.embed_tokens = init((cfg.vocab_size, cfg.hidden_size), cfg.dtype)
         self.set_pspec("embed_tokens", P("tp", None))
-        self.layers = [LlamaDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)]
+        layers = [LlamaDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)]
+        if cfg.scan_layers:
+            # stacked pytree [L, ...]: one traced layer, lax.scan over depth —
+            # compile time independent of depth, leading axis a natural fsdp dim
+            self.layers_stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *layers)
+            self.layers = []
+        else:
+            self.layers = layers
+            self.layers_stacked = None
         self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, cfg.dtype)
 
     def __call__(self, input_ids, attn_mask=None, position_ids=None):
@@ -157,8 +167,13 @@ class LlamaModel(Module):
         layer_fn = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin, attn_mask),
                                    static_argnums=())
                     if cfg.remat else (lambda lyr, h: lyr(h, cos, sin, attn_mask)))
-        for lyr in self.layers:
-            x = layer_fn(lyr, x)
+        if cfg.scan_layers:
+            def body(h, lyr):
+                return layer_fn(lyr, h), None
+            x, _ = jax.lax.scan(body, x, self.layers_stacked)
+        else:
+            for lyr in self.layers:
+                x = layer_fn(lyr, x)
         return self.norm(x)
 
 

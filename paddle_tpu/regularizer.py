@@ -1,0 +1,32 @@
+"""Regularizers (ref: ``python/paddle/regularizer.py`` — L1Decay, L2Decay).
+
+Functional: produce a penalty term from a param tree; optimizers also accept
+``weight_decay`` directly (the reference's common path)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class L2Decay:
+    def __init__(self, coeff=1e-4):
+        self.coeff = coeff
+
+    def __call__(self, params):
+        tot = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree_util.tree_leaves(params):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+                tot = tot + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        return 0.5 * self.coeff * tot
+
+
+class L1Decay:
+    def __init__(self, coeff=1e-4):
+        self.coeff = coeff
+
+    def __call__(self, params):
+        tot = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree_util.tree_leaves(params):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+                tot = tot + jnp.sum(jnp.abs(leaf.astype(jnp.float32)))
+        return self.coeff * tot
