@@ -67,16 +67,21 @@ def main():
 
     step = make_train_step(loss_fn, optimizer)
 
+    # NB: on the axon TPU tunnel, block_until_ready is a no-op — the only
+    # reliable sync is an actual host transfer, so we fetch the scalar loss.
+    def sync(x):
+        return float(jax.device_get(x))
+
     # warmup / compile
     state, loss = step(state, ids, labels)
-    jax.block_until_ready(loss)
+    sync(loss)
     state, loss = step(state, ids, labels)
-    jax.block_until_ready(loss)
+    sync(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = step(state, ids, labels)
-    jax.block_until_ready(loss)
+    loss_val = sync(loss)  # forces the whole chained-step sequence
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_sec = batch * seq / dt
@@ -95,7 +100,7 @@ def main():
             "step_ms": round(dt * 1e3, 2),
             "params": model.num_parameters(),
             "batch": batch, "seq": seq,
-            "loss": float(loss),
+            "loss": loss_val,
             "device": str(jax.devices()[0]),
         },
     }))

@@ -68,9 +68,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_sc[:, 0], 1e-30)
-        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = (m_sc[:, 0] + jnp.log(l)).astype(lse_ref.dtype)
+        l = jnp.maximum(l_sc[:], 1e-30)  # [Bq, 1]
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        # lse is [Bq, 1]: kept 2D with q on the sublane dim so the block
+        # tiling is TPU-legal and it broadcasts against [Bq, Bk] scores.
+        lse_ref[0] = (m_sc[:] + jnp.log(l)).astype(lse_ref.dtype)
 
 
 def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
@@ -90,11 +92,11 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -125,10 +127,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
             q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [Bq, 1]
         dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dq_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -162,12 +164,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])  # [Bq, Bk]
+        p = jnp.exp(s - lse_ref[0])  # [Bq, Bk]; lse_ref[0]: [Bq, 1]
         dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale  # [Bq, Bk]
+        ds = p * (dp - delta_ref[0]) * scale  # [Bq, Bk]
         dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -187,7 +189,8 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
     sk = k.shape[1]
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [BH,S]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, S, 1] to match lse layout
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -198,8 +201,8 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -216,8 +219,8 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
