@@ -404,7 +404,7 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
         xr = x.reshape(n, c, out[0], h // out[0], out[1], w // out[1])
         y = xr.mean(axis=(3, 5))
     else:
-        y = jax.image.resize(x, (n, c, out[0], out[1]), method="linear")
+        y = _adaptive_avg_along(_adaptive_avg_along(x, 2, out[0]), 3, out[1])
     if data_format != "NCHW":
         y = jnp.moveaxis(y, 1, -1)
     return y
@@ -420,19 +420,74 @@ def adaptive_max_pool2d(x, output_size):
 
 # -- interpolate ------------------------------------------------------------
 
+def _resize_axis(x, axis, out_size, mode, align_corners):
+    """Separable 1-axis resize matching reference (torch/paddle) coordinate
+    conventions: nearest = floor(out*in/out) asymmetric; linear = half-pixel
+    centers unless align_corners."""
+    in_size = x.shape[axis]
+    if in_size == out_size:
+        return x
+    if mode == "nearest":
+        idx = jnp.floor(jnp.arange(out_size) * (in_size / out_size)).astype(jnp.int32)
+        return jnp.take(x, jnp.clip(idx, 0, in_size - 1), axis=axis)
+    if align_corners and out_size > 1:
+        coords = jnp.arange(out_size) * ((in_size - 1) / (out_size - 1))
+    else:
+        coords = (jnp.arange(out_size) + 0.5) * (in_size / out_size) - 0.5
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    if mode == "cubic":
+        # Keys cubic kernel, a=-0.75 (reference/torch bicubic), border-clamped
+        a = -0.75
+        base = jnp.floor(coords).astype(jnp.int32)
+        t = (coords - base).astype(jnp.float32)
+
+        def k1(u):  # |u| <= 1
+            return (a + 2) * u ** 3 - (a + 3) * u ** 2 + 1
+
+        def k2(u):  # 1 < |u| < 2
+            return a * u ** 3 - 5 * a * u ** 2 + 8 * a * u - 4 * a
+
+        ws = [k2(t + 1), k1(t), k1(1 - t), k2(2 - t)]
+        y = 0.0
+        for off, w in zip((-1, 0, 1, 2), ws):
+            idx = jnp.clip(base + off, 0, in_size - 1)
+            y = y + jnp.take(x, idx, axis=axis).astype(jnp.float32) * w.reshape(shape)
+        return y.astype(x.dtype)
+    coords = jnp.clip(coords, 0.0, in_size - 1)
+    lo = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, in_size - 1)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    w = (coords - lo).astype(jnp.float32).reshape(shape)
+    xlo = jnp.take(x, lo, axis=axis).astype(jnp.float32)
+    xhi = jnp.take(x, hi, axis=axis).astype(jnp.float32)
+    return (xlo * (1 - w) + xhi * w).astype(x.dtype)
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                align_corners=False, data_format="NCHW"):
-    if data_format != "NCHW":
+                align_corners=False, data_format=None):
+    """N-D resize: 3D (linear), 4D (nearest/bilinear/bicubic/area), 5D
+    (nearest/trilinear). Ref: paddle.nn.functional.interpolate."""
+    nd = x.ndim - 2
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if channels_last:
         x = jnp.moveaxis(x, -1, 1)
-    n, c, h, w = x.shape
+    spatial = x.shape[2:]
     if size is None:
-        sf = _norm_tuple(scale_factor, 2)
-        size = (int(h * sf[0]), int(w * sf[1]))
-    size = _norm_tuple(size, 2)
-    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
-              "linear": "linear", "area": "linear"}[mode]
-    y = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
-    if data_format != "NCHW":
+        sf = ((scale_factor,) * nd if isinstance(scale_factor, (int, float))
+              else tuple(scale_factor))
+        size = tuple(int(s * f) for s, f in zip(spatial, sf))
+    size = _norm_tuple(size, nd)
+    if mode == "area":
+        for axis, o in zip(range(2, 2 + nd), size):
+            x = _adaptive_avg_along(x, axis, o)
+        y = x
+    else:
+        m = {"nearest": "nearest", "bicubic": "cubic", "linear": "linear",
+             "bilinear": "linear", "trilinear": "linear"}[mode]
+        y = x
+        for axis, o in zip(range(2, 2 + nd), size):
+            y = _resize_axis(y, axis, o, m, align_corners)
+    if channels_last:
         y = jnp.moveaxis(y, 1, -1)
     return y
 
@@ -600,6 +655,213 @@ def softmax_mask_fuse_upper_triangle(x):
 
 def one_hot(x, num_classes):
     return jax.nn.one_hot(x, num_classes)
+
+
+# -- distance / similarity (ref functional/distance.py) ----------------------
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+# -- extra losses (ref functional/loss.py) -----------------------------------
+
+def soft_margin_loss(input, label, reduction="mean"):
+    return _reduce(jax.nn.softplus(-label * input), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input) +
+             (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean"):
+    num_classes = input.shape[-1]
+    x_y = jnp.take_along_axis(input, label[..., None], axis=-1)
+    m = jnp.maximum(margin - x_y + input, 0.0) ** p
+    if weight is not None:
+        m = m * jnp.take(weight, label)[..., None]
+    # the j == y term is excluded from the sum
+    m = m * (1 - jax.nn.one_hot(label, num_classes, dtype=m.dtype))
+    return _reduce(jnp.sum(m, axis=-1) / num_classes, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(jnp.maximum(label, 1.0)) - label + \
+            0.5 * jnp.log(2 * math.pi * jnp.maximum(label, 1.0))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean"):
+    """CTC alpha-recursion in log space via ``lax.scan`` over time.
+
+    Ref: paddle.nn.functional.ctc_loss (warpctc kernel,
+    ``paddle/phi/kernels/impl/warpctc_kernel_impl.h``). TPU-native: the
+    whole forward DP is one scan, batch-vectorised, no host sync.
+
+    ``log_probs``: [T, B, C] log-softmax-normalised; ``labels``: [B, L] padded.
+    """
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.float32(-1e30)
+    log_probs = log_probs.astype(jnp.float32)
+
+    s = jnp.arange(S)
+    lab_idx = jnp.clip((s - 1) // 2, 0, L - 1)
+    ext = jnp.where(s[None, :] % 2 == 0, blank, labels[:, lab_idx])  # [B, S]
+    # skip transition s-2 -> s allowed when ext[s] != blank and != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, ext.dtype), ext[:, :-2]], axis=1)
+    allow_skip = (ext != blank) & (ext != ext_m2) & (s[None, :] >= 2)
+
+    def emit(lp_t):  # [B, C] -> [B, S]
+        return jnp.take_along_axis(lp_t, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, blank])
+    if L > 0:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(log_probs[0], ext[:, 1:2], axis=1)[:, 0])
+
+    def step(alpha, lp_t):
+        a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(allow_skip, a2, neg_inf)
+        stacked = jnp.stack([alpha, a1, a2], axis=0)
+        new = jax.scipy.special.logsumexp(stacked, axis=0) + emit(lp_t)
+        return new, new
+
+    _, alphas = lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+    # per-sample final alpha at t = input_length - 1
+    tb = alphas.transpose(1, 0, 2)  # [B, T, S]
+    a_final = jnp.take_along_axis(
+        tb, (input_lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, S]
+    end = (2 * label_lengths).astype(jnp.int32)  # index of last blank
+    a_last = jnp.take_along_axis(a_final, end[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(
+        end - 1 >= 0,
+        jnp.take_along_axis(a_final, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0],
+        neg_inf)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths.astype(loss.dtype), 1.0))
+    return _reduce(loss, reduction)
+
+
+# -- fold / shuffle (ref functional/common.py) --------------------------------
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """Inverse of :func:`unfold` — scatter-add col patches back to an image."""
+    H, W = _norm_tuple(output_sizes, 2)
+    kh, kw = _norm_tuple(kernel_sizes, 2)
+    sh, sw = _norm_tuple(strides, 2)
+    ph, pw = _norm_tuple(paddings, 2)
+    dh, dw = _norm_tuple(dilations, 2)
+    N = x.shape[0]
+    C = x.shape[1] // (kh * kw)
+    nh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    x6 = x.reshape(N, C, kh, kw, nh, nw)
+    rows = (jnp.arange(kh) * dh)[:, None] + (jnp.arange(nh) * sh)[None, :]  # [kh, nh]
+    cols = (jnp.arange(kw) * dw)[:, None] + (jnp.arange(nw) * sw)[None, :]  # [kw, nw]
+    out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), x.dtype)
+    out = out.at[:, :, rows[:, None, :, None], cols[None, :, None, :]].add(x6)
+    return out[:, :, ph:ph + H, pw:pw + W]
+
+
+def pixel_unshuffle(x, downscale_factor):
+    n, c, h, w = x.shape
+    r = downscale_factor
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    return jnp.swapaxes(x, 1, 2).reshape(n, c, h, w)
+
+
+# -- adaptive pooling with exact window semantics ----------------------------
+
+def _adaptive_avg_matrix(in_size, out_size, dtype):
+    """[out, in] averaging matrix: row i averages window
+    [floor(i*in/out), ceil((i+1)*in/out))."""
+    import numpy as _np
+    m = _np.zeros((out_size, in_size), _np.float32)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -(-((i + 1) * in_size) // out_size)  # ceil
+        m[i, lo:hi] = 1.0 / (hi - lo)
+    return jnp.asarray(m, dtype)
+
+
+def _adaptive_avg_along(x, axis, out_size):
+    if x.shape[axis] == out_size:
+        return x
+    m = _adaptive_avg_matrix(x.shape[axis], out_size, jnp.float32)
+    # HIGHEST: keep fp32 MXU accumulation — window means must be exact, and
+    # this runs on tiny [out, in] matrices so the cost is nil
+    y = jnp.matmul(jnp.moveaxis(x, axis, -1).astype(jnp.float32), m.T,
+                   precision=lax.Precision.HIGHEST)
+    return jnp.moveaxis(y, -1, axis).astype(x.dtype)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_avg_along(x, -1, output_size if isinstance(output_size, int)
+                               else output_size[0])
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    out = _norm_tuple(output_size, 3)
+    if data_format != "NCDHW":
+        x = jnp.moveaxis(x, -1, 1)
+    for axis, o in zip((-3, -2, -1), out):
+        x = _adaptive_avg_along(x, axis, o)
+    if data_format != "NCDHW":
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def adaptive_max_pool1d(x, output_size):
+    out = output_size if isinstance(output_size, int) else output_size[0]
+    n, c, l = x.shape
+    if l % out == 0:
+        return x.reshape(n, c, out, l // out).max(axis=-1)
+    # non-divisible: windowed max via masked segments
+    m = _adaptive_avg_matrix(l, out, jnp.float32) > 0  # [out, in] membership
+    big = jnp.where(m[None, None], x[:, :, None, :], -jnp.inf)
+    return big.max(axis=-1).astype(x.dtype)
 
 
 def sequence_mask(lengths, maxlen=None, dtype="bool"):

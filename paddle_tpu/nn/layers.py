@@ -15,6 +15,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from paddle_tpu.core.dtypes import get_default_dtype
 from paddle_tpu.core.module import Module
@@ -33,7 +34,11 @@ __all__ = [
     "ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Mish", "Sigmoid", "Tanh",
     "Softmax", "LogSoftmax", "LeakyReLU", "ELU", "SELU", "CELU", "Hardswish",
     "Hardsigmoid", "Hardtanh", "PReLU", "Softplus", "Softshrink", "Hardshrink",
-    "Softsign", "Tanhshrink", "ThresholdedReLU", "Maxout", "GLU",
+    "Softsign", "Tanhshrink", "ThresholdedReLU", "Maxout", "GLU", "RReLU",
+    "Pad3D", "ZeroPad2D", "Unflatten", "Unfold", "Fold", "PixelUnshuffle",
+    "ChannelShuffle", "CosineSimilarity", "PairwiseDistance", "InstanceNorm1D",
+    "InstanceNorm3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool3D",
+    "AdaptiveMaxPool1D", "SpectralNorm",
 ]
 
 
@@ -137,8 +142,12 @@ class Flatten(Module):
 
 
 class Pad1D(Module):
+    _nd = 1
+
     def __init__(self, padding, mode="constant", value=0.0):
         super().__init__()
+        if isinstance(padding, int):
+            padding = (padding,) * (2 * self._nd)
         self.padding, self.mode, self.value = tuple(padding), mode, value
 
     def __call__(self, x):
@@ -147,7 +156,7 @@ class Pad1D(Module):
 
 
 class Pad2D(Pad1D):
-    pass
+    _nd = 2
 
 
 class Upsample(Module):
@@ -506,3 +515,174 @@ class PReLU(Module):
 
     def __call__(self, x):
         return F.prelu(x, self.weight)
+
+
+# -- widened layer surface (ref common.py / norm.py / vision.py) -------------
+
+class Pad3D(Pad1D):
+    _nd = 3
+
+
+class ZeroPad2D(Pad1D):
+    _nd = 2
+
+    def __init__(self, padding):
+        super().__init__(padding, mode="constant", value=0.0)
+
+
+class Unflatten(Module):
+    def __init__(self, axis, shape):
+        super().__init__()
+        self.axis, self.shape = axis, tuple(shape)
+
+    def __call__(self, x):
+        ax = self.axis % x.ndim
+        return x.reshape(x.shape[:ax] + self.shape + x.shape[ax + 1:])
+
+
+class Unfold(Module):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.kernel_sizes, self.strides = kernel_sizes, strides
+        self.paddings, self.dilations = paddings, dilations
+
+    def __call__(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class Fold(Module):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings, self.dilations = strides, paddings, dilations
+
+    def __call__(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
+class PixelUnshuffle(Module):
+    def __init__(self, downscale_factor):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+
+    def __call__(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor)
+
+
+class ChannelShuffle(Module):
+    def __init__(self, groups):
+        super().__init__()
+        self.groups = groups
+
+    def __call__(self, x):
+        return F.channel_shuffle(x, self.groups)
+
+
+class CosineSimilarity(Module):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def __call__(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class PairwiseDistance(Module):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def __call__(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    pass
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    pass
+
+
+class AdaptiveAvgPool1D(Module):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Module):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Module):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class RReLU(Module):
+    """Randomised leaky ReLU (ref activation.py:RReLU). In eval mode uses the
+    mean slope; in train mode samples slopes per element from U(lower, upper)."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, x, rng=None):
+        if not self.training or rng is None:
+            return F.leaky_relu(x, (self.lower + self.upper) / 2)
+        slope = jax.random.uniform(rng, x.shape, jnp.float32,
+                                   self.lower, self.upper).astype(x.dtype)
+        return jnp.where(x >= 0, x, slope * x)
+
+
+class SpectralNorm(Module):
+    """Ref: paddle.nn.SpectralNorm — forward(weight) returns weight / sigma
+    where sigma is estimated by power iteration. Stateless under jit: the
+    u/v vectors are buffers updated eagerly, frozen inside traces."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        # u/v kept fp32 regardless of weight dtype: power iteration is
+        # norm-sensitive and the vectors are tiny
+        self.register_buffer("weight_u", I.Normal(0, 1)((h,), jnp.float32))
+        self.register_buffer("weight_v", I.Normal(0, 1)((w,), jnp.float32))
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+
+    def __call__(self, weight):
+        mat = jnp.moveaxis(weight, self.dim, 0).reshape(weight.shape[self.dim], -1)
+        mat = mat.astype(jnp.float32)
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        # u/v are constants w.r.t. the gradient (reference no_grad buffers)
+        u = lax.stop_gradient(u)
+        v = lax.stop_gradient(v)
+        # persist the iteration so repeated eager calls converge; under jit
+        # u is a tracer and must not escape onto the module
+        if not isinstance(u, jax.core.Tracer):
+            object.__setattr__(self, "weight_u", u)
+            object.__setattr__(self, "weight_v", v)
+        sigma = u @ mat @ v
+        return (weight / sigma.astype(weight.dtype))

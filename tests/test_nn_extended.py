@@ -1,0 +1,194 @@
+"""Extended nn surface: new losses (incl. CTC vs torch), fold/shuffle,
+adaptive pools, interpolate modes — golden-checked against torch CPU."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def _np(x):
+    return np.asarray(x, np.float32)
+
+
+def test_ctc_loss_vs_torch():
+    rs = np.random.RandomState(0)
+    T, B, C, L = 12, 3, 5, 4
+    logits = rs.randn(T, B, C).astype(np.float32)
+    log_probs = tF.log_softmax(torch.tensor(logits), dim=-1)
+    labels = rs.randint(1, C, (B, L)).astype(np.int32)
+    input_lengths = np.array([12, 10, 8], np.int32)
+    label_lengths = np.array([4, 3, 2], np.int32)
+
+    want = tF.ctc_loss(log_probs, torch.tensor(labels.astype(np.int64)),
+                       torch.tensor(input_lengths.astype(np.int64)),
+                       torch.tensor(label_lengths.astype(np.int64)),
+                       blank=0, reduction="mean").item()
+    got = F.ctc_loss(jnp.asarray(log_probs.numpy()), jnp.asarray(labels),
+                     jnp.asarray(input_lengths), jnp.asarray(label_lengths))
+    assert np.allclose(float(got), want, rtol=1e-4), (float(got), want)
+
+    # zero-length label edge case
+    ll0 = np.array([4, 3, 0], np.int32)
+    want0 = tF.ctc_loss(log_probs, torch.tensor(labels.astype(np.int64)),
+                        torch.tensor(input_lengths.astype(np.int64)),
+                        torch.tensor(ll0.astype(np.int64)),
+                        blank=0, reduction="sum").item()
+    got0 = F.ctc_loss(jnp.asarray(log_probs.numpy()), jnp.asarray(labels),
+                      jnp.asarray(input_lengths), jnp.asarray(ll0),
+                      reduction="sum")
+    assert np.allclose(float(got0), want0, rtol=1e-4), (float(got0), want0)
+
+
+@pytest.mark.parametrize("name,args", [
+    ("soft_margin", {}),
+    ("multi_label_soft_margin", {}),
+    ("poisson_nll", {}),
+    ("gaussian_nll", {}),
+    ("multi_margin", {}),
+])
+def test_extra_losses_vs_torch(name, args):
+    rs = np.random.RandomState(1)
+    x = rs.randn(8, 6).astype(np.float32)
+    if name == "soft_margin":
+        y = rs.choice([-1.0, 1.0], (8, 6)).astype(np.float32)
+        want = tF.soft_margin_loss(torch.tensor(x), torch.tensor(y)).item()
+        got = F.soft_margin_loss(jnp.asarray(x), jnp.asarray(y))
+    elif name == "multi_label_soft_margin":
+        y = rs.randint(0, 2, (8, 6)).astype(np.float32)
+        want = tF.multilabel_soft_margin_loss(torch.tensor(x), torch.tensor(y)).item()
+        got = F.multi_label_soft_margin_loss(jnp.asarray(x), jnp.asarray(y))
+    elif name == "poisson_nll":
+        y = rs.poisson(3.0, (8, 6)).astype(np.float32)
+        want = tF.poisson_nll_loss(torch.tensor(x), torch.tensor(y), full=True).item()
+        got = F.poisson_nll_loss(jnp.asarray(x), jnp.asarray(y), full=True)
+    elif name == "gaussian_nll":
+        y = rs.randn(8, 6).astype(np.float32)
+        var = np.abs(rs.randn(8, 6)).astype(np.float32) + 0.1
+        want = tF.gaussian_nll_loss(torch.tensor(x), torch.tensor(y),
+                                    torch.tensor(var)).item()
+        got = F.gaussian_nll_loss(jnp.asarray(x), jnp.asarray(y), jnp.asarray(var))
+    else:  # multi_margin
+        y = rs.randint(0, 6, (8,))
+        want = tF.multi_margin_loss(torch.tensor(x), torch.tensor(y)).item()
+        got = F.multi_margin_loss(jnp.asarray(x), jnp.asarray(y.astype(np.int32)))
+    assert np.allclose(float(got), want, rtol=1e-4, atol=1e-5), (name, float(got), want)
+
+
+def test_fold_inverts_unfold():
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 3, 10, 8).astype(np.float32)
+    cols = F.unfold(jnp.asarray(x), kernel_size=3, stride=2, padding=1)
+    got = F.fold(cols, (10, 8), 3, strides=2, paddings=1)
+    want = tF.fold(tF.unfold(torch.tensor(x), 3, stride=2, padding=1),
+                   (10, 8), 3, stride=2, padding=1).numpy()
+    assert np.allclose(_np(got), want, atol=1e-5)
+
+
+def test_pixel_and_channel_shuffle():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 8, 4, 6).astype(np.float32)
+    assert np.allclose(_np(F.pixel_unshuffle(jnp.asarray(x), 2)),
+                       tF.pixel_unshuffle(torch.tensor(x), 2).numpy())
+    assert np.allclose(_np(F.channel_shuffle(jnp.asarray(x), 4)),
+                       tF.channel_shuffle(torch.tensor(x), 4).numpy())
+    # unshuffle inverts shuffle
+    y = F.pixel_shuffle(jnp.asarray(x), 2)
+    assert np.allclose(_np(F.pixel_unshuffle(y, 2)), x)
+
+
+def test_adaptive_pools_nondivisible():
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 3, 11).astype(np.float32)
+    got = F.adaptive_avg_pool1d(jnp.asarray(x), 4)
+    want = tF.adaptive_avg_pool1d(torch.tensor(x), 4).numpy()
+    assert np.allclose(_np(got), want, atol=1e-5)
+    got = F.adaptive_max_pool1d(jnp.asarray(x), 4)
+    want = tF.adaptive_max_pool1d(torch.tensor(x), 4).numpy()
+    assert np.allclose(_np(got), want, atol=1e-5)
+    x3 = rs.randn(2, 3, 5, 7, 9).astype(np.float32)
+    got = F.adaptive_avg_pool3d(jnp.asarray(x3), (2, 3, 4))
+    want = tF.adaptive_avg_pool3d(torch.tensor(x3), (2, 3, 4)).numpy()
+    assert np.allclose(_np(got), want, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode,align", [
+    ("nearest", False), ("bilinear", False), ("bilinear", True), ("area", False),
+    ("bicubic", False), ("bicubic", True),
+])
+def test_interpolate_2d_vs_torch(mode, align):
+    rs = np.random.RandomState(5)
+    x = rs.randn(2, 3, 7, 9).astype(np.float32)
+    kw = {} if mode in ("nearest", "area") else {"align_corners": align}
+    want = tF.interpolate(torch.tensor(x), size=(13, 5), mode=mode, **kw).numpy()
+    got = F.interpolate(jnp.asarray(x), size=(13, 5), mode=mode, align_corners=align)
+    assert np.allclose(_np(got), want, atol=1e-5), (mode, align)
+
+
+def test_interpolate_3d_5d():
+    rs = np.random.RandomState(6)
+    x1 = rs.randn(2, 3, 11).astype(np.float32)
+    want = tF.interpolate(torch.tensor(x1), size=5, mode="linear").numpy()
+    got = F.interpolate(jnp.asarray(x1), size=5, mode="linear")
+    assert np.allclose(_np(got), want, atol=1e-5)
+    x2 = rs.randn(1, 2, 4, 5, 6).astype(np.float32)
+    want = tF.interpolate(torch.tensor(x2), size=(8, 3, 4), mode="trilinear").numpy()
+    got = F.interpolate(jnp.asarray(x2), size=(8, 3, 4), mode="trilinear")
+    assert np.allclose(_np(got), want, atol=1e-5)
+
+
+def test_distance_layers():
+    rs = np.random.RandomState(7)
+    a = rs.randn(4, 8).astype(np.float32)
+    b = rs.randn(4, 8).astype(np.float32)
+    want = tF.cosine_similarity(torch.tensor(a), torch.tensor(b), dim=1).numpy()
+    got = nn.CosineSimilarity(axis=1)(jnp.asarray(a), jnp.asarray(b))
+    assert np.allclose(_np(got), want, atol=1e-5)
+    want = torch.nn.PairwiseDistance()(torch.tensor(a), torch.tensor(b)).numpy()
+    got = nn.PairwiseDistance()(jnp.asarray(a), jnp.asarray(b))
+    assert np.allclose(_np(got), want, atol=1e-4)
+
+
+def test_spectral_norm_layer():
+    rs = np.random.RandomState(8)
+    w = jnp.asarray(rs.randn(6, 4).astype(np.float32))
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=30)
+    wn = sn(w)
+    # largest singular value of the normalised weight ~= 1
+    s = np.linalg.svd(_np(wn), compute_uv=False)
+    assert abs(s[0] - 1.0) < 1e-3
+    # u/v persist across calls: power_iters=1 converges over repeated calls
+    sn1 = nn.SpectralNorm(w.shape, dim=0, power_iters=1)
+    for _ in range(30):
+        wn1 = sn1(w)
+    s1 = np.linalg.svd(_np(wn1), compute_uv=False)
+    assert abs(s1[0] - 1.0) < 1e-3
+
+
+def test_scale_factor_and_int_padding():
+    x = jnp.ones((1, 2, 4, 4))
+    assert F.interpolate(x, scale_factor=2.0, mode="bilinear").shape == (1, 2, 8, 8)
+    assert F.interpolate(x, scale_factor=0.5, mode="nearest").shape == (1, 2, 2, 2)
+    assert nn.ZeroPad2D(1)(x).shape == (1, 2, 6, 6)
+    assert nn.Pad3D(2)(jnp.ones((1, 2, 3, 3, 3))).shape == (1, 2, 7, 7, 7)
+    assert nn.Pad1D(1)(jnp.ones((1, 2, 3))).shape == (1, 2, 5)
+    # stability: large-magnitude soft margin stays finite
+    out = F.soft_margin_loss(jnp.asarray([90.0]), jnp.asarray([-1.0]))
+    assert np.isfinite(float(out))
+
+
+def test_misc_new_layers():
+    x = jnp.asarray(np.random.RandomState(9).randn(2, 6, 4, 4).astype(np.float32))
+    assert nn.ZeroPad2D([1, 1, 2, 2])(x).shape == (2, 6, 8, 6)
+    assert nn.Unflatten(1, (2, 3))(x).shape == (2, 2, 3, 4, 4)
+    assert nn.ChannelShuffle(3)(x).shape == x.shape
+    assert nn.InstanceNorm1D(6)(x[..., 0]).shape == (2, 6, 4)
+    assert nn.AdaptiveAvgPool3D(2)(jnp.ones((1, 2, 4, 4, 4))).shape == (1, 2, 2, 2, 2)
+    loss = nn.CTCLoss()
+    out = loss(jnp.zeros((5, 2, 4)), jnp.ones((2, 2), jnp.int32),
+               jnp.array([5, 5]), jnp.array([2, 2]))
+    assert np.isfinite(float(out))
