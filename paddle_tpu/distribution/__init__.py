@@ -1,0 +1,716 @@
+"""Probability distributions (ref: ``python/paddle/distribution/``).
+
+Same namespace and method surface as the reference (``sample``, ``rsample``,
+``log_prob``, ``prob``, ``entropy``, ``mean``, ``variance``,
+``kl_divergence``/``register_kl``), rebuilt on ``jax.random`` — samplers take
+an optional ``rng`` key and fall back to the framework's seeded global
+stream, so eager code matches the reference's stateful API while jitted code
+can thread keys explicitly.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.random import next_key
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal", "Gumbel",
+    "Geometric", "Multinomial", "Cauchy", "StudentT", "Poisson",
+    "TransformedDistribution", "Transform", "AffineTransform", "ExpTransform",
+    "SigmoidTransform", "TanhTransform", "PowerTransform", "ChainTransform",
+    "kl_divergence", "register_kl",
+]
+
+
+def _key(rng):
+    return rng if rng is not None else next_key()
+
+
+def _shape(shape):
+    return tuple(shape) if not isinstance(shape, int) else (shape,)
+
+
+class Distribution:
+    """Ref: python/paddle/distribution/distribution.py:Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=(), rng=None):
+        return jax.lax.stop_gradient(self.rsample(shape, rng=rng))
+
+    def rsample(self, shape=(), rng=None):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(_key(rng), shape)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape)
+
+    def cdf(self, value):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(rng), shape)
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None):
+        if probs is not None:
+            self.probs = jnp.asarray(probs, jnp.float32)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = jnp.asarray(logits, jnp.float32)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.bernoulli(_key(rng), self.probs, shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        # stable bernoulli log pmf from logits
+        return value * jax.nn.log_sigmoid(self.logits) + \
+            (1 - value) * jax.nn.log_sigmoid(-self.logits)
+
+    def entropy(self):
+        p = self.probs
+        return -(p * jnp.log(jnp.clip(p, 1e-12, None)) +
+                 (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12, None)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None):
+        if logits is None:
+            self.probs = jnp.asarray(probs, jnp.float32)
+            self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+            self.logits = jnp.log(jnp.clip(self.probs, 1e-12, None))
+        else:
+            self.logits = jnp.asarray(logits, jnp.float32)
+            self.probs = jax.nn.softmax(self.logits, axis=-1)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.categorical(_key(rng), self.logits, shape=shape)
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, value[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(self.probs * logp, axis=-1)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = jnp.asarray(alpha, jnp.float32)
+        self.beta = jnp.asarray(beta, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return self.alpha * self.beta / (t * t * (t + 1))
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.beta(_key(rng), self.alpha, self.beta, shape)
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        return ((self.alpha - 1) * jnp.log(value) +
+                (self.beta - 1) * jnp.log1p(-value) -
+                betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(-1, keepdims=True)
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.dirichlet(_key(rng), self.concentration, shape)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a = self.concentration
+        return (jnp.sum((a - 1) * jnp.log(value), axis=-1)
+                + gammaln(a.sum(-1)) - jnp.sum(gammaln(a), axis=-1))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        lnB = jnp.sum(gammaln(a), -1) - gammaln(a0)
+        return (lnB + (a0 - k) * digamma(a0)
+                - jnp.sum((a - 1) * digamma(a), axis=-1))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / self.rate ** 2
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.gamma(_key(rng), self.concentration, shape) / self.rate
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a, b = self.concentration, self.rate
+        return a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value - gammaln(a)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.concentration
+        return a - jnp.log(self.rate) + gammaln(a) + (1 - a) * digamma(a)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return 1 / self.rate
+
+    @property
+    def variance(self):
+        return 1 / self.rate ** 2
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.exponential(_key(rng), shape) / self.rate
+
+    def log_prob(self, value):
+        return jnp.log(self.rate) - self.rate * value
+
+    def entropy(self):
+        return jnp.broadcast_to(1 - jnp.log(self.rate), self.batch_shape)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return self.loc + self.scale * jax.random.laplace(_key(rng), shape)
+
+    def log_prob(self, value):
+        return -jnp.abs(value - self.loc) / self.scale - \
+            jnp.log(2 * self.scale)
+
+    def entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale), self.batch_shape)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)
+
+    def rsample(self, shape=(), rng=None):
+        return jnp.exp(self._base.rsample(shape, rng=rng))
+
+    def log_prob(self, value):
+        return self._base.log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        return self._base.entropy() + self.loc
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * jnp.float32(0.5772156649015329)
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return self.loc + self.scale * jax.random.gumbel(_key(rng), shape)
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1.5772156649015329,
+                                self.batch_shape)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (reference convention)."""
+
+    def __init__(self, probs):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return (1 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+    def sample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(rng), shape, minval=1e-7)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def log_prob(self, value):
+        return value * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        q = 1 - p
+        return -(q * jnp.log(q) + p * jnp.log(p)) / p
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs = jnp.asarray(probs, jnp.float32)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        logits = jnp.log(jnp.clip(self.probs, 1e-12, None))
+        draws = jax.random.categorical(
+            _key(rng), logits, shape=(self.total_count,) + shape)
+        k = self.probs.shape[-1]
+        return jax.nn.one_hot(draws, k).sum(0)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        logp = jnp.log(jnp.clip(self.probs, 1e-12, None))
+        return (gammaln(self.total_count + 1.0)
+                - jnp.sum(gammaln(value + 1.0), axis=-1)
+                + jnp.sum(value * logp, axis=-1))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return self.loc + self.scale * jax.random.cauchy(_key(rng), shape)
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z * z))
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                self.batch_shape)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = jnp.asarray(df, jnp.float32)
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return self.loc + self.scale * jax.random.t(_key(rng), self.df, shape)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        d = self.df
+        z = (value - self.loc) / self.scale
+        return (gammaln((d + 1) / 2) - gammaln(d / 2)
+                - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.poisson(_key(rng), self.rate, shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return value * jnp.log(self.rate) - self.rate - gammaln(value + 1.0)
+
+
+# -- transforms (ref python/paddle/distribution/transform.py) ----------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(power, jnp.float32)
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        ldj = 0.0
+        for t in self.transforms:
+            ldj = ldj + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return ldj
+
+
+class TransformedDistribution(Distribution):
+    """Ref: python/paddle/distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transform = (transforms if isinstance(transforms, Transform)
+                          else ChainTransform(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=(), rng=None):
+        return self.transform.forward(self.base.rsample(shape, rng=rng))
+
+    def sample(self, shape=(), rng=None):
+        return self.transform.forward(self.base.sample(shape, rng=rng))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        return self.base.log_prob(x) - self.transform.forward_log_det_jacobian(x)
+
+
+# -- KL divergence registry (ref python/paddle/distribution/kl.py) -----------
+
+_KL_REGISTRY: dict[tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    # +inf when p's support escapes q's
+    contained = (q.low <= p.low) & (p.high <= q.high)
+    return jnp.where(contained, kl, jnp.inf)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = p.probs * (jnp.log(jnp.clip(p.probs, 1e-12, None)) -
+                   jnp.log(jnp.clip(q.probs, 1e-12, None)))
+    b = (1 - p.probs) * (jnp.log(jnp.clip(1 - p.probs, 1e-12, None)) -
+                         jnp.log(jnp.clip(1 - q.probs, 1e-12, None)))
+    return a + b
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, axis=-1)
+    logq = jax.nn.log_softmax(q.logits, axis=-1)
+    return jnp.sum(p.probs * (logp - logq), axis=-1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return (betaln(a2, b2) - betaln(a1, b1)
+            + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+            + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    return (gammaln(a0) - gammaln(b.sum(-1))
+            + jnp.sum(gammaln(b) - gammaln(a), axis=-1)
+            + jnp.sum((a - b) * (digamma(a) - digamma(a0)[..., None]), axis=-1))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    return ((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+            + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 - b1) / b1)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return jnp.log(p.rate) - jnp.log(q.rate) + r - 1
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    t = jnp.abs(p.loc - q.loc)
+    return (jnp.log(q.scale) - jnp.log(p.scale)
+            + (p.scale * jnp.exp(-t / p.scale) + t) / q.scale - 1)

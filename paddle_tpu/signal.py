@@ -1,0 +1,99 @@
+"""Signal processing (ref: ``python/paddle/signal.py``): frame, overlap_add,
+stft, istft. Framing is a static-shape gather; the FFT is XLA-native — the
+whole pipeline jits and differentiates."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu import fft as _fft
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames. axis=-1: [..., seq] -> [..., frame_length,
+    n_frames]; axis=0: [seq, ...] -> [frame_length, n_frames, ...]
+    (reference layouts, python/paddle/signal.py:frame)."""
+    seq_first = axis in (0, -x.ndim)
+    if seq_first:
+        x = jnp.moveaxis(x, 0, -1)
+    seq = x.shape[-1]
+    n_frames = 1 + (seq - frame_length) // hop_length
+    idx = jnp.arange(n_frames)[:, None] * hop_length + jnp.arange(frame_length)[None, :]
+    frames = jnp.swapaxes(x[..., idx], -1, -2)  # [..., frame_length, n_frames]
+    if seq_first:
+        frames = jnp.moveaxis(jnp.moveaxis(frames, -1, 0), -1, 0)
+    return frames
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame. axis=-1: [..., frame_length, n_frames] -> [..., seq];
+    axis=0: [frame_length, n_frames, ...] -> [seq, ...]."""
+    seq_first = axis in (0, -x.ndim)
+    if seq_first:
+        x = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -1)
+    frame_length, n_frames = x.shape[-2], x.shape[-1]
+    seq = (n_frames - 1) * hop_length + frame_length
+    idx = jnp.arange(n_frames)[:, None] * hop_length + jnp.arange(frame_length)[None, :]
+    out = jnp.zeros(x.shape[:-2] + (seq,), x.dtype)
+    # scatter-add each frame back at its hop offset
+    out = out.at[..., idx].add(jnp.swapaxes(x, -1, -2))
+    if seq_first:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True):
+    """[..., seq] -> complex [..., n_freq, n_frames] (ref: paddle.signal.stft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:  # center-pad window to n_fft
+        lp = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lp, n_fft - win_length - lp))
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = frame(x, n_fft, hop_length)  # [..., n_fft, n_frames]
+    frames = frames * window[:, None]
+    spec = (_fft.rfft if onesided else _fft.fft)(
+        jnp.swapaxes(frames, -1, -2), axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False):
+    """Inverse stft with window-envelope normalisation (ref: paddle.signal.istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lp, n_fft - win_length - lp))
+    spec = jnp.swapaxes(x, -1, -2)  # [..., n_frames, n_freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1)
+        frames = frames if return_complex else frames.real
+    frames = frames * window
+    y = overlap_add(jnp.swapaxes(frames, -1, -2), hop_length)
+    # normalise by the summed squared window envelope
+    wsq = overlap_add(
+        jnp.broadcast_to((window ** 2)[:, None],
+                         (n_fft, x.shape[-1])), hop_length)
+    y = y / jnp.maximum(wsq, 1e-11)
+    if center:
+        y = y[..., n_fft // 2:]
+        end = length if length is not None else y.shape[-1] - n_fft // 2
+        y = y[..., :end]
+    elif length is not None:
+        y = y[..., :length]
+    return y

@@ -1,0 +1,155 @@
+"""paddle_tpu.distribution vs torch.distributions golden values."""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.distribution as D
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    pt.seed(0)
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x, np.float32))
+
+
+PAIRS = [
+    (lambda: D.Normal(0.5, 1.3), lambda: td.Normal(_t(0.5), _t(1.3))),
+    (lambda: D.Uniform(-1.0, 2.0), lambda: td.Uniform(_t(-1.0), _t(2.0))),
+    (lambda: D.Laplace(0.3, 0.8), lambda: td.Laplace(_t(0.3), _t(0.8))),
+    (lambda: D.Gumbel(0.1, 1.2), lambda: td.Gumbel(_t(0.1), _t(1.2))),
+    (lambda: D.Exponential(1.7), lambda: td.Exponential(_t(1.7))),
+    (lambda: D.Gamma(2.0, 3.0), lambda: td.Gamma(_t(2.0), _t(3.0))),
+    (lambda: D.Beta(2.0, 3.0), lambda: td.Beta(_t(2.0), _t(3.0))),
+    (lambda: D.LogNormal(0.2, 0.5), lambda: td.LogNormal(_t(0.2), _t(0.5))),
+    (lambda: D.Cauchy(0.0, 1.0), lambda: td.Cauchy(_t(0.0), _t(1.0))),
+    (lambda: D.StudentT(5.0, 0.1, 1.1), lambda: td.StudentT(_t(5.0), _t(0.1), _t(1.1))),
+]
+
+
+@pytest.mark.parametrize("mk_p,mk_t", PAIRS,
+                         ids=[p[0]().__class__.__name__ for p in PAIRS])
+def test_log_prob_matches_torch(mk_p, mk_t):
+    p, t = mk_p(), mk_t()
+    # evaluate inside each distribution's support
+    lo = {"Uniform": -0.9, "Exponential": 0.1, "Gamma": 0.1, "Beta": 0.05,
+          "LogNormal": 0.1}.get(type(p).__name__, -2.0)
+    hi = {"Uniform": 1.9, "Beta": 0.95}.get(type(p).__name__, 3.0)
+    xs = np.linspace(lo, hi, 7).astype(np.float32)
+    got = np.asarray(p.log_prob(jnp.asarray(xs)))
+    want = t.log_prob(torch.tensor(xs)).numpy()
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-5), type(p).__name__
+    if hasattr(t, "entropy") and type(p).__name__ not in ("StudentT",):
+        try:
+            want_e = t.entropy().numpy()
+        except NotImplementedError:
+            return
+        got_e = np.asarray(p.entropy())
+        assert np.allclose(got_e, want_e, rtol=1e-4, atol=1e-5), type(p).__name__
+
+
+def test_discrete_log_prob():
+    b = D.Bernoulli(probs=0.3)
+    tb = td.Bernoulli(_t(0.3))
+    for v in (0.0, 1.0):
+        assert np.allclose(float(b.log_prob(jnp.asarray(v))),
+                           tb.log_prob(_t(v)).item(), rtol=1e-5)
+    c = D.Categorical(logits=jnp.asarray([0.1, 0.5, -0.3]))
+    tc = td.Categorical(logits=_t([0.1, 0.5, -0.3]))
+    for v in range(3):
+        assert np.allclose(float(c.log_prob(jnp.asarray(v))),
+                           tc.log_prob(torch.tensor(v)).item(), rtol=1e-5)
+    assert np.allclose(float(c.entropy()), tc.entropy().item(), rtol=1e-5)
+    g = D.Geometric(0.4)
+    tg = td.Geometric(_t(0.4))
+    assert np.allclose(float(g.log_prob(jnp.asarray(3.0))),
+                       tg.log_prob(_t(3.0)).item(), rtol=1e-5)
+    po = D.Poisson(2.5)
+    tp = td.Poisson(_t(2.5))
+    assert np.allclose(float(po.log_prob(jnp.asarray(4.0))),
+                       tp.log_prob(_t(4.0)).item(), rtol=1e-5)
+    m = D.Multinomial(5, jnp.asarray([0.2, 0.3, 0.5]))
+    tm = td.Multinomial(5, probs=_t([0.2, 0.3, 0.5]))
+    v = np.array([1.0, 2.0, 2.0], np.float32)
+    assert np.allclose(float(m.log_prob(jnp.asarray(v))),
+                       tm.log_prob(torch.tensor(v)).item(), rtol=1e-5)
+    d = D.Dirichlet(jnp.asarray([1.0, 2.0, 3.0]))
+    tdd = td.Dirichlet(_t([1.0, 2.0, 3.0]))
+    v = np.array([0.2, 0.3, 0.5], np.float32)
+    assert np.allclose(float(d.log_prob(jnp.asarray(v))),
+                       tdd.log_prob(torch.tensor(v)).item(), rtol=1e-4)
+    assert np.allclose(float(d.entropy()), tdd.entropy().item(), rtol=1e-4)
+
+
+def test_sampling_moments():
+    n = D.Normal(jnp.asarray([0.0, 2.0]), jnp.asarray([1.0, 0.5]))
+    s = n.sample((20000,), rng=jax.random.PRNGKey(0))
+    assert s.shape == (20000, 2)
+    assert np.allclose(np.asarray(s.mean(0)), [0.0, 2.0], atol=0.05)
+    assert np.allclose(np.asarray(s.std(0)), [1.0, 0.5], atol=0.05)
+    g = D.Gamma(3.0, 2.0).sample((20000,), rng=jax.random.PRNGKey(1))
+    assert abs(float(g.mean()) - 1.5) < 0.05
+    c = D.Categorical(probs=jnp.asarray([0.2, 0.8]))
+    cs = c.sample((10000,), rng=jax.random.PRNGKey(2))
+    assert abs(float((cs == 1).mean()) - 0.8) < 0.02
+    m = D.Multinomial(10, jnp.asarray([0.5, 0.5])).sample(
+        (100,), rng=jax.random.PRNGKey(3))
+    assert np.all(np.asarray(m.sum(-1)) == 10)
+    # rsample is differentiable (reparameterised)
+    grad = jax.grad(lambda mu: D.Normal(mu, 1.0).rsample(
+        (100,), rng=jax.random.PRNGKey(4)).mean())(0.0)
+    assert abs(float(grad) - 1.0) < 1e-5
+
+
+@pytest.mark.parametrize("mk_p,mk_q,mk_tp,mk_tq", [
+    (lambda: D.Normal(0.0, 1.0), lambda: D.Normal(1.0, 2.0),
+     lambda: td.Normal(_t(0.0), _t(1.0)), lambda: td.Normal(_t(1.0), _t(2.0))),
+    (lambda: D.Beta(2.0, 3.0), lambda: D.Beta(4.0, 1.5),
+     lambda: td.Beta(_t(2.0), _t(3.0)), lambda: td.Beta(_t(4.0), _t(1.5))),
+    (lambda: D.Gamma(2.0, 1.0), lambda: D.Gamma(3.0, 2.0),
+     lambda: td.Gamma(_t(2.0), _t(1.0)), lambda: td.Gamma(_t(3.0), _t(2.0))),
+    (lambda: D.Exponential(1.0), lambda: D.Exponential(2.5),
+     lambda: td.Exponential(_t(1.0)), lambda: td.Exponential(_t(2.5))),
+    (lambda: D.Laplace(0.0, 1.0), lambda: D.Laplace(0.5, 2.0),
+     lambda: td.Laplace(_t(0.0), _t(1.0)), lambda: td.Laplace(_t(0.5), _t(2.0))),
+    (lambda: D.Bernoulli(probs=0.3), lambda: D.Bernoulli(probs=0.6),
+     lambda: td.Bernoulli(_t(0.3)), lambda: td.Bernoulli(_t(0.6))),
+], ids=["normal", "beta", "gamma", "exponential", "laplace", "bernoulli"])
+def test_kl_matches_torch(mk_p, mk_q, mk_tp, mk_tq):
+    got = float(D.kl_divergence(mk_p(), mk_q()))
+    want = td.kl_divergence(mk_tp(), mk_tq()).item()
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_transformed_distribution():
+    base = D.Normal(0.0, 1.0)
+    # exp(Normal) == LogNormal
+    tdist = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.0, 1.0)
+    xs = jnp.asarray([0.5, 1.0, 2.0])
+    assert np.allclose(np.asarray(tdist.log_prob(xs)),
+                       np.asarray(ln.log_prob(xs)), rtol=1e-5)
+    # affine(Normal) == shifted/scaled Normal
+    tdist2 = D.TransformedDistribution(base, [D.AffineTransform(2.0, 3.0)])
+    n2 = D.Normal(2.0, 3.0)
+    assert np.allclose(np.asarray(tdist2.log_prob(xs)),
+                       np.asarray(n2.log_prob(xs)), rtol=1e-5)
+    # tanh transform round-trip + jacobian sanity vs torch
+    tt = D.TanhTransform()
+    x = jnp.asarray([-1.5, 0.0, 0.7])
+    assert np.allclose(np.asarray(tt.inverse(tt.forward(x))), np.asarray(x), atol=1e-5)
+    want = td.TanhTransform().log_abs_det_jacobian(
+        torch.tensor(np.asarray(x)), torch.tensor(np.tanh(np.asarray(x)))).numpy()
+    assert np.allclose(np.asarray(tt.forward_log_det_jacobian(x)), want, atol=1e-5)
+
+
+def test_kl_unregistered_raises():
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))

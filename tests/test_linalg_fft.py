@@ -1,0 +1,169 @@
+"""paddle_tpu.linalg / fft / signal vs numpy + torch golden values."""
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import fft as pfft
+from paddle_tpu import linalg as L
+from paddle_tpu import signal as S
+
+
+def _spd(n, rs):
+    a = rs.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+rs = np.random.RandomState(0)
+
+
+def test_cholesky_and_solve():
+    a = _spd(5, rs)
+    b = rs.randn(5, 3).astype(np.float32)
+    low = L.cholesky(jnp.asarray(a))
+    assert np.allclose(np.asarray(low @ low.T), a, atol=1e-3)
+    up = L.cholesky(jnp.asarray(a), upper=True)
+    assert np.allclose(np.asarray(up), np.asarray(low).T, atol=1e-5)
+    x = L.cholesky_solve(jnp.asarray(b), low)
+    assert np.allclose(np.asarray(jnp.asarray(a) @ x), b, atol=1e-3)
+    x2 = L.solve(jnp.asarray(a), jnp.asarray(b))
+    assert np.allclose(np.asarray(x2), np.linalg.solve(a, b), atol=1e-3)
+
+
+def test_det_inv_pinv_rank():
+    a = _spd(4, rs)
+    assert np.allclose(float(L.det(jnp.asarray(a))), np.linalg.det(a), rtol=1e-3)
+    sign, logabs = L.slogdet(jnp.asarray(a))
+    s2, l2 = np.linalg.slogdet(a)
+    assert float(sign) == s2 and np.allclose(float(logabs), l2, rtol=1e-4)
+    assert np.allclose(np.asarray(L.inv(jnp.asarray(a))), np.linalg.inv(a), atol=1e-4)
+    r = rs.randn(6, 3).astype(np.float32)
+    assert np.allclose(np.asarray(L.pinv(jnp.asarray(r))), np.linalg.pinv(r), atol=1e-4)
+    assert int(L.matrix_rank(jnp.asarray(r))) == np.linalg.matrix_rank(r)
+
+
+def test_qr_svd_eigh():
+    a = rs.randn(6, 4).astype(np.float32)
+    q, r = L.qr(jnp.asarray(a))
+    assert np.allclose(np.asarray(q @ r), a, atol=1e-4)
+    assert np.allclose(np.asarray(q.T @ q), np.eye(4), atol=1e-4)
+    u, s, vh = L.svd(jnp.asarray(a))
+    assert np.allclose(np.asarray((u * s) @ vh), a, atol=1e-4)
+    assert np.allclose(np.asarray(L.svdvals(jnp.asarray(a))),
+                       np.linalg.svd(a, compute_uv=False), atol=1e-4)
+    spd = _spd(5, rs)
+    w, v = L.eigh(jnp.asarray(spd))
+    assert np.allclose(np.asarray(v @ jnp.diag(w) @ v.T), spd, atol=1e-2)
+
+
+def test_eig_host_callback():
+    a = rs.randn(5, 5).astype(np.float32)
+    w, v = L.eig(jnp.asarray(a))
+    # A v = v diag(w)
+    assert np.allclose(np.asarray(jnp.asarray(a).astype(jnp.complex64) @ v),
+                       np.asarray(v @ jnp.diag(w)), atol=1e-3)
+    wv = L.eigvals(jnp.asarray(a))
+    assert np.allclose(sorted(np.asarray(w).real), sorted(np.asarray(wv).real), atol=1e-3)
+    # works under jit too (pure_callback)
+    wj = jax.jit(L.eigvals)(jnp.asarray(a))
+    assert np.allclose(sorted(np.asarray(wj).real), sorted(np.asarray(wv).real), atol=1e-3)
+
+
+def test_lu_and_unpack():
+    a = rs.randn(5, 5).astype(np.float32)
+    lu_data, piv = L.lu(jnp.asarray(a))
+    P, Lo, U = L.lu_unpack(lu_data, piv)
+    assert np.allclose(np.asarray(P @ Lo @ U), a, atol=1e-4)
+
+
+def test_householder_product_vs_torch():
+    a = rs.randn(6, 4).astype(np.float32)
+    ta, tau = torch.geqrf(torch.tensor(a))
+    want = torch.linalg.householder_product(ta, tau).numpy()
+    got = L.householder_product(jnp.asarray(ta.numpy()), jnp.asarray(tau.numpy()))
+    assert np.allclose(np.asarray(got), want, atol=1e-4)
+
+
+def test_lstsq_triangular_matrix_fns():
+    a = rs.randn(8, 3).astype(np.float32)
+    b = rs.randn(8, 2).astype(np.float32)
+    sol, _, _, _ = L.lstsq(jnp.asarray(a), jnp.asarray(b))
+    want, *_ = np.linalg.lstsq(a, b, rcond=None)
+    assert np.allclose(np.asarray(sol), want, atol=1e-3)
+    tri = np.triu(_spd(4, rs))
+    y = rs.randn(4, 2).astype(np.float32)
+    x = L.triangular_solve(jnp.asarray(tri), jnp.asarray(y), upper=True)
+    assert np.allclose(np.asarray(jnp.asarray(tri) @ x), y, atol=1e-3)
+    m = rs.randn(3, 3).astype(np.float32) * 0.1
+    assert np.allclose(np.asarray(L.matrix_exp(jnp.asarray(m))),
+                       torch.matrix_exp(torch.tensor(m)).numpy(), atol=1e-4)
+    assert np.allclose(np.asarray(L.matrix_power(jnp.asarray(m), 3)),
+                       np.linalg.matrix_power(m, 3), atol=1e-5)
+
+
+def test_norms_cond_cov():
+    a = rs.randn(4, 5).astype(np.float32)
+    for p in ["fro", "nuc", 1, 2, np.inf]:
+        want = np.asarray(torch.linalg.matrix_norm(torch.tensor(a), ord=p))
+        got = L.norm(jnp.asarray(a), p=p, axis=(-2, -1))
+        assert np.allclose(np.asarray(got), want, rtol=1e-4), p
+    v = rs.randn(7).astype(np.float32)
+    assert np.allclose(float(L.vector_norm(jnp.asarray(v), p=3)),
+                       np.sum(np.abs(v) ** 3) ** (1 / 3), rtol=1e-4)
+    spd = _spd(4, rs)
+    assert np.allclose(float(L.cond(jnp.asarray(spd))), np.linalg.cond(spd), rtol=1e-3)
+    x = rs.randn(3, 10).astype(np.float32)
+    assert np.allclose(np.asarray(L.cov(jnp.asarray(x))), np.cov(x), atol=1e-4)
+    assert np.allclose(np.asarray(L.corrcoef(jnp.asarray(x))), np.corrcoef(x), atol=1e-4)
+    assert np.allclose(float(L.dist(jnp.asarray(v), jnp.zeros(7))),
+                       np.linalg.norm(v), rtol=1e-5)
+    ms = [jnp.asarray(rs.randn(3, 4).astype(np.float32)),
+          jnp.asarray(rs.randn(4, 5).astype(np.float32)),
+          jnp.asarray(rs.randn(5, 2).astype(np.float32))]
+    assert np.allclose(np.asarray(L.multi_dot(ms)),
+                       np.asarray(ms[0]) @ np.asarray(ms[1]) @ np.asarray(ms[2]),
+                       atol=1e-4)
+
+
+# -- fft ---------------------------------------------------------------------
+
+def test_fft_roundtrip_and_golden():
+    x = rs.randn(4, 16).astype(np.float32)
+    X = pfft.fft(jnp.asarray(x))
+    assert np.allclose(np.asarray(X), np.fft.fft(x), atol=1e-4)
+    assert np.allclose(np.asarray(pfft.ifft(X)).real, x, atol=1e-5)
+    Xr = pfft.rfft(jnp.asarray(x), norm="ortho")
+    assert np.allclose(np.asarray(Xr), np.fft.rfft(x, norm="ortho"), atol=1e-4)
+    assert np.allclose(np.asarray(pfft.irfft(Xr, norm="ortho")), x, atol=1e-5)
+    x2 = rs.randn(3, 8, 8).astype(np.float32)
+    assert np.allclose(np.asarray(pfft.fft2(jnp.asarray(x2))), np.fft.fft2(x2), atol=1e-3)
+    assert np.allclose(np.asarray(pfft.fftshift(jnp.asarray(x))), np.fft.fftshift(x))
+    assert np.allclose(np.asarray(pfft.fftfreq(10, 0.1)), np.fft.fftfreq(10, 0.1))
+    assert np.allclose(np.asarray(pfft.rfftfreq(10)), np.fft.rfftfreq(10))
+
+
+# -- signal ------------------------------------------------------------------
+
+def test_frame_overlap_add_roundtrip():
+    x = rs.randn(2, 32).astype(np.float32)
+    fr = S.frame(jnp.asarray(x), 8, 8)  # non-overlapping
+    assert fr.shape == (2, 8, 4)
+    back = S.overlap_add(fr, 8)
+    assert np.allclose(np.asarray(back), x, atol=1e-6)
+
+
+def test_stft_istft_vs_torch():
+    x = rs.randn(2, 64).astype(np.float32)
+    win = np.hanning(16).astype(np.float32)
+    got = S.stft(jnp.asarray(x), n_fft=16, hop_length=4, window=jnp.asarray(win))
+    want = torch.stft(torch.tensor(x), n_fft=16, hop_length=4,
+                      window=torch.tensor(win), return_complex=True,
+                      center=True, pad_mode="reflect").numpy()
+    assert got.shape == want.shape
+    assert np.allclose(np.asarray(got), want, atol=1e-3)
+    # istft round-trips
+    rec = S.istft(got, n_fft=16, hop_length=4, window=jnp.asarray(win),
+                  length=64)
+    assert np.allclose(np.asarray(rec), x, atol=1e-3)
