@@ -169,7 +169,16 @@ def matrix_power(x, n):
 
 
 def matrix_rank(x, tol=None, hermitian=False):
-    return jnp.linalg.matrix_rank(x, rtol=tol)
+    """Count of singular values above ``tol`` — ``tol`` is ABSOLUTE
+    (reference semantics), default eps-scaled like numpy."""
+    if hermitian:
+        s = jnp.abs(jnp.linalg.eigvalsh(x))
+    else:
+        s = jnp.linalg.svd(x, compute_uv=False)
+    if tol is None:
+        eps = jnp.finfo(x.dtype).eps
+        tol = s.max(axis=-1, keepdims=True) * max(x.shape[-2:]) * eps
+    return jnp.sum(s > tol, axis=-1)
 
 
 def householder_product(x, tau):
@@ -198,28 +207,41 @@ def cond(x, p=None):
     return norm(x, p=p, axis=(-2, -1)) * norm(inv(x), p=p, axis=(-2, -1))
 
 
+def _keep_all_dims(val, ndim):
+    return val.reshape((1,) * ndim)
+
+
 def norm(x, p=None, axis=None, keepdim=False):
     """Unified vector/matrix norm (ref: paddle.linalg.norm)."""
     if p == "fro":
         ax = tuple(axis) if isinstance(axis, (tuple, list)) else \
             (axis,) if axis is not None else None
-        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=ax,
-                                keepdims=keepdim))
+        out = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=ax,
+                               keepdims=keepdim))
+        return _keep_all_dims(out, x.ndim) if keepdim and ax is None else out
     if p == "nuc":
-        return jnp.sum(svdvals(x), axis=-1, keepdims=keepdim)
+        ax = tuple(a % x.ndim for a in axis) if isinstance(axis, (tuple, list)) \
+            else (x.ndim - 2, x.ndim - 1)
+        xm = jnp.moveaxis(x, ax, (-2, -1))
+        out = jnp.sum(jnp.linalg.svd(xm, compute_uv=False), axis=-1)
+        if keepdim:
+            out = jnp.expand_dims(jnp.expand_dims(out, -1), -1)
+            out = jnp.moveaxis(out, (-2, -1), ax)
+        return out
     if isinstance(axis, (tuple, list)) and len(axis) == 2:
         return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
     if p is None:
         p = 2
     if axis is None:
-        return jnp.linalg.norm(x.reshape(-1), ord=p, keepdims=keepdim)
+        out = jnp.linalg.norm(x.reshape(-1), ord=p)
+        return _keep_all_dims(out, x.ndim) if keepdim else out
     return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
 
 
 def vector_norm(x, p=2, axis=None, keepdim=False):
     if axis is None:
-        x = x.reshape(-1)
-        axis = 0
+        out = jnp.linalg.norm(x.reshape(-1), ord=p)
+        return _keep_all_dims(out, x.ndim) if keepdim else out
     return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
 
 

@@ -85,6 +85,15 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
     return logits, new_cache
 
 
+def _apply_repetition_penalty(logits, appeared, penalty):
+    """CTRL-style penalty (ref PaddleNLP GenerationMixin): divide positive
+    scores / multiply negative scores of already-generated tokens."""
+    if penalty == 1.0:
+        return logits
+    penalised = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(appeared, penalised, logits)
+
+
 def _sample(logits, rng, temperature, top_k, top_p):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
@@ -103,8 +112,10 @@ def _sample(logits, rng, temperature, top_k, top_p):
 
 
 def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=None,
-             top_p=None, eos_token_id=None, rng=None):
-    """Greedy/temperature/top-k/top-p decoding (ref PaddleNLP GenerationMixin).
+             top_p=None, eos_token_id=None, rng=None, repetition_penalty=1.0,
+             min_new_tokens=0):
+    """Greedy/temperature/top-k/top-p decoding (ref PaddleNLP GenerationMixin)
+    with repetition penalty and min-length constraint.
 
     One jitted while_loop; returns [B, prompt+max_new_tokens].
     """
@@ -117,34 +128,163 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=None,
                          cfg.num_key_value_heads,
                          cfg.hidden_size // cfg.num_attention_heads, cfg.dtype)
 
+    def constrain(logits, appeared, gen_len):
+        logits = _apply_repetition_penalty(logits, appeared, repetition_penalty)
+        if eos_token_id is not None and min_new_tokens > 0:
+            logits = jnp.where(
+                (gen_len < min_new_tokens)
+                & (jnp.arange(logits.shape[-1]) == eos_token_id)[None, :],
+                -1e30, logits)
+        return logits
+
     @jax.jit
     def run(model, input_ids, cache, rng):
+        vocab = cfg.vocab_size
+        appeared = jnp.zeros((b, vocab), bool)
+        appeared = appeared.at[jnp.arange(b)[:, None], input_ids].set(True)
         logits, cache = llama_forward_with_cache(model, input_ids, cache, 0)
-        next_tok = _sample(logits[:, -1], rng, temperature, top_k, top_p)
+        logits = constrain(logits[:, -1].astype(jnp.float32), appeared, 0)
+        next_tok = _sample(logits, rng, temperature, top_k, top_p)
+        appeared = appeared.at[jnp.arange(b), next_tok].set(True)
         tokens = jnp.concatenate(
             [input_ids, jnp.zeros((b, max_new_tokens), input_ids.dtype)], axis=1)
         tokens = tokens.at[:, prompt_len].set(next_tok)
         done = jnp.zeros((b,), bool) if eos_token_id is None else (next_tok == eos_token_id)
 
         def cond(state):
-            i, tokens, cache, rng, done = state
+            i, tokens, cache, rng, done, appeared = state
             return jnp.logical_and(i < max_new_tokens - 1, ~jnp.all(done))
 
         def body(state):
-            i, tokens, cache, rng, done = state
+            i, tokens, cache, rng, done, appeared = state
             rng, sub = jax.random.split(rng)
             cur = lax.dynamic_slice_in_dim(tokens, prompt_len + i, 1, axis=1)
             logits, cache = llama_forward_with_cache(model, cur, cache, prompt_len + i)
-            nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+            logits = constrain(logits[:, -1].astype(jnp.float32), appeared, i + 1)
+            nxt = _sample(logits, sub, temperature, top_k, top_p)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
                 done = done | (nxt == eos_token_id)
+            appeared = appeared.at[jnp.arange(b), nxt].set(True)
             tokens = lax.dynamic_update_slice_in_dim(
                 tokens, nxt[:, None], prompt_len + i + 1, axis=1)
-            return (i + 1, tokens, cache, rng, done)
+            return (i + 1, tokens, cache, rng, done, appeared)
 
-        state = (jnp.zeros((), jnp.int32), tokens, cache, rng, done)
-        _, tokens, _, _, _ = lax.while_loop(cond, body, state)
+        state = (jnp.zeros((), jnp.int32), tokens, cache, rng, done, appeared)
+        _, tokens, _, _, _, _ = lax.while_loop(cond, body, state)
         return tokens
 
     return run(model, input_ids, cache, rng)
+
+
+def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
+                length_penalty=1.0, eos_token_id=None):
+    """Beam search with a beam-gathered KV cache (ref: PaddleNLP
+    ``GenerationMixin.beam_search`` / ``BeamSearchScorer``).
+
+    TPU-native: beams live in a [B*K] leading dim so every step is one
+    batched forward; beam reordering is a gather on the cache pytree inside
+    ``lax.scan`` — static shapes, single compile.
+
+    Returns (sequences [B, prompt+max_new], scores [B]) — the best finished
+    hypothesis per batch (length-penalised log prob, PaddleNLP convention
+    ``sum logp / len**alpha``).
+    """
+    cfg = model.cfg
+    b, prompt_len = input_ids.shape
+    K, V = num_beams, cfg.vocab_size
+    max_len = prompt_len + max_new_tokens
+    NEG = jnp.float32(-1e9)
+
+    cache = KVCache.init(cfg.num_hidden_layers, b, max_len,
+                         cfg.num_key_value_heads,
+                         cfg.hidden_size // cfg.num_attention_heads, cfg.dtype)
+
+    def gather_beams(tree, beam_idx):
+        """tree leaves [B*K, ...] reordered by beam_idx [B, K] (scalar leaves
+        like the cache length pass through)."""
+        def g(x):
+            if jnp.ndim(x) == 0:
+                return x
+            xk = x.reshape((b, K) + x.shape[1:])
+            idx = beam_idx.reshape((b, K) + (1,) * (x.ndim - 1))
+            return jnp.take_along_axis(xk, idx, axis=1).reshape(x.shape)
+        return jax.tree_util.tree_map(g, tree)
+
+    @jax.jit
+    def run(model, input_ids, cache):
+        # prefill ONCE at batch B (beams are byte-identical pre-fork), then
+        # tile the cache along a beam axis
+        logits, cache = llama_forward_with_cache(model, input_ids, cache, 0)
+        cache = jax.tree_util.tree_map(
+            lambda x: x if jnp.ndim(x) == 0 else jnp.repeat(x, K, axis=0), cache)
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        logp = jnp.broadcast_to(logp[:, None, :], (b, K, V))
+
+        # beam 0 starts live, the rest masked so step 0 picks K distinct tokens
+        running_lp = jnp.tile(jnp.array([0.0] + [NEG] * (K - 1)), (b, 1))
+        seqs = jnp.zeros((b, K, max_len), input_ids.dtype)
+        seqs = seqs.at[:, :, :prompt_len].set(input_ids[:, None, :])
+        fin_seqs = jnp.zeros_like(seqs)
+        fin_scores = jnp.full((b, K), NEG)
+
+        def select(running_lp, seqs, fin_seqs, fin_scores, logp, i):
+            """One beam expansion: place token i, split candidates into
+            finished (eos) and running pools."""
+            total = running_lp[:, :, None] + logp  # [B, K, V]
+            cand_lp, cand_idx = lax.top_k(total.reshape(b, K * V), 2 * K)
+            beam = cand_idx // V  # [B, 2K]
+            tok = cand_idx % V
+            cand_seqs = jnp.take_along_axis(seqs, beam[:, :, None], axis=1)
+            cand_seqs = cand_seqs.at[:, :, prompt_len + i].set(tok)
+
+            if eos_token_id is not None:
+                is_eos = tok == eos_token_id
+            else:
+                is_eos = jnp.zeros_like(tok, bool)
+            # finished pool: merge newly-finished candidates, keep top K
+            cand_score = cand_lp / ((i + 1.0) ** length_penalty)
+            all_scores = jnp.concatenate(
+                [fin_scores, jnp.where(is_eos, cand_score, NEG)], axis=1)
+            all_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)
+            fin_scores, fin_idx = lax.top_k(all_scores, K)
+            fin_seqs = jnp.take_along_axis(all_seqs, fin_idx[:, :, None], axis=1)
+
+            # running pool: best K non-eos candidates
+            run_lp_cand = jnp.where(is_eos, NEG, cand_lp)
+            running_lp, run_idx = lax.top_k(run_lp_cand, K)
+            seqs = jnp.take_along_axis(cand_seqs, run_idx[:, :, None], axis=1)
+            new_beam = jnp.take_along_axis(beam, run_idx, axis=1)  # [B, K]
+            new_tok = jnp.take_along_axis(tok, run_idx, axis=1)
+            return running_lp, seqs, fin_seqs, fin_scores, new_beam, new_tok
+
+        def step(carry, i):
+            running_lp, seqs, fin_seqs, fin_scores, cache, logp = carry
+            running_lp, seqs, fin_seqs, fin_scores, new_beam, new_tok = select(
+                running_lp, seqs, fin_seqs, fin_scores, logp, i)
+            cache = gather_beams(cache, new_beam)
+            cur = new_tok.reshape(b * K, 1)
+            logits, cache = llama_forward_with_cache(
+                model, cur, cache, prompt_len + i)
+            logp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1).reshape(b, K, V)
+            return (running_lp, seqs, fin_seqs, fin_scores, cache, logp), None
+
+        carry = (running_lp, seqs, fin_seqs, fin_scores, cache, logp)
+        (running_lp, seqs, fin_seqs, fin_scores, _, logp), _ = lax.scan(
+            step, carry, jnp.arange(max_new_tokens - 1))
+        # last token: pure selection, no forward needed after it
+        running_lp, seqs, fin_seqs, fin_scores, _, _ = select(
+            running_lp, seqs, fin_seqs, fin_scores, logp, max_new_tokens - 1)
+
+        # merge still-running beams (at full length) with the finished pool
+        run_score = running_lp / (float(max_new_tokens) ** length_penalty)
+        all_scores = jnp.concatenate([fin_scores, run_score], axis=1)
+        all_seqs = jnp.concatenate([fin_seqs, seqs], axis=1)
+        best = jnp.argmax(all_scores, axis=1)
+        best_seqs = jnp.take_along_axis(
+            all_seqs, best[:, None, None], axis=1)[:, 0]
+        best_scores = jnp.take_along_axis(all_scores, best[:, None], axis=1)[:, 0]
+        return best_seqs, best_scores
+
+    return run(model, input_ids, cache)

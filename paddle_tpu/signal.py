@@ -79,6 +79,10 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     if normalized:
         spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
     if onesided:
+        if return_complex:
+            raise ValueError(
+                "return_complex=True requires onesided=False (reference "
+                "paddle.signal.istft raises for this combination)")
         frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
     else:
         frames = jnp.fft.ifft(spec, axis=-1)

@@ -1,0 +1,87 @@
+"""Beam search + generation constraints (ref PaddleNLP GenerationMixin)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.decoding import beam_search, generate
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    return LlamaForCausalLM(cfg).eval()
+
+
+def _seq_logprob(model, seq, prompt_len):
+    """Sum log p(token | prefix) over generated positions."""
+    logits = model(seq[None, :])  # [1, L, V]
+    logp = jax.nn.log_softmax(np.asarray(logits, np.float32), axis=-1)
+    total = 0.0
+    for t in range(prompt_len, seq.shape[0]):
+        total += logp[0, t - 1, int(seq[t])]
+    return total
+
+
+def test_beam1_equals_greedy(model):
+    prompt = jnp.asarray(np.random.RandomState(0).randint(
+        0, model.cfg.vocab_size, (2, 4)))
+    greedy = generate(model, prompt, max_new_tokens=6, temperature=0.0)
+    beam, _ = beam_search(model, prompt, max_new_tokens=6, num_beams=1)
+    assert np.array_equal(np.asarray(greedy), np.asarray(beam))
+
+
+def test_beam_score_is_exact_and_beats_greedy(model):
+    rs = np.random.RandomState(1)
+    prompt = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (2, 3)))
+    n_new = 5
+    seqs, scores = beam_search(model, prompt, max_new_tokens=n_new, num_beams=4)
+    assert seqs.shape == (2, 3 + n_new)
+    greedy = generate(model, prompt, max_new_tokens=n_new, temperature=0.0)
+    for bi in range(2):
+        want_lp = _seq_logprob(model, np.asarray(seqs[bi]), 3)
+        got = float(scores[bi]) * n_new  # length_penalty=1.0
+        assert abs(want_lp - got) < 5e-2, (want_lp, got)
+        greedy_lp = _seq_logprob(model, np.asarray(greedy[bi]), 3)
+        assert want_lp >= greedy_lp - 1e-3  # beam can't be worse than greedy
+
+
+def test_beam_eos_finishes(model):
+    rs = np.random.RandomState(2)
+    prompt = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (1, 3)))
+    eos = 7
+    seqs, scores = beam_search(model, prompt, max_new_tokens=8, num_beams=3,
+                               eos_token_id=eos)
+    assert seqs.shape == (1, 11)
+    assert np.isfinite(float(scores[0]))
+
+
+def test_repetition_penalty_reduces_repeats(model):
+    rs = np.random.RandomState(3)
+    prompt = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (1, 4)))
+    plain = np.asarray(generate(model, prompt, max_new_tokens=12, temperature=0.0))
+    pen = np.asarray(generate(model, prompt, max_new_tokens=12, temperature=0.0,
+                              repetition_penalty=5.0))
+
+    def repeats(x):
+        gen = x[0, 4:]
+        return len(gen) - len(set(gen.tolist()))
+
+    assert repeats(pen) <= repeats(plain)
+    assert not np.array_equal(plain, pen) or repeats(plain) == 0
+
+
+def test_min_new_tokens_blocks_eos(model):
+    rs = np.random.RandomState(4)
+    prompt = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (1, 3)))
+    # find the greedy first token, use it as "eos" so it would stop instantly
+    g = np.asarray(generate(model, prompt, max_new_tokens=1, temperature=0.0))
+    eos = int(g[0, 3])
+    out = np.asarray(generate(model, prompt, max_new_tokens=6, temperature=0.0,
+                              eos_token_id=eos, min_new_tokens=4))
+    gen = out[0, 3:]
+    assert not np.any(gen[:3] == eos), gen  # eos suppressed below min length
