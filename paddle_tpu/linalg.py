@@ -216,11 +216,13 @@ def norm(x, p=None, axis=None, keepdim=False):
     if p == "fro":
         ax = tuple(axis) if isinstance(axis, (tuple, list)) else \
             (axis,) if axis is not None else None
-        out = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=ax,
-                               keepdims=keepdim))
-        return _keep_all_dims(out, x.ndim) if keepdim and ax is None else out
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=ax,
+                                keepdims=keepdim))
     if p == "nuc":
-        ax = tuple(a % x.ndim for a in axis) if isinstance(axis, (tuple, list)) \
+        if axis is not None and not isinstance(axis, (tuple, list)):
+            raise ValueError("nuclear norm needs a 2-axis tuple, got "
+                             f"axis={axis!r}")
+        ax = tuple(a % x.ndim for a in axis) if axis is not None \
             else (x.ndim - 2, x.ndim - 1)
         xm = jnp.moveaxis(x, ax, (-2, -1))
         out = jnp.sum(jnp.linalg.svd(xm, compute_uv=False), axis=-1)

@@ -285,6 +285,15 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
         best_seqs = jnp.take_along_axis(
             all_seqs, best[:, None, None], axis=1)[:, 0]
         best_scores = jnp.take_along_axis(all_scores, best[:, None], axis=1)[:, 0]
+        if eos_token_id is not None:
+            # early-finished hypotheses carry 0s after eos — pad with eos
+            # (generate()'s convention)
+            gen = best_seqs[:, prompt_len:]
+            seen = jnp.cumsum(gen == eos_token_id, axis=1)
+            after = jnp.concatenate(
+                [jnp.zeros((b, 1), bool), (seen > 0)[:, :-1]], axis=1)
+            best_seqs = best_seqs.at[:, prompt_len:].set(
+                jnp.where(after, eos_token_id, gen))
         return best_seqs, best_scores
 
     return run(model, input_ids, cache)

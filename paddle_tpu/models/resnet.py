@@ -43,13 +43,16 @@ class BasicBlock(Module):
 class BottleneckBlock(Module):
     expansion = 4
 
-    def __init__(self, in_ch, ch, stride=1, downsample=None):
+    def __init__(self, in_ch, ch, stride=1, downsample=None, groups=1,
+                 base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(in_ch, ch, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(ch)
-        self.conv2 = Conv2D(ch, ch, 3, stride=stride, padding=1, bias_attr=False)
-        self.bn2 = BatchNorm2D(ch)
-        self.conv3 = Conv2D(ch, ch * 4, 1, bias_attr=False)
+        width = int(ch * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(in_ch, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, ch * 4, 1, bias_attr=False)
         self.bn3 = BatchNorm2D(ch * 4)
         self.downsample = downsample
 
@@ -72,12 +75,17 @@ class _Downsample(Module):
 
 
 class ResNet(Module):
-    def __init__(self, block, depths, num_classes=1000, in_channels=3, width=64):
+    def __init__(self, block, depths, num_classes=1000, in_channels=3, width=64,
+                 groups=1, width_per_group=64):
         super().__init__()
         self.conv1 = Conv2D(in_channels, width, 7, stride=2, padding=3, bias_attr=False)
         self.bn1 = BatchNorm2D(width)
         self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        if block is BasicBlock and (groups != 1 or width_per_group != 64):
+            raise ValueError("BasicBlock only supports groups=1 and "
+                             "width_per_group=64 (reference behaviour)")
         self.in_ch = width
+        self.groups, self.base_width = groups, width_per_group
         self.layer1 = self._make_layer(block, width, depths[0])
         self.layer2 = self._make_layer(block, width * 2, depths[1], stride=2)
         self.layer3 = self._make_layer(block, width * 4, depths[2], stride=2)
@@ -87,12 +95,14 @@ class ResNet(Module):
 
     def _make_layer(self, block, ch, n, stride=1):
         downsample = None
+        kw = {} if block is BasicBlock else \
+            dict(groups=self.groups, base_width=self.base_width)
         if stride != 1 or self.in_ch != ch * block.expansion:
             downsample = _Downsample(self.in_ch, ch * block.expansion, stride)
-        layers = [block(self.in_ch, ch, stride, downsample)]
+        layers = [block(self.in_ch, ch, stride, downsample, **kw)]
         self.in_ch = ch * block.expansion
         for _ in range(1, n):
-            layers.append(block(self.in_ch, ch))
+            layers.append(block(self.in_ch, ch, **kw))
         return layers
 
     def __call__(self, x):
@@ -122,3 +132,33 @@ def resnet101(num_classes=1000, **kw):
 
 def resnet152(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes, **kw)
+
+
+def resnext50_32x4d(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
+                  groups=32, width_per_group=4, **kw)
+
+
+def resnext101_32x4d(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes,
+                  groups=32, width_per_group=4, **kw)
+
+
+def resnext101_64x4d(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes,
+                  groups=64, width_per_group=4, **kw)
+
+
+def resnext152_32x4d(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes,
+                  groups=32, width_per_group=4, **kw)
+
+
+def wide_resnet50_2(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
+                  width_per_group=128, **kw)
+
+
+def wide_resnet101_2(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes,
+                  width_per_group=128, **kw)

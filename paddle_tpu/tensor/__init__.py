@@ -411,8 +411,9 @@ def triangular_solve(a, b, upper=True):
     return jax.scipy.linalg.solve_triangular(a, b, lower=not upper)
 
 
-def matrix_rank(x, tol=None):
-    return jnp.linalg.matrix_rank(x, tol)
+def matrix_rank(x, tol=None, hermitian=False):
+    from paddle_tpu import linalg
+    return linalg.matrix_rank(x, tol=tol, hermitian=hermitian)
 
 
 def histogram(x, bins=100, min=0, max=0):

@@ -12,7 +12,14 @@ from paddle_tpu.models.resnet import (  # noqa: F401
     resnet50,
     resnet101,
     resnet152,
+    resnext50_32x4d,
+    resnext101_32x4d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    wide_resnet50_2,
+    wide_resnet101_2,
 )
+from paddle_tpu.vision.models_extra import *  # noqa: F401,F403
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layers import (
     AdaptiveAvgPool2D,

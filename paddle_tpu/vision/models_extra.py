@@ -1,0 +1,607 @@
+"""Vision zoo completion (ref: ``python/paddle/vision/models/``): LeNet,
+AlexNet, SqueezeNet, DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2,
+MobileNetV1/V3.
+
+All NCHW, pytree modules, pure calls; BatchNorm runs inference-style under
+jit (running stats are buffers) exactly like the rest of the zoo.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layers import (
+    AdaptiveAvgPool2D,
+    AvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Linear,
+    MaxPool2D,
+    ReLU,
+    Sequential,
+)
+
+__all__ = [
+    "LeNet", "AlexNet", "SqueezeNet", "DenseNet", "GoogLeNet", "InceptionV3",
+    "ShuffleNetV2", "MobileNetV1", "MobileNetV3Small", "MobileNetV3Large",
+    "alexnet", "squeezenet1_0", "squeezenet1_1", "densenet121", "densenet161",
+    "densenet169", "densenet201", "densenet264", "googlenet", "inception_v3",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "mobilenet_v1",
+    "mobilenet_v3_small", "mobilenet_v3_large",
+]
+
+
+class _ConvBN(Module):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1, act="relu"):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.act = act
+
+    def __call__(self, x):
+        x = self.bn(self.conv(x))
+        if self.act == "relu":
+            return F.relu(x)
+        if self.act == "relu6":
+            return F.relu6(x)
+        if self.act == "hardswish":
+            return F.hardswish(x)
+        return x
+
+
+class LeNet(Module):
+    """Ref: python/paddle/vision/models/lenet.py (28x28 inputs)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(), MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1), ReLU(), MaxPool2D(2, 2))
+        self.fc = Sequential(Linear(400, 120), Linear(120, 84),
+                             Linear(84, num_classes))
+
+    def __call__(self, x):
+        x = self.features(x)
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+class AlexNet(Module):
+    """Ref: python/paddle/vision/models/alexnet.py."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, 2))
+        self.avgpool = AdaptiveAvgPool2D(6)
+        self.classifier = Sequential(
+            Dropout(0.5), Linear(256 * 6 * 6, 4096), ReLU(),
+            Dropout(0.5), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def __call__(self, x, rng=None):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1), rng=rng)
+
+
+class _Fire(Module):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(in_c, squeeze, 1)
+        self.expand1 = Conv2D(squeeze, e1, 1)
+        self.expand3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def __call__(self, x):
+        x = F.relu(self.squeeze(x))
+        return jnp.concatenate(
+            [F.relu(self.expand1(x)), F.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(Module):
+    """Ref: python/paddle/vision/models/squeezenet.py (version 1.0/1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000):
+        super().__init__()
+        self.version = version
+        if version == "1.0":
+            self.stem = Conv2D(3, 96, 7, stride=2)
+            self.blocks = [
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+            self.pool_before = (3, 7)  # maxpool precedes these block indices
+        else:
+            self.stem = Conv2D(3, 64, 3, stride=2)
+            self.blocks = [
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+            self.pool_before = (2, 4)
+        self.final_conv = Conv2D(512, num_classes, 1)
+        self.pool = AdaptiveAvgPool2D(1)
+
+    def __call__(self, x, rng=None):
+        x = F.max_pool2d(F.relu(self.stem(x)), 3, 2)
+        for i, b in enumerate(self.blocks):
+            if i in self.pool_before:
+                x = F.max_pool2d(x, 3, 2)
+            x = b(x)
+        x = self.pool(F.relu(self.final_conv(x)))
+        return x.reshape(x.shape[0], -1)
+
+
+class _DenseLayer(Module):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(in_c)
+        self.conv1 = Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1, bias_attr=False)
+
+    def __call__(self, x):
+        y = self.conv1(F.relu(self.bn1(x)))
+        y = self.conv2(F.relu(self.bn2(y)))
+        return jnp.concatenate([x, y], axis=1)
+
+
+class _Transition(Module):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = BatchNorm2D(in_c)
+        self.conv = Conv2D(in_c, out_c, 1, bias_attr=False)
+
+    def __call__(self, x):
+        return F.avg_pool2d(self.conv(F.relu(self.bn(x))), 2, 2)
+
+
+_DENSE_CFGS = {
+    121: (32, (6, 12, 24, 16), 64), 161: (48, (6, 12, 36, 24), 96),
+    169: (32, (6, 12, 32, 32), 64), 201: (32, (6, 12, 48, 32), 64),
+    264: (32, (6, 12, 64, 48), 64),
+}
+
+
+class DenseNet(Module):
+    """Ref: python/paddle/vision/models/densenet.py."""
+
+    def __init__(self, layers=121, num_classes=1000, bn_size=4):
+        super().__init__()
+        growth, block_cfg, init_c = _DENSE_CFGS[layers]
+        self.stem = Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False)
+        self.stem_bn = BatchNorm2D(init_c)
+        blocks = []
+        c = init_c
+        for bi, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(c, growth, bn_size))
+                c += growth
+            if bi != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c //= 2
+        self.blocks = blocks
+        self.final_bn = BatchNorm2D(c)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(c, num_classes)
+
+    def __call__(self, x):
+        x = F.max_pool2d(F.relu(self.stem_bn(self.stem(x))), 3, 2, padding=1)
+        for b in self.blocks:
+            x = b(x)
+        x = self.pool(F.relu(self.final_bn(x)))
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+class _Inception(Module):
+    """GoogLeNet inception block (1x1 / 3x3 / 5x5 / pool-proj branches)."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, c1, 1)
+        self.b3a = _ConvBN(in_c, c3r, 1)
+        self.b3b = _ConvBN(c3r, c3, 3, padding=1)
+        self.b5a = _ConvBN(in_c, c5r, 1)
+        self.b5b = _ConvBN(c5r, c5, 5, padding=2)
+        self.proj = _ConvBN(in_c, proj, 1)
+
+    def __call__(self, x):
+        return jnp.concatenate([
+            self.b1(x), self.b3b(self.b3a(x)), self.b5b(self.b5a(x)),
+            self.proj(F.max_pool2d(x, 3, 1, padding=1))], axis=1)
+
+
+class GoogLeNet(Module):
+    """Ref: python/paddle/vision/models/googlenet.py (aux heads omitted in
+    eval; returns main logits)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3), MaxPool2D(3, 2, padding=1),
+            _ConvBN(64, 64, 1), _ConvBN(64, 192, 3, padding=1),
+            MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.dropout = Dropout(0.2)
+        self.fc = Linear(1024, num_classes)
+
+    def __call__(self, x, rng=None):
+        x = self.stem(x)
+        x = self.i3b(self.i3a(x))
+        x = F.max_pool2d(x, 3, 2, padding=1)
+        x = self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x)))))
+        x = F.max_pool2d(x, 3, 2, padding=1)
+        x = self.i5b(self.i5a(x))
+        x = self.pool(x).reshape(x.shape[0], -1)
+        return self.fc(self.dropout(x, rng=rng))
+
+
+class _IncA(Module):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = Sequential(_ConvBN(in_c, 48, 1), _ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBN(in_c, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                             _ConvBN(96, 96, 3, padding=1))
+        self.bp = _ConvBN(in_c, pool_c, 1)
+
+    def __call__(self, x):
+        return jnp.concatenate([
+            self.b1(x), self.b5(x), self.b3(x),
+            self.bp(F.avg_pool2d(x, 3, 1, padding=1))], axis=1)
+
+
+class _IncB(Module):  # grid reduction 35->17
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b3d = Sequential(_ConvBN(in_c, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                              _ConvBN(96, 96, 3, stride=2))
+
+    def __call__(self, x):
+        return jnp.concatenate([
+            self.b3(x), self.b3d(x), F.max_pool2d(x, 3, 2)], axis=1)
+
+
+class _IncC(Module):  # 17x17 factorised 7x7
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7 = Sequential(
+            _ConvBN(in_c, c7, 1), _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            _ConvBN(in_c, c7, 1), _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = _ConvBN(in_c, 192, 1)
+
+    def __call__(self, x):
+        return jnp.concatenate([
+            self.b1(x), self.b7(x), self.b7d(x),
+            self.bp(F.avg_pool2d(x, 3, 1, padding=1))], axis=1)
+
+
+class _IncD(Module):  # grid reduction 17->8
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = Sequential(_ConvBN(in_c, 192, 1), _ConvBN(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            _ConvBN(in_c, 192, 1), _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)), _ConvBN(192, 192, 3, stride=2))
+
+    def __call__(self, x):
+        return jnp.concatenate([
+            self.b3(x), self.b7(x), F.max_pool2d(x, 3, 2)], axis=1)
+
+
+class _IncE(Module):  # 8x8 expanded
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3a = _ConvBN(in_c, 384, 1)
+        self.b3b1 = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3b2 = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bda = Sequential(_ConvBN(in_c, 448, 1), _ConvBN(448, 384, 3, padding=1))
+        self.bdb1 = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.bdb2 = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = _ConvBN(in_c, 192, 1)
+
+    def __call__(self, x):
+        a = self.b3a(x)
+        d = self.bda(x)
+        return jnp.concatenate([
+            self.b1(x), self.b3b1(a), self.b3b2(a), self.bdb1(d), self.bdb2(d),
+            self.bp(F.avg_pool2d(x, 3, 1, padding=1))], axis=1)
+
+
+class InceptionV3(Module):
+    """Ref: python/paddle/vision/models/inceptionv3.py (299x299 inputs)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = [
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048)]
+        self.pool = AdaptiveAvgPool2D(1)
+        self.dropout = Dropout(0.5)
+        self.fc = Linear(2048, num_classes)
+
+    def __call__(self, x, rng=None):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        x = self.pool(x).reshape(x.shape[0], -1)
+        return self.fc(self.dropout(x, rng=rng))
+
+
+class _ShuffleUnit(Module):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.b1_dw = _ConvBN(in_c, in_c, 3, stride=2, padding=1,
+                                 groups=in_c, act=None)
+            self.b1_pw = _ConvBN(in_c, branch_c, 1)
+            in_main = in_c
+        else:
+            in_main = in_c // 2
+        self.b2_pw1 = _ConvBN(in_main, branch_c, 1)
+        self.b2_dw = _ConvBN(branch_c, branch_c, 3, stride=stride, padding=1,
+                             groups=branch_c, act=None)
+        self.b2_pw2 = _ConvBN(branch_c, branch_c, 1)
+
+    def __call__(self, x):
+        if self.stride == 2:
+            left = self.b1_pw(self.b1_dw(x))
+            right = self.b2_pw2(self.b2_dw(self.b2_pw1(x)))
+        else:
+            left, right = jnp.split(x, 2, axis=1)
+            right = self.b2_pw2(self.b2_dw(self.b2_pw1(right)))
+        out = jnp.concatenate([left, right], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFGS = {
+    0.25: (24, 24, 48, 96, 512), 0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024), 1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(Module):
+    """Ref: python/paddle/vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        c0, c1, c2, c3, c_last = _SHUFFLE_CFGS[scale]
+        self.stem = _ConvBN(3, c0, 3, stride=2, padding=1)
+        blocks = []
+        in_c = c0
+        for c, n in ((c1, 4), (c2, 8), (c3, 4)):
+            blocks.append(_ShuffleUnit(in_c, c, 2))
+            for _ in range(n - 1):
+                blocks.append(_ShuffleUnit(c, c, 1))
+            in_c = c
+        self.blocks = blocks
+        self.head = _ConvBN(in_c, c_last, 1)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(c_last, num_classes)
+
+    def __call__(self, x):
+        x = F.max_pool2d(self.stem(x), 3, 2, padding=1)
+        for b in self.blocks:
+            x = b(x)
+        x = self.pool(self.head(x))
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+class MobileNetV1(Module):
+    """Ref: python/paddle/vision/models/mobilenetv1.py (dw-separable stacks)."""
+
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        def c(v):
+            return max(8, int(v * scale))
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        self.stem = _ConvBN(3, c(32), 3, stride=2, padding=1)
+        blocks = []
+        for in_c, out_c, s in cfg:
+            blocks.append(_ConvBN(c(in_c), c(in_c), 3, stride=s, padding=1,
+                                  groups=c(in_c)))
+            blocks.append(_ConvBN(c(in_c), c(out_c), 1))
+        self.blocks = blocks
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(c(1024), num_classes)
+
+    def __call__(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        return self.fc(self.pool(x).reshape(x.shape[0], -1))
+
+
+class _SEBlock(Module):
+    def __init__(self, c, reduction=4):
+        super().__init__()
+        self.fc1 = Conv2D(c, c // reduction, 1)
+        self.fc2 = Conv2D(c // reduction, c, 1)
+
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(2, 3), keepdims=True)
+        s = F.hardsigmoid(self.fc2(F.relu(self.fc1(s))))
+        return x * s
+
+
+class _MBV3Block(Module):
+    def __init__(self, in_c, exp_c, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        self.expand = _ConvBN(in_c, exp_c, 1, act=act) if exp_c != in_c else None
+        self.dw = _ConvBN(exp_c, exp_c, k, stride=stride, padding=k // 2,
+                          groups=exp_c, act=act)
+        self.se = _SEBlock(exp_c) if use_se else None
+        self.project = _ConvBN(exp_c, out_c, 1, act=None)
+
+    def __call__(self, x):
+        y = x if self.expand is None else self.expand(x)
+        y = self.dw(y)
+        if self.se is not None:
+            y = self.se(y)
+        y = self.project(y)
+        return x + y if self.use_res else y
+
+
+_MBV3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(Module):
+    def __init__(self, cfg, last_exp, last_c, num_classes=1000):
+        super().__init__()
+        self.stem = _ConvBN(3, 16, 3, stride=2, padding=1, act="hardswish")
+        blocks = []
+        in_c = 16
+        for k, exp, out, se, act, s in cfg:
+            blocks.append(_MBV3Block(in_c, exp, out, k, s, se, act))
+            in_c = out
+        self.blocks = blocks
+        self.head = _ConvBN(in_c, last_exp, 1, act="hardswish")
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Linear(last_exp, last_c)
+        self.fc2 = Linear(last_c, num_classes)
+
+    def __call__(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        x = self.pool(self.head(x)).reshape(x.shape[0], -1)
+        return self.fc2(F.hardswish(self.fc1(x)))
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, num_classes=1000):
+        super().__init__(_MBV3_LARGE, 960, 1280, num_classes)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, num_classes=1000):
+        super().__init__(_MBV3_SMALL, 576, 1024, num_classes)
+
+
+# -- factories (reference naming) --------------------------------------------
+
+def alexnet(num_classes=1000):
+    return AlexNet(num_classes)
+
+
+def squeezenet1_0(num_classes=1000):
+    return SqueezeNet("1.0", num_classes)
+
+
+def squeezenet1_1(num_classes=1000):
+    return SqueezeNet("1.1", num_classes)
+
+
+def densenet121(num_classes=1000):
+    return DenseNet(121, num_classes)
+
+
+def densenet161(num_classes=1000):
+    return DenseNet(161, num_classes)
+
+
+def densenet169(num_classes=1000):
+    return DenseNet(169, num_classes)
+
+
+def densenet201(num_classes=1000):
+    return DenseNet(201, num_classes)
+
+
+def densenet264(num_classes=1000):
+    return DenseNet(264, num_classes)
+
+
+def googlenet(num_classes=1000):
+    return GoogLeNet(num_classes)
+
+
+def inception_v3(num_classes=1000):
+    return InceptionV3(num_classes)
+
+
+def shufflenet_v2_x0_25(num_classes=1000):
+    return ShuffleNetV2(0.25, num_classes)
+
+
+def shufflenet_v2_x0_5(num_classes=1000):
+    return ShuffleNetV2(0.5, num_classes)
+
+
+def shufflenet_v2_x1_0(num_classes=1000):
+    return ShuffleNetV2(1.0, num_classes)
+
+
+def shufflenet_v2_x1_5(num_classes=1000):
+    return ShuffleNetV2(1.5, num_classes)
+
+
+def shufflenet_v2_x2_0(num_classes=1000):
+    return ShuffleNetV2(2.0, num_classes)
+
+
+def mobilenet_v1(scale=1.0, num_classes=1000):
+    return MobileNetV1(scale, num_classes)
+
+
+def mobilenet_v3_small(num_classes=1000):
+    return MobileNetV3Small(num_classes)
+
+
+def mobilenet_v3_large(num_classes=1000):
+    return MobileNetV3Large(num_classes)
