@@ -1,0 +1,63 @@
+import time, json
+import jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as pt
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, num_flops_per_token
+from paddle_tpu.core.module import partition_trainable, combine, value_and_grad
+from paddle_tpu.train import make_train_step
+from paddle_tpu.train.step import init_state
+
+PEAK = 197e12
+cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                  num_hidden_layers=12, num_attention_heads=16,
+                  num_key_value_heads=16, max_position_embeddings=2048,
+                  dtype=jnp.bfloat16, remat=True, scan_layers=True)
+batch, seq, iters = 4, 2048, 10
+pt.seed(0)
+model = LlamaForCausalLM(cfg)
+rs = np.random.RandomState(0)
+ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)))
+labels = jnp.concatenate([ids[:, 1:], -100 * jnp.ones((batch, 1), ids.dtype)], axis=1)
+
+def timeit(f, *args, n=iters):
+    out = f(*args); jax.device_get(jax.tree_util.tree_leaves(out)[0].sum() if hasattr(jax.tree_util.tree_leaves(out)[0], 'sum') else out)
+    out = f(*args); jax.device_get(jax.tree_util.tree_leaves(out)[0].sum())
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(n):
+        r = f(*args)
+    jax.device_get(jax.tree_util.tree_leaves(r)[0].sum())
+    return (time.perf_counter() - t0) / n
+
+fwd = jax.jit(lambda m, i: m(i))
+t_fwd = timeit(fwd, model, ids)
+
+loss_j = jax.jit(lambda m, i, l: m.loss(i, l))
+t_loss = timeit(loss_j, model, ids, labels)
+
+grad_j = jax.jit(lambda m, i, l: value_and_grad(lambda mm, ii, ll: mm.loss(ii, ll))(m, i, l))
+t_grad = timeit(grad_j, model, ids, labels)
+
+optimizer = opt.AdamW(learning_rate=3e-4, weight_decay=0.1,
+                      grad_clip=opt.ClipGradByGlobalNorm(1.0), multi_precision=True)
+state = init_state(model, optimizer)
+step = make_train_step(lambda m, i, l: m.loss(i, l), optimizer)
+t_step = None
+s2 = state
+s2, l = step(s2, ids, labels); float(jax.device_get(l))
+s2, l = step(s2, ids, labels); float(jax.device_get(l))
+t0 = time.perf_counter()
+for _ in range(iters):
+    s2, l = step(s2, ids, labels)
+float(jax.device_get(l))
+t_step = (time.perf_counter() - t0) / iters
+
+fpt = num_flops_per_token(cfg, seq)
+tok = batch * seq
+print(json.dumps({
+    "fwd_ms": round(t_fwd*1e3,1), "loss_ms": round(t_loss*1e3,1),
+    "grad_ms": round(t_grad*1e3,1), "step_ms": round(t_step*1e3,1),
+    "fwd_mfu_vs_third": round(tok*(fpt/3)/t_fwd/PEAK, 3),
+    "grad_mfu": round(tok*fpt/t_grad/PEAK, 3),
+    "step_mfu": round(tok*fpt/t_step/PEAK, 3),
+}))

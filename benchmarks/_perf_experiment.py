@@ -1,0 +1,67 @@
+"""Perf sweep on the real chip: remat policy x batch size."""
+import time, json, sys
+import jax, jax.numpy as jnp, numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, num_flops_per_token
+from paddle_tpu.train import make_train_step
+from paddle_tpu.train.step import init_state
+
+PEAK = 197e12
+
+def run(policy, batch, seq=2048, iters=10):
+    import paddle_tpu.models.llama as llama_mod
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                      num_hidden_layers=12, num_attention_heads=16,
+                      num_key_value_heads=16, max_position_embeddings=2048,
+                      dtype=jnp.bfloat16, remat=True, scan_layers=True)
+    # monkeypatch the checkpoint policy for the experiment
+    orig_ckpt = jax.checkpoint
+    if policy is not None:
+        import functools
+        def ckpt(f, **kw):
+            kw.pop("policy", None)
+            return orig_ckpt(f, policy=policy, **kw)
+        llama_mod.jax.checkpoint = ckpt
+    try:
+        pt.seed(0)
+        model = LlamaForCausalLM(cfg)
+        optimizer = opt.AdamW(learning_rate=3e-4, weight_decay=0.1,
+                              grad_clip=opt.ClipGradByGlobalNorm(1.0),
+                              multi_precision=True)
+        state = init_state(model, optimizer)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)))
+        labels = jnp.concatenate([ids[:, 1:], -100 * jnp.ones((batch, 1), ids.dtype)], axis=1)
+        step = make_train_step(lambda m, i, l: m.loss(i, l), optimizer)
+        state, loss = step(state, ids, labels)
+        float(jax.device_get(loss))
+        state, loss = step(state, ids, labels)
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = step(state, ids, labels)
+        float(jax.device_get(loss))
+        dt = (time.perf_counter() - t0) / iters
+        tps = batch * seq / dt
+        mfu = tps * num_flops_per_token(cfg, seq) / PEAK
+        print(json.dumps({"policy": str(policy), "batch": batch,
+                          "step_ms": round(dt*1e3,1), "tps": round(tps,1),
+                          "mfu": round(mfu,4)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"policy": str(policy), "batch": batch, "error": str(e)[:200]}), flush=True)
+    finally:
+        llama_mod.jax.checkpoint = orig_ckpt
+
+
+which = sys.argv[1]
+pol = jax.checkpoint_policies
+if which == "baseline":
+    run(None, 4)
+elif which == "dots":
+    run(pol.dots_with_no_batch_dims_saveable, 4)
+elif which == "dots8":
+    run(pol.dots_with_no_batch_dims_saveable, 8)
+elif which == "base8":
+    run(None, 8)

@@ -1,0 +1,46 @@
+import time, json, sys
+import jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as pt
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, num_flops_per_token
+from paddle_tpu.train import make_train_step
+from paddle_tpu.train.step import init_state
+
+PEAK = 197e12
+
+def run(tag, remat, scan, batch=4, seq=2048, iters=10):
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                      num_hidden_layers=12, num_attention_heads=16,
+                      num_key_value_heads=16, max_position_embeddings=2048,
+                      dtype=jnp.bfloat16, remat=remat, scan_layers=scan)
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-4, weight_decay=0.1,
+                          grad_clip=opt.ClipGradByGlobalNorm(1.0), multi_precision=True)
+    state = init_state(model, optimizer)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.concatenate([ids[:, 1:], -100*jnp.ones((batch,1), ids.dtype)], axis=1)
+    step = make_train_step(lambda m, i, l: m.loss(i, l), optimizer)
+    try:
+        state, l = step(state, ids, labels); float(jax.device_get(l))
+        state, l = step(state, ids, labels); float(jax.device_get(l))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, l = step(state, ids, labels)
+        float(jax.device_get(l))
+        dt = (time.perf_counter()-t0)/iters
+        mfu = batch*seq*num_flops_per_token(cfg, seq)/dt/PEAK
+        print(json.dumps({"tag": tag, "step_ms": round(dt*1e3,1), "mfu": round(mfu,4)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"tag": tag, "error": str(e)[:150]}), flush=True)
+
+for arg in sys.argv[1:]:
+    if arg == "noremat_scan":
+        run(arg, False, True)
+    elif arg == "noremat_unroll":
+        run(arg, False, False)
+    elif arg == "remat_unroll":
+        run(arg, True, False)
+    elif arg == "noremat_scan_b8":
+        run(arg, False, True, batch=8)

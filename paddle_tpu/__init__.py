@@ -11,7 +11,8 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from paddle_tpu import amp, core, io, nn, ops, optimizer, utils
-from paddle_tpu import distribution, fft, linalg, signal
+from paddle_tpu import (audio, autograd, distribution, fft, linalg,
+                        quantization, signal, sparse, text)
 from paddle_tpu.core.device import (
     device_count,
     get_device,

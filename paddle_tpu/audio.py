@@ -1,0 +1,158 @@
+"""Audio feature extraction (ref: ``python/paddle/audio/``): mel filterbanks,
+Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC.
+
+Everything composes from ``paddle_tpu.signal.stft`` + small dense matmuls,
+so feature extraction jits and runs on-device (the reference runs these as
+CPU ops feeding the GPU)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from paddle_tpu import signal as _signal
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "create_dct", "power_to_db",
+    "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+]
+
+
+def hz_to_mel(freq, htk=False):
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    # Slaney formula (reference default)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(freq, 1e-10) / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk=False):
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(lo, hi, n_mels), htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb = fb * enorm[:, None]
+    return fb
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II basis."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct = dct * jnp.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].set(dct[:, 0] / math.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return dct
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    log_spec = 10.0 * jnp.log10(jnp.maximum(spect, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def _hann(n):
+    return 0.5 - 0.5 * jnp.cos(2 * math.pi * jnp.arange(n) / n)
+
+
+class Spectrogram:
+    """Ref: paddle.audio.features.Spectrogram (power spectrogram)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect"):
+        self.n_fft, self.power = n_fft, power
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.window = _hann(self.win_length) if window == "hann" else \
+            jnp.ones((self.win_length,), jnp.float32)
+        self.center, self.pad_mode = center, pad_mode
+
+    def __call__(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram:
+    """Ref: paddle.audio.features.MelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney"):
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm)
+
+    def __call__(self, x):
+        spec = self.spectrogram(x)  # [..., n_freq, n_frames]
+        return jnp.einsum("mf,...ft->...mt", self.fbank, spec)
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    """Ref: paddle.audio.features.LogMelSpectrogram."""
+
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__(*args, **kw)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def __call__(self, x):
+        return power_to_db(super().__call__(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC:
+    """Ref: paddle.audio.features.MFCC (log-mel → DCT-II)."""
+
+    def __init__(self, sr=22050, n_mfcc=13, n_mels=64, **kw):
+        self.logmel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **kw)
+        self.dct = create_dct(n_mfcc, n_mels)
+
+    def __call__(self, x):
+        mel = self.logmel(x)  # [..., n_mels, n_frames]
+        return jnp.einsum("mk,...mt->...kt", self.dct, mel)

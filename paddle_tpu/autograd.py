@@ -1,0 +1,109 @@
+"""Functional autograd API (ref: ``python/paddle/autograd/``:
+``backward``-free functional surface — jacobian, hessian, jvp, vjp —
+plus ``PyLayer`` for custom VJPs).
+
+Thin re-exposure of JAX's tracing autodiff under the reference names.
+Unlike the reference (tape-based double backward), everything here composes
+with jit/vmap and compiles to one XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grad", "jacobian", "hessian", "jvp", "vjp", "vhp", "PyLayer",
+           "no_grad"]
+
+from paddle_tpu.jit import grad, no_grad  # noqa: F401  (reference namespace)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Ref: paddle.autograd.jacobian — d func(xs) / d xs.
+
+    xs may be one array or a tuple; returns the same structure of jacobians.
+    """
+    if isinstance(xs, (tuple, list)):
+        return jax.jacobian(func, argnums=tuple(range(len(xs))))(*xs)
+    return jax.jacobian(func)(xs)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Ref: paddle.autograd.hessian — d^2 func(xs) / d xs^2 (func scalar)."""
+    if isinstance(xs, (tuple, list)):
+        return jax.hessian(func, argnums=tuple(range(len(xs))))(*xs)
+    return jax.hessian(func)(xs)
+
+
+def jvp(func, xs, v=None):
+    """Ref: paddle.incubate.autograd.jvp -> (func(xs), J @ v)."""
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    if v is None:
+        v = tuple(jnp.ones_like(x) for x in xs)
+    v = v if isinstance(v, (tuple, list)) else (v,)
+    out, tangent = jax.jvp(func, tuple(xs), tuple(v))
+    return out, tangent
+
+
+def vjp(func, xs, v=None):
+    """Ref: paddle.incubate.autograd.vjp -> (func(xs), v @ J)."""
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    out, pullback = jax.vjp(func, *xs)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    grads = pullback(v)
+    return out, grads if len(grads) > 1 else grads[0]
+
+
+def vhp(func, xs, v=None):
+    """vector-Hessian product: (func(xs), v @ H)."""
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    if v is None:
+        v = tuple(jnp.ones_like(x) for x in xs_t)
+    v_t = v if isinstance(v, (tuple, list)) else (v,)
+    g = jax.grad(func, argnums=tuple(range(len(xs_t))))
+    out = func(*xs_t)
+    _, hvp = jax.jvp(lambda *a: g(*a), tuple(xs_t), tuple(v_t))
+    return out, hvp if len(hvp) > 1 else hvp[0]
+
+
+class PyLayer:
+    """Custom-VJP layer (ref: paddle.autograd.PyLayer).
+
+    Subclass with static ``forward(ctx, *args)`` and
+    ``backward(ctx, *grads)``; call via ``MyLayer.apply(*args)``.
+    ``ctx.save_for_backward(*ts)`` stashes residuals.
+    """
+
+    class _Ctx:
+        def __init__(self):
+            self.saved = ()
+
+        def save_for_backward(self, *ts):
+            self.saved = ts
+
+        def saved_tensor(self):
+            return self.saved
+
+    @classmethod
+    def apply(cls, *args):
+        @jax.custom_vjp
+        def f(*xs):
+            ctx = cls._Ctx()
+            return cls.forward(ctx, *xs)
+
+        def fwd(*xs):
+            ctx = cls._Ctx()
+            out = cls.forward(ctx, *xs)
+            return out, ctx.saved
+
+        def bwd(saved, g):
+            ctx = cls._Ctx()
+            ctx.saved = saved
+            # multi-output forward -> tuple cotangent, unpacked per the
+            # documented backward(ctx, *grads) signature
+            grads = cls.backward(ctx, *g) if isinstance(g, tuple) \
+                else cls.backward(ctx, g)
+            return grads if isinstance(grads, tuple) else (grads,)
+
+        f.defvjp(fwd, bwd)
+        return f(*args)

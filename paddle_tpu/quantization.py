@@ -1,0 +1,211 @@
+"""Quantization (ref: ``python/paddle/quantization/`` — QAT fake-quant, PTQ
+calibration, quantized inference layers).
+
+TPU-native: int8 matmuls hit the MXU at 2x bf16 throughput via
+``lax.dot_general(..., preferred_element_type=jnp.int32)``; fake-quant uses a
+straight-through estimator (custom_vjp) so QAT composes with ``jax.grad``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.module import Module
+
+__all__ = [
+    "fake_quant", "quantize_weight", "dequantize", "AbsmaxObserver",
+    "FakeQuantLayer", "QuantizedLinear", "quant_linear", "QAT", "PTQ",
+]
+
+
+# -- fake quant with straight-through estimator ------------------------------
+
+@jax.custom_vjp
+def fake_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax - 1, qmax)
+    return q * scale / qmax
+
+
+def _fq_fwd(x, scale, bits=8):
+    return fake_quant(x, scale, bits), (x, scale, bits)
+
+
+def _fq_bwd(res, g):
+    x, scale, bits = res
+    qmax = 2.0 ** (bits - 1) - 1
+    # STE: pass gradient inside the clip range, zero outside
+    inside = (jnp.abs(x / scale) <= 1.0).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_weight(w, bits=8, axis=None):
+    """Symmetric int8 quantization; per-channel when axis given.
+    Returns (q_int8, scale_fp32)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    if axis is None:
+        scale = jnp.max(jnp.abs(w)).astype(jnp.float32)
+    else:
+        red = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+        scale = jnp.max(jnp.abs(w), axis=red, keepdims=True).astype(jnp.float32)
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale * qmax),
+                 -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale / qmax
+
+
+def dequantize(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+# -- observers / PTQ ---------------------------------------------------------
+
+class AbsmaxObserver:
+    """Running absmax calibration (ref: paddle.quantization observers)."""
+
+    def __init__(self, momentum=0.9):
+        self.momentum = momentum
+        self.absmax = None
+
+    def observe(self, x):
+        cur = float(jnp.max(jnp.abs(x)))
+        self.absmax = cur if self.absmax is None else \
+            self.momentum * self.absmax + (1 - self.momentum) * cur
+        return self.absmax
+
+    @property
+    def scale(self):
+        return max(self.absmax or 1.0, 1e-8)
+
+
+class FakeQuantLayer(Module):
+    """QAT activation fake-quant node; scale is a buffer set by calibration."""
+
+    def __init__(self, bits=8, init_scale=1.0):
+        super().__init__()
+        self.register_buffer("scale", jnp.asarray(init_scale, jnp.float32))
+        self.bits = bits
+
+    def __call__(self, x):
+        return fake_quant(x, self.scale, self.bits).astype(x.dtype)
+
+
+# -- quantized inference layers ----------------------------------------------
+
+class QuantizedLinear(Module):
+    """int8-weight linear (ref: paddle.nn.quant.Linear after PTQ).
+
+    Weights stored int8 with per-output-channel scales; activations
+    dynamically quantized per-tensor. The matmul runs int8 x int8 -> int32
+    on the MXU, then rescales in fp32.
+    """
+
+    def __init__(self, weight, bias=None, bits=8):
+        super().__init__()
+        q, scale = quantize_weight(weight, bits=bits, axis=1)  # [in, out]
+        self.register_buffer("qweight", q)
+        self.register_buffer("wscale", scale.reshape(1, -1))
+        self.bias = bias
+        self.bits = bits
+
+    def __call__(self, x):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        xs = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)),
+                                 axis=-1, keepdims=True), 1e-8) / qmax
+        qx = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -qmax - 1,
+                      qmax).astype(jnp.int8)
+        acc = lax.dot_general(
+            qx, self.qweight,
+            dimension_numbers=(((qx.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * xs * self.wscale
+        if self.bias is not None:
+            out = out + self.bias
+        return out.astype(x.dtype)
+
+
+def quant_linear(linear, bits=8):
+    """Convert a ``nn.Linear`` into a ``QuantizedLinear`` (PTQ weight-only)."""
+    return QuantizedLinear(linear.weight, linear.bias, bits=bits)
+
+
+# -- high-level entry points (ref paddle.quantization.QAT / PTQ) -------------
+
+@dataclass
+class QuantConfig:
+    bits: int = 8
+    activation: bool = True
+
+
+class QATLinear(Module):
+    """Linear whose weight passes through fake_quant each call (STE grads) —
+    the reference's QAT-instrumented layer."""
+
+    def __init__(self, weight, bias=None, bits=8):
+        super().__init__()
+        self.weight, self.bias, self.bits = weight, bias, bits
+
+    def __call__(self, x):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        _, wscale = quantize_weight(self.weight, bits=self.bits, axis=1)
+        w = fake_quant(self.weight.astype(jnp.float32),
+                       (wscale * qmax).astype(jnp.float32),
+                       self.bits).astype(x.dtype)
+        y = x @ w
+        return y + self.bias if self.bias is not None else y
+
+
+def _replace_linears(model, make):
+    import copy
+
+    from paddle_tpu.nn.layers import Linear
+
+    model = copy.deepcopy(model)  # the pass returns a new model (params
+    # are immutable jax arrays, so this copies structure, not buffers)
+
+    def convert_tree(m):
+        for name in list(vars(m)):
+            sub = getattr(m, name)
+            if isinstance(sub, Linear):
+                object.__setattr__(m, name, make(sub))
+            elif isinstance(sub, Module):
+                convert_tree(sub)
+            elif isinstance(sub, (list, tuple)):
+                for i, item in enumerate(sub):
+                    if isinstance(item, Linear) and isinstance(sub, list):
+                        sub[i] = make(item)
+                    elif isinstance(item, Module):
+                        convert_tree(item)
+        return m
+
+    return convert_tree(model)
+
+
+class QAT:
+    """Quantization-aware training pass (ref: paddle.quantization.QAT):
+    replaces every Linear with a fake-quant-weight QATLinear."""
+
+    def __init__(self, config: QuantConfig = QuantConfig()):
+        self.config = config
+
+    def quantize(self, model):
+        return _replace_linears(
+            model, lambda lin: QATLinear(lin.weight, lin.bias, self.config.bits))
+
+
+class PTQ:
+    """Post-training quantization (ref: paddle.quantization.PTQ): converts
+    Linears to int8 QuantizedLinear."""
+
+    def __init__(self, config: QuantConfig = QuantConfig()):
+        self.config = config
+
+    def quantize(self, model):
+        return _replace_linears(
+            model, lambda lin: quant_linear(lin, self.config.bits))

@@ -1,0 +1,175 @@
+"""Sparse tensors (ref: ``python/paddle/sparse/``).
+
+Built on ``jax.experimental.sparse.BCOO`` — XLA's batched-COO format, the
+only sparse representation with a TPU lowering. The reference's COO/CSR
+creation API, elementwise ops, and matmul are provided; CSR inputs are
+converted to BCOO (TPU kernels are gather/scatter based, so the distinction
+is a storage detail, not a performance one, unlike cuSPARSE).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "is_sparse", "is_sparse_coo",
+    "to_dense", "to_sparse_coo", "add", "subtract", "multiply", "divide",
+    "matmul", "masked_matmul", "relu", "tanh", "sigmoid", "abs", "neg",
+    "cast", "transpose", "sum", "nnz", "coalesce",
+]
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None):
+    """Ref: paddle.sparse.sparse_coo_tensor — indices [ndim, nnz]."""
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values, dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in indices.max(axis=1))
+    return jsparse.BCOO((values, indices.T), shape=tuple(shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """Ref: paddle.sparse.sparse_csr_tensor — 2-D CSR, stored as BCOO."""
+    crows = jnp.asarray(crows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    values = jnp.asarray(values, dtype)
+    n_rows = len(crows) - 1
+    rows = jnp.repeat(jnp.arange(n_rows, dtype=jnp.int32),
+                      jnp.diff(crows), total_repeat_length=values.shape[0])
+    idx = jnp.stack([rows, cols], axis=1)
+    return jsparse.BCOO((values, idx), shape=tuple(shape))
+
+
+def is_sparse(x):
+    return isinstance(x, jsparse.JAXSparse)
+
+
+is_sparse_coo = is_sparse
+
+
+def to_dense(x):
+    return x.todense() if is_sparse(x) else x
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """sparse_dim leading dims sparse, the rest dense (reference hybrid
+    layout → BCOO n_dense)."""
+    n_dense = 0 if sparse_dim is None else jnp.ndim(x) - sparse_dim
+    return jsparse.BCOO.fromdense(x, n_dense=n_dense)
+
+
+def coalesce(x):
+    return x.sum_duplicates(nse=int(x.nse))
+
+
+def nnz(x):
+    return x.nse
+
+
+def _ew(fn, x, y=None):
+    """Elementwise op on values (zero-preserving ops only)."""
+    if y is None:
+        return jsparse.BCOO((fn(x.data), x.indices), shape=x.shape)
+    if not (is_sparse(x) and is_sparse(y)):
+        # sparse x dense-array: dense result (reference returns dense too)
+        return fn(to_dense(x), to_dense(y))
+    # sparse-sparse: via dense with a STATIC nse bound so it stays jittable
+    # (structural result pattern ⊆ union of operand patterns)
+    nse = min(int(x.nse) + int(y.nse), int(np.prod(x.shape)))
+    return jsparse.BCOO.fromdense(fn(to_dense(x), to_dense(y)), nse=nse)
+
+
+def add(x, y):
+    if is_sparse(x) and is_sparse(y):
+        return _ew(jnp.add, x, y)
+    return to_dense(x) + to_dense(y)
+
+
+def subtract(x, y):
+    return _ew(jnp.subtract, x, y) if is_sparse(x) and is_sparse(y) \
+        else to_dense(x) - to_dense(y)
+
+
+def multiply(x, y):
+    if is_sparse(x) and not is_sparse(y) and jnp.ndim(y) == 0:
+        return jsparse.BCOO((x.data * y, x.indices), shape=x.shape)
+    return _ew(jnp.multiply, x, y)
+
+
+def divide(x, y):
+    if is_sparse(x) and not is_sparse(y) and jnp.ndim(y) == 0:
+        return jsparse.BCOO((x.data / y, x.indices), shape=x.shape)
+    if is_sparse(x) and is_sparse(y):
+        # reference semantics: same-pattern value-wise quotient (densifying
+        # would put 0/0 = NaN at every structural zero)
+        xs = x.sum_duplicates(nse=int(x.nse))
+        ys = y.sum_duplicates(nse=int(y.nse))
+        if xs.indices.shape != ys.indices.shape:
+            raise ValueError("sparse divide requires operands with the same "
+                             "sparsity pattern (reference behaviour)")
+        if not isinstance(xs.indices, jax.core.Tracer) and not bool(
+                jnp.all(xs.indices == ys.indices)):
+            # eager-only validation; under jit the same pattern is assumed
+            raise ValueError("sparse divide requires operands with the same "
+                             "sparsity pattern (reference behaviour)")
+        return jsparse.BCOO((xs.data / ys.data, xs.indices), shape=x.shape)
+    return _ew(jnp.divide, x, y)
+
+
+def matmul(x, y):
+    """sparse @ dense (or dense @ sparse) — BCOO dot_general on TPU;
+    __matmul__/__rmatmul__ dispatch covers both operand orders."""
+    return x @ y
+
+
+def masked_matmul(x, y, mask):
+    """Ref: paddle.sparse.masked_matmul — dense@dense sampled at mask's
+    sparsity (SDDMM)."""
+    rows = mask.indices[:, 0]
+    cols = mask.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", x[rows, :], y[:, cols].T)
+    return jsparse.BCOO((vals.astype(x.dtype), mask.indices), shape=mask.shape)
+
+
+def relu(x):
+    return _ew(jax.nn.relu, x)
+
+
+def tanh(x):
+    return _ew(jnp.tanh, x)
+
+
+def sigmoid(x):
+    # NOT zero-preserving; reference applies to stored values only
+    return _ew(jax.nn.sigmoid, x)
+
+
+def abs(x):
+    return _ew(jnp.abs, x)
+
+
+def neg(x):
+    return _ew(jnp.negative, x)
+
+
+def cast(x, dtype):
+    return jsparse.BCOO((x.data.astype(dtype), x.indices), shape=x.shape)
+
+
+def transpose(x, perm=(1, 0)):
+    return jsparse.bcoo_transpose(x, permutation=tuple(perm))
+
+
+def sum(x, axis=None, keepdim=False):
+    if axis is None:
+        out = jnp.sum(x.data)
+        return out.reshape((1,) * x.ndim) if keepdim else out
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a % x.ndim for a in axes)
+    out = jsparse.bcoo_reduce_sum(x, axes=axes)
+    if keepdim:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(x.shape))
+        out = jsparse.bcoo_reshape(out, new_sizes=shape)
+    return out

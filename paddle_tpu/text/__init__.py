@@ -1,0 +1,118 @@
+"""Text/tokenization utilities (ref capability: PaddleNLP
+``paddlenlp.transformers.*Tokenizer`` + ``paddle.text`` datasets).
+
+Tokenization is host-side string processing — no TPU angle — so we provide:
+ * a zero-dependency, reproducible ``SimpleTokenizer`` (whitespace/byte-level
+   with a trainable vocab) for tests and self-contained pipelines;
+ * ``AutoTokenizer`` which defers to the locally-installed ``transformers``
+   library when a pretrained vocab is available on disk (no downloads).
+
+Both return numpy int32 arrays shaped for ``paddle_tpu`` models
+(``input_ids``, ``attention_mask``) and pad to fixed lengths so downstream
+jit programs see static shapes.
+"""
+from __future__ import annotations
+
+import collections
+import re
+
+import numpy as np
+
+__all__ = ["SimpleTokenizer", "AutoTokenizer", "pad_batch"]
+
+
+def pad_batch(seqs, max_len=None, pad_id=0):
+    """Pad a list of int lists to [B, max_len] + mask (static shapes for jit)."""
+    max_len = max_len or max(len(s) for s in seqs)
+    ids = np.full((len(seqs), max_len), pad_id, np.int32)
+    mask = np.zeros((len(seqs), max_len), np.int32)
+    for i, s in enumerate(seqs):
+        s = s[:max_len]
+        ids[i, :len(s)] = s
+        mask[i, :len(s)] = 1
+    return ids, mask
+
+
+class SimpleTokenizer:
+    """Regex word-level tokenizer with special tokens (ref: paddlenlp
+    BasicTokenizer + vocab). Train on a corpus, encode/decode reversibly
+    for in-vocab text."""
+
+    PAT = re.compile(r"\w+|[^\w\s]")
+
+    def __init__(self, vocab=None, unk_token="[UNK]", pad_token="[PAD]",
+                 cls_token="[CLS]", sep_token="[SEP]", lowercase=True):
+        self.lowercase = lowercase
+        self.specials = [pad_token, unk_token, cls_token, sep_token]
+        self.unk_token, self.pad_token = unk_token, pad_token
+        self.cls_token, self.sep_token = cls_token, sep_token
+        self.vocab = dict(vocab) if vocab else {
+            t: i for i, t in enumerate(self.specials)}
+        self.inv = {i: t for t, i in self.vocab.items()}
+
+    # -- vocab ---------------------------------------------------------------
+    @classmethod
+    def train(cls, texts, vocab_size=30000, min_freq=1, **kw):
+        tok = cls(**kw)
+        counter = collections.Counter()
+        for t in texts:
+            counter.update(tok._tokens(t))
+        for word, freq in counter.most_common(vocab_size - len(tok.specials)):
+            if freq < min_freq:
+                break
+            if word not in tok.vocab:
+                tok.vocab[word] = len(tok.vocab)
+        tok.inv = {i: t for t, i in tok.vocab.items()}
+        return tok
+
+    @property
+    def vocab_size(self):
+        return len(self.vocab)
+
+    @property
+    def pad_token_id(self):
+        return self.vocab[self.pad_token]
+
+    @property
+    def unk_token_id(self):
+        return self.vocab[self.unk_token]
+
+    # -- encode/decode -------------------------------------------------------
+    def _tokens(self, text):
+        if self.lowercase:
+            text = text.lower()
+        return self.PAT.findall(text)
+
+    def encode(self, text, add_special_tokens=True, max_len=None):
+        ids = [self.vocab.get(t, self.unk_token_id) for t in self._tokens(text)]
+        if add_special_tokens:
+            ids = [self.vocab[self.cls_token]] + ids + [self.vocab[self.sep_token]]
+        if max_len is not None:
+            ids = ids[:max_len] + [self.pad_token_id] * (max_len - len(ids))
+        return ids
+
+    def __call__(self, texts, max_len=None, add_special_tokens=True):
+        if isinstance(texts, str):
+            texts = [texts]
+        seqs = [self.encode(t, add_special_tokens) for t in texts]
+        ids, mask = pad_batch(seqs, max_len, self.pad_token_id)
+        return {"input_ids": ids, "attention_mask": mask}
+
+    def decode(self, ids, skip_special_tokens=True):
+        toks = []
+        for i in np.asarray(ids).reshape(-1).tolist():
+            t = self.inv.get(int(i), self.unk_token)
+            if skip_special_tokens and t in self.specials:
+                continue
+            toks.append(t)
+        return " ".join(toks)
+
+
+class AutoTokenizer:
+    """Ref: paddlenlp.transformers.AutoTokenizer — loads any pretrained
+    tokenizer present on local disk via the installed ``transformers``."""
+
+    @staticmethod
+    def from_pretrained(path, **kw):
+        from transformers import AutoTokenizer as _HFAuto
+        return _HFAuto.from_pretrained(path, local_files_only=True, **kw)

@@ -1,0 +1,129 @@
+"""Collective-order lint (SURVEY §5: race/deadlock detection aux subsystem).
+
+The reference detects NCCL hangs at runtime (Fleet elastic watchdog,
+``paddle/fluid/distributed/collective/``). A functional SPMD program can be
+checked STATICALLY instead: the classic deadlock is a collective inside
+divergent control flow — one branch of a ``cond`` issues a ``psum`` the
+other doesn't, or a ``while_loop`` cond-fn launches collectives — so we walk
+the jaxpr and flag those patterns before anything runs on hardware.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.extend as jex
+
+# primitive names that lower to XLA collectives
+_COLLECTIVE_PRIMS = {
+    "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
+    "reduce_scatter", "psum_scatter", "pbroadcast", "axis_index", "pgather",
+}
+
+
+@dataclass
+class CollectiveIssue:
+    kind: str       # "cond-divergence" | "while-cond-collective"
+    detail: str
+
+    def __str__(self):
+        return f"[{self.kind}] {self.detail}"
+
+
+@dataclass
+class CollectiveReport:
+    sequence: list = field(default_factory=list)  # ordered (prim, axes) pairs
+    issues: list = field(default_factory=list)
+
+    @property
+    def ok(self):
+        return not self.issues
+
+
+def _axes_of(eqn) -> Any:
+    for key in ("axis_name", "axes", "axis_index_groups"):
+        if key in eqn.params and eqn.params[key] is not None:
+            return eqn.params[key]
+    return None
+
+
+def _sub_jaxprs(eqn):
+    out = []
+    for k, v in eqn.params.items():
+        if isinstance(v, jex.core.ClosedJaxpr):
+            out.append((k, v.jaxpr))
+        elif isinstance(v, jex.core.Jaxpr):
+            out.append((k, v))
+        elif isinstance(v, (tuple, list)):
+            for i, item in enumerate(v):
+                if isinstance(item, jex.core.ClosedJaxpr):
+                    out.append((f"{k}[{i}]", item.jaxpr))
+                elif isinstance(item, jex.core.Jaxpr):
+                    out.append((f"{k}[{i}]", item))
+    return out
+
+
+def _walk(jaxpr, report: CollectiveReport, path: str = ""):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _COLLECTIVE_PRIMS and name != "axis_index":
+            report.sequence.append((name, _axes_of(eqn)))
+        subs = _sub_jaxprs(eqn)
+        if name == "cond":
+            # each branch must issue the SAME collective sequence
+            branch_seqs = []
+            for label, sub in subs:
+                r = CollectiveReport()
+                _walk(sub, r, f"{path}/{name}.{label}")
+                branch_seqs.append((label, r))
+            seqs = [tuple(r.sequence) for _, r in branch_seqs]
+            if len(set(seqs)) > 1:
+                report.issues.append(CollectiveIssue(
+                    "cond-divergence",
+                    f"at {path or '<root>'}: cond branches issue different "
+                    f"collective sequences {dict((l, r.sequence) for l, r in branch_seqs)}"
+                    " — divergent collectives deadlock SPMD programs"))
+            for _, r in branch_seqs:
+                report.issues.extend(r.issues)
+            if seqs:
+                report.sequence.extend(seqs[0])
+        elif name == "while":
+            for label, sub in subs:
+                r = CollectiveReport()
+                _walk(sub, r, f"{path}/{name}.{label}")
+                if "cond" in label and r.sequence:
+                    report.issues.append(CollectiveIssue(
+                        "while-cond-collective",
+                        f"at {path or '<root>'}: while_loop condition issues "
+                        f"collectives {r.sequence} — the loop predicate must "
+                        "be replicated, not collective-dependent"))
+                report.sequence.extend(r.sequence)
+                report.issues.extend(r.issues)
+        else:
+            for label, sub in subs:
+                _walk(sub, report, f"{path}/{name}.{label}")
+
+
+def lint_collectives(fn, *args, axis_env=None, **kwargs) -> CollectiveReport:
+    """Trace ``fn`` and statically lint its collective usage.
+
+    Use on the function you pass to ``shard_map``, with ``axis_env`` naming
+    the mesh axes it runs under, e.g.
+    ``lint_collectives(stage_fn, x, axis_env=[("pp", 4)])``. Returns a
+    report with the ordered collective sequence and any deadlock-shaped
+    issues.
+    """
+    jaxpr = jax.make_jaxpr(fn, axis_env=axis_env, **kwargs)(*args)
+    report = CollectiveReport()
+    _walk(jaxpr.jaxpr, report)
+    return report
+
+
+def assert_no_collective_deadlock(fn, *args, axis_env=None, **kwargs) -> CollectiveReport:
+    report = lint_collectives(fn, *args, axis_env=axis_env, **kwargs)
+    if not report.ok:
+        raise RuntimeError(
+            "collective deadlock lint failed:\n  " +
+            "\n  ".join(str(i) for i in report.issues))
+    return report

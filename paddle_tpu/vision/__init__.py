@@ -1,3 +1,1 @@
-from paddle_tpu.vision import models, transforms
-from paddle_tpu.vision import models_extra
-from paddle_tpu.vision.models_extra import *  # noqa: F401,F403
+from paddle_tpu.vision import datasets, models, models_extra, transforms

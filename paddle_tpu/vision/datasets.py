@@ -1,0 +1,123 @@
+"""Vision datasets (ref: ``python/paddle/vision/datasets/``).
+
+File-backed parsers for the reference's dataset formats (MNIST idx,
+CIFAR pickle batches). No downloading — this environment has zero egress;
+point ``*_path`` at local copies. ``FakeData`` generates deterministic
+synthetic batches for pipeline tests (reference uses it the same way).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad idx image magic {magic}"
+        data = np.frombuffer(f.read(), np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad idx label magic {magic}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+class MNIST(Dataset):
+    """Ref: paddle.vision.datasets.MNIST — idx-format reader.
+
+    ``image_path``/``label_path`` point at (optionally gzipped) idx files.
+    """
+
+    def __init__(self, image_path, label_path, transform=None,
+                 backend="numpy"):
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # [1, H, W]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    """Same idx container as MNIST."""
+
+
+class Cifar10(Dataset):
+    """Ref: paddle.vision.datasets.Cifar10 — python-pickle tar reader."""
+
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file, mode="train", transform=None):
+        members = self._train_members if mode == "train" else self._test_members
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in members:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"])
+                    labels.extend(d[self._label_key])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic dataset for pipeline tests."""
+
+    def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rs = np.random.RandomState(self.seed + idx)
+        img = rs.randn(*self.image_shape).astype(np.float32)
+        label = int(rs.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label

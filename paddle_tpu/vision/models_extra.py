@@ -109,23 +109,25 @@ class SqueezeNet(Module):
 
     def __init__(self, version="1.0", num_classes=1000):
         super().__init__()
+        version = str(version)
         self.version = version
         if version == "1.0":
             self.stem = Conv2D(3, 96, 7, stride=2)
-            self.blocks = [
-                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
-                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+            first_in = 96
             self.pool_before = (3, 7)  # maxpool precedes these block indices
-        else:
+        elif version == "1.1":
             self.stem = Conv2D(3, 64, 3, stride=2)
-            self.blocks = [
-                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
-                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+            first_in = 64
             self.pool_before = (2, 4)
+        else:
+            raise ValueError(f"SqueezeNet version must be '1.0' or '1.1', "
+                             f"got {version!r}")
+        self.blocks = [
+            _Fire(first_in, 16, 64, 64), _Fire(128, 16, 64, 64),
+            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+        self.dropout = Dropout(0.5)
         self.final_conv = Conv2D(512, num_classes, 1)
         self.pool = AdaptiveAvgPool2D(1)
 
@@ -135,6 +137,7 @@ class SqueezeNet(Module):
             if i in self.pool_before:
                 x = F.max_pool2d(x, 3, 2)
             x = b(x)
+        x = self.dropout(x, rng=rng)
         x = self.pool(F.relu(self.final_conv(x)))
         return x.reshape(x.shape[0], -1)
 
@@ -448,11 +451,20 @@ class MobileNetV1(Module):
         return self.fc(self.pool(x).reshape(x.shape[0], -1))
 
 
+def _make_divisible(v, divisor=8):
+    """Reference channel rounding (mobilenet make_divisible)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
 class _SEBlock(Module):
     def __init__(self, c, reduction=4):
         super().__init__()
-        self.fc1 = Conv2D(c, c // reduction, 1)
-        self.fc2 = Conv2D(c // reduction, c, 1)
+        squeeze = _make_divisible(c // reduction, 8)
+        self.fc1 = Conv2D(c, squeeze, 1)
+        self.fc2 = Conv2D(squeeze, c, 1)
 
     def __call__(self, x):
         s = jnp.mean(x, axis=(2, 3), keepdims=True)
@@ -513,14 +525,15 @@ class _MobileNetV3(Module):
         self.head = _ConvBN(in_c, last_exp, 1, act="hardswish")
         self.pool = AdaptiveAvgPool2D(1)
         self.fc1 = Linear(last_exp, last_c)
+        self.dropout = Dropout(0.2)
         self.fc2 = Linear(last_c, num_classes)
 
-    def __call__(self, x):
+    def __call__(self, x, rng=None):
         x = self.stem(x)
         for b in self.blocks:
             x = b(x)
         x = self.pool(self.head(x)).reshape(x.shape[0], -1)
-        return self.fc2(F.hardswish(self.fc1(x)))
+        return self.fc2(self.dropout(F.hardswish(self.fc1(x)), rng=rng))
 
 
 class MobileNetV3Large(_MobileNetV3):

@@ -1,0 +1,130 @@
+"""autograd functional API + audio features + file-backed datasets."""
+import gzip
+import struct
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.audio as audio
+import paddle_tpu.autograd as ag
+
+
+# -- autograd ----------------------------------------------------------------
+
+def test_jacobian_hessian():
+    def f(x):
+        return (x ** 3).sum()
+
+    x = jnp.asarray([1.0, 2.0])
+    j = ag.jacobian(f, x)
+    assert np.allclose(np.asarray(j), 3 * np.asarray(x) ** 2)
+    h = ag.hessian(f, x)
+    assert np.allclose(np.asarray(h), np.diag(6 * np.asarray(x)))
+
+
+def test_jvp_vjp_vhp():
+    def f(x):
+        return jnp.sin(x).sum()
+
+    x = jnp.asarray([0.3, 0.7])
+    v = jnp.asarray([1.0, 2.0])
+    out, tangent = ag.jvp(f, x, v)
+    assert np.allclose(float(tangent), float((jnp.cos(x) * v).sum()), rtol=1e-6)
+    out, g = ag.vjp(f, x)
+    assert np.allclose(np.asarray(g), np.cos(np.asarray(x)), rtol=1e-6)
+    out, hv = ag.vhp(f, x, v)
+    assert np.allclose(np.asarray(hv), -np.sin(np.asarray(x)) * np.asarray(v),
+                       rtol=1e-6)
+
+
+def test_pylayer_custom_vjp():
+    class Double(ag.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return 2.0 * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return 10.0 * g  # deliberately wrong to prove custom vjp is used
+
+    x = jnp.asarray(3.0)
+    y = Double.apply(x)
+    assert float(y) == 6.0
+    g = jax.grad(lambda x: Double.apply(x))(x)
+    assert float(g) == 10.0
+
+
+# -- audio -------------------------------------------------------------------
+
+def test_mel_scale_roundtrip():
+    freqs = jnp.asarray([50.0, 440.0, 1000.0, 4000.0])
+    for htk in (False, True):
+        back = audio.mel_to_hz(audio.hz_to_mel(freqs, htk), htk)
+        assert np.allclose(np.asarray(back), np.asarray(freqs), rtol=1e-4)
+
+
+def test_fbank_matches_torchaudio_style():
+    fb = audio.compute_fbank_matrix(sr=16000, n_fft=400, n_mels=40)
+    assert fb.shape == (40, 201)
+    assert bool((fb >= 0).all())
+    # every filter has support, triangles overlap
+    assert bool((fb.sum(axis=1) > 0).all())
+
+
+def test_spectrogram_matches_torch():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 2048).astype(np.float32)
+    spec = audio.Spectrogram(n_fft=256, hop_length=128)(jnp.asarray(x))
+    want = torch.stft(torch.tensor(x), n_fft=256, hop_length=128,
+                      window=torch.hann_window(256, periodic=True),
+                      center=True, pad_mode="reflect",
+                      return_complex=True).abs().pow(2).numpy()
+    assert spec.shape == want.shape
+    assert np.allclose(np.asarray(spec), want, atol=1e-2)
+
+
+def test_mfcc_shapes_and_finite():
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 4096).astype(np.float32))
+    mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=64)(x)
+    assert mel.shape[1] == 64
+    mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_mels=64, n_fft=512)(x)
+    assert mfcc.shape[1] == 13
+    assert bool(jnp.isfinite(mfcc).all())
+    db = audio.power_to_db(mel, top_db=80.0)
+    assert float(db.max()) - float(db.min()) <= 80.0 + 1e-3
+
+
+# -- datasets ----------------------------------------------------------------
+
+def test_mnist_idx_reader(tmp_path):
+    from paddle_tpu.vision.datasets import MNIST
+    rs = np.random.RandomState(0)
+    imgs = rs.randint(0, 255, (5, 28, 28), dtype=np.uint8)
+    labels = rs.randint(0, 10, (5,), dtype=np.uint8)
+    ip = tmp_path / "images.idx3-ubyte.gz"
+    lp = tmp_path / "labels.idx1-ubyte.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5) + labels.tobytes())
+    ds = MNIST(str(ip), str(lp))
+    assert len(ds) == 5
+    img, lab = ds[2]
+    assert img.shape == (1, 28, 28)
+    assert lab == int(labels[2])
+    assert np.allclose(img[0], imgs[2].astype(np.float32))
+
+
+def test_fake_data_deterministic():
+    from paddle_tpu.vision.datasets import FakeData
+    ds = FakeData(size=8, image_shape=(3, 16, 16), num_classes=4)
+    img1, lab1 = ds[3]
+    img2, lab2 = ds[3]
+    assert np.array_equal(img1, img2) and lab1 == lab2
+    assert img1.shape == (3, 16, 16) and 0 <= lab1 < 4

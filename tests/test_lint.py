@@ -1,0 +1,79 @@
+"""Collective-deadlock lint: catches divergent-cond collectives and
+collective while-predicates; passes clean SPMD code."""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from paddle_tpu.utils.lint import (
+    assert_no_collective_deadlock,
+    lint_collectives,
+)
+
+AX = [("x", 4)]
+
+
+def test_clean_collective_sequence():
+    def f(v):
+        s = lax.psum(v, "x")
+        g = lax.all_gather(v, "x")
+        return s + g.sum()
+
+    rep = lint_collectives(f, jnp.ones(2), axis_env=AX)
+    assert rep.ok
+    assert [n for n, _ in rep.sequence] == ["psum", "all_gather"]
+
+
+def test_cond_divergence_flagged():
+    def f(v):
+        return lax.cond(v.sum() > 0,
+                        lambda u: lax.psum(u, "x"),
+                        lambda u: u * 2,
+                        v)
+
+    rep = lint_collectives(f, jnp.ones(2), axis_env=AX)
+    assert not rep.ok
+    assert rep.issues[0].kind == "cond-divergence"
+    with pytest.raises(RuntimeError):
+        assert_no_collective_deadlock(f, jnp.ones(2), axis_env=AX)
+
+
+def test_cond_symmetric_ok():
+    def f(v):
+        return lax.cond(v.sum() > 0,
+                        lambda u: lax.psum(u * 2, "x"),
+                        lambda u: lax.psum(u + 1, "x"),
+                        v)
+
+    rep = lint_collectives(f, jnp.ones(2), axis_env=AX)
+    assert rep.ok
+    assert [n for n, _ in rep.sequence] == ["psum"]
+
+
+def test_while_cond_collective_flagged():
+    def f(v):
+        def cond(c):
+            return lax.psum(c.sum(), "x") < 10
+
+        def body(c):
+            return c + 1
+
+        return lax.while_loop(cond, body, v)
+
+    rep = lint_collectives(f, jnp.ones(2), axis_env=AX)
+    assert not rep.ok
+    assert any(i.kind == "while-cond-collective" for i in rep.issues)
+
+
+def test_nested_scan_collectives_found():
+    def f(v):
+        def body(c, _):
+            return lax.ppermute(c, "x", [(i, (i + 1) % 4) for i in range(4)]), None
+
+        out, _ = lax.scan(body, v, None, length=3)
+        return lax.psum(out, "x")
+
+    rep = lint_collectives(f, jnp.ones(2), axis_env=AX)
+    assert rep.ok
+    names = [n for n, _ in rep.sequence]
+    assert names == ["ppermute", "psum"]

@@ -1,0 +1,129 @@
+"""paddle_tpu.sparse (BCOO-backed) vs dense golden values."""
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu.sparse as sp
+
+
+def _mk():
+    dense = np.array([[0, 2.0, 0], [3.0, 0, 4.0], [0, 0, 0], [5.0, 0, 0]],
+                     np.float32)
+    nz = np.nonzero(dense)
+    indices = np.stack(nz)  # [2, nnz]
+    values = dense[nz]
+    return dense, indices, values
+
+
+def test_coo_create_and_dense():
+    dense, idx, vals = _mk()
+    s = sp.sparse_coo_tensor(idx, vals, dense.shape)
+    assert sp.is_sparse(s)
+    assert sp.nnz(s) == 4
+    assert np.allclose(np.asarray(sp.to_dense(s)), dense)
+    s2 = sp.to_sparse_coo(jnp.asarray(dense))
+    assert np.allclose(np.asarray(sp.to_dense(s2)), dense)
+
+
+def test_csr_create():
+    dense, _, _ = _mk()
+    # CSR of the same matrix
+    crows = np.array([0, 1, 3, 3, 4])
+    cols = np.array([1, 0, 2, 0])
+    vals = np.array([2.0, 3.0, 4.0, 5.0], np.float32)
+    s = sp.sparse_csr_tensor(crows, cols, vals, dense.shape)
+    assert np.allclose(np.asarray(sp.to_dense(s)), dense)
+
+
+def test_elementwise_and_activation():
+    dense, idx, vals = _mk()
+    s = sp.sparse_coo_tensor(idx, -vals, dense.shape)
+    assert np.allclose(np.asarray(sp.to_dense(sp.relu(s))), np.maximum(-dense, 0))
+    assert np.allclose(np.asarray(sp.to_dense(sp.abs(s))), np.abs(dense))
+    assert np.allclose(np.asarray(sp.to_dense(sp.neg(s))), dense)
+    assert np.allclose(np.asarray(sp.to_dense(sp.multiply(s, 2.0))), -2 * dense)
+    t = sp.sparse_coo_tensor(idx, vals, dense.shape)
+    assert np.allclose(np.asarray(sp.to_dense(sp.add(s, t))), np.zeros_like(dense))
+    assert np.allclose(np.asarray(sp.to_dense(sp.tanh(t))), np.tanh(dense))
+
+
+def test_matmul_and_masked():
+    dense, idx, vals = _mk()
+    s = sp.sparse_coo_tensor(idx, vals, dense.shape)
+    w = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+    out = sp.matmul(s, jnp.asarray(w))
+    assert np.allclose(np.asarray(out), dense @ w, atol=1e-5)
+    # SDDMM: (a @ b) sampled at s's pattern
+    a = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    b = np.random.RandomState(2).randn(6, 3).astype(np.float32)
+    got = sp.masked_matmul(jnp.asarray(a), jnp.asarray(b), s)
+    want = (a @ b) * (dense != 0)
+    assert np.allclose(np.asarray(sp.to_dense(got)), want, atol=1e-4)
+
+
+def test_transpose_sum_cast():
+    dense, idx, vals = _mk()
+    s = sp.sparse_coo_tensor(idx, vals, dense.shape)
+    assert np.allclose(np.asarray(sp.to_dense(sp.transpose(s))), dense.T)
+    assert np.allclose(float(sp.sum(s)), dense.sum())
+    assert np.allclose(np.asarray(sp.to_dense(sp.sum(s, axis=1))), dense.sum(1))
+    assert sp.sum(s, axis=1, keepdim=True).shape == (4, 1)
+    assert sp.sum(s, keepdim=True).shape == (1, 1)
+    assert sp.cast(s, jnp.bfloat16).data.dtype == jnp.bfloat16
+
+
+def test_divide_same_pattern():
+    dense, idx, vals = _mk()
+    a = sp.sparse_coo_tensor(idx, vals, dense.shape)
+    b = sp.sparse_coo_tensor(idx, vals * 2, dense.shape)
+    q = sp.divide(a, b)
+    got = np.asarray(sp.to_dense(q))
+    assert np.all(np.isfinite(got))
+    assert np.allclose(got[dense != 0], 0.5)
+    assert np.allclose(got[dense == 0], 0.0)  # structural zeros stay zero
+    # mismatched pattern rejected
+    other_idx = idx.copy()
+    other_idx[1, 0] = (other_idx[1, 0] + 1) % 3
+    c = sp.sparse_coo_tensor(other_idx, vals, dense.shape)
+    import pytest
+    with pytest.raises(ValueError):
+        sp.divide(a, c)
+
+
+def test_sparse_ops_under_jit():
+    import jax
+    dense, idx, vals = _mk()
+    a = sp.sparse_coo_tensor(idx, vals, dense.shape)
+    b = sp.sparse_coo_tensor(idx, vals * 3, dense.shape)
+    out = jax.jit(lambda x, y: sp.to_dense(sp.add(x, y)))(a, b)
+    assert np.allclose(np.asarray(out), 4 * dense)
+    q = jax.jit(lambda x, y: sp.to_dense(sp.divide(x, y)))(a, b)
+    assert np.allclose(np.asarray(q)[dense != 0], 1 / 3, atol=1e-6)
+    mm = jax.jit(lambda x: sp.matmul(x, jnp.ones((3, 2))))(a)
+    assert np.allclose(np.asarray(mm), dense @ np.ones((3, 2)))
+
+
+def test_pylayer_multi_output():
+    import jax
+    import paddle_tpu.autograd as ag
+
+    class Split(ag.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return 2.0 * x, 3.0 * x
+
+        @staticmethod
+        def backward(ctx, ga, gb):
+            return 2.0 * ga + 3.0 * gb
+
+    a, b = Split.apply(jnp.asarray(1.0))
+    assert float(a) == 2.0 and float(b) == 3.0
+    g = jax.grad(lambda x: sum(Split.apply(x)))(jnp.asarray(1.0))
+    assert float(g) == 5.0
+
+
+def test_hybrid_to_sparse_coo():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    h = sp.to_sparse_coo(x, sparse_dim=1)
+    assert h.n_dense == 1
+    assert np.allclose(np.asarray(sp.to_dense(h)), np.asarray(x))

@@ -1,0 +1,41 @@
+"""SimpleTokenizer: train/encode/decode round trip, static-shape batching."""
+import numpy as np
+
+from paddle_tpu.text import SimpleTokenizer, pad_batch
+
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "pack my box with five dozen liquor jugs!",
+    "the dog barks.",
+]
+
+
+def test_train_encode_decode():
+    tok = SimpleTokenizer.train(CORPUS, vocab_size=100)
+    assert tok.vocab_size > 10
+    text = "the quick dog"
+    ids = tok.encode(text)
+    assert ids[0] == tok.vocab["[CLS]"] and ids[-1] == tok.vocab["[SEP]"]
+    assert tok.decode(ids) == text
+    # oov maps to UNK
+    ids2 = tok.encode("zyzzyva")
+    assert tok.unk_token_id in ids2
+
+
+def test_batch_static_shapes():
+    tok = SimpleTokenizer.train(CORPUS)
+    out = tok(["the dog", "the quick brown fox jumps"], max_len=12)
+    assert out["input_ids"].shape == (2, 12)
+    assert out["attention_mask"].shape == (2, 12)
+    assert out["input_ids"].dtype == np.int32
+    # padding area is pad_id with mask 0
+    assert out["attention_mask"][0].sum() < 12
+    pad_area = out["input_ids"][0][out["attention_mask"][0] == 0]
+    assert np.all(pad_area == tok.pad_token_id)
+
+
+def test_pad_batch_truncates():
+    ids, mask = pad_batch([[1, 2, 3, 4, 5], [6]], max_len=3, pad_id=9)
+    assert ids.tolist() == [[1, 2, 3], [6, 9, 9]]
+    assert mask.tolist() == [[1, 1, 1], [1, 0, 0]]
