@@ -1,0 +1,73 @@
+"""Hybrid-parallel LLaMA training on a device mesh (dp x fsdp x tp).
+
+Runs on real chips when available, or on a virtual CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_multichip.py --devices 8 --steps 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--fsdp", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=2)
+    args = ap.parse_args()
+
+    # flags must be in place BEFORE the backend initialises (first
+    # jax.devices() call) — same dance as __graft_entry__.dryrun_multichip
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        f" --xla_force_host_platform_device_count={args.devices}"
+    import jax
+    if jax.default_backend() != "tpu" or len(jax.devices()) < args.devices:
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.clear_backends()
+        except Exception:
+            pass
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed import HybridMesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.train import make_train_step
+    from paddle_tpu.train.step import init_state
+
+    mesh = HybridMesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
+                      devices=jax.devices()[:args.devices])
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=64,
+                           num_attention_heads=4, num_key_value_heads=2)
+    batch = args.dp * args.fsdp * 2
+    rs = np.random.RandomState(0)
+
+    with mesh:
+        model = LlamaForCausalLM(cfg)
+        optimizer = opt.AdamW(learning_rate=1e-3,
+                              grad_clip=opt.ClipGradByGlobalNorm(1.0))
+        state = init_state(model, optimizer, mesh)
+        step = make_train_step(lambda m, i, l: m.loss(i, l), optimizer, mesh)
+        for i in range(args.steps):
+            ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, 16)))
+            labels = jnp.concatenate(
+                [ids[:, 1:], -100 * jnp.ones((batch, 1), ids.dtype)], axis=1)
+            ids = jax.device_put(ids, mesh.batch_sharding())
+            labels = jax.device_put(labels, mesh.batch_sharding())
+            state, loss = step(state, ids, labels)
+            print(f"step {i} loss {float(loss):.4f} "
+                  f"(mesh dp={args.dp} fsdp={args.fsdp} tp={args.tp})")
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,8 +95,18 @@ def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
     return log_spec
 
 
-def _hann(n):
-    return 0.5 - 0.5 * jnp.cos(2 * math.pi * jnp.arange(n) / n)
+def _window(name, n):
+    if name in (None, "rect", "rectangular", "boxcar", "ones"):
+        return jnp.ones((n,), jnp.float32)
+    t = 2 * math.pi * jnp.arange(n) / n
+    if name == "hann":
+        return 0.5 - 0.5 * jnp.cos(t)
+    if name == "hamming":
+        return 0.54 - 0.46 * jnp.cos(t)
+    if name == "blackman":
+        return 0.42 - 0.5 * jnp.cos(t) + 0.08 * jnp.cos(2 * t)
+    raise ValueError(f"unsupported window {name!r}; use hann/hamming/"
+                     "blackman/rect")
 
 
 class Spectrogram:
@@ -107,8 +117,7 @@ class Spectrogram:
         self.n_fft, self.power = n_fft, power
         self.hop_length = hop_length or n_fft // 4
         self.win_length = win_length or n_fft
-        self.window = _hann(self.win_length) if window == "hann" else \
-            jnp.ones((self.win_length,), jnp.float32)
+        self.window = _window(window, self.win_length)
         self.center, self.pad_mode = center, pad_mode
 
     def __call__(self, x):

@@ -49,3 +49,12 @@ def get_world_size():
 def get_rank():
     import jax
     return jax.process_index()
+
+
+def recompute(fn, *args, **kwargs):
+    """Ref: paddle.distributed.fleet.utils.recompute — rematerialise
+    ``fn``'s activations in backward. Direct mapping onto jax.checkpoint."""
+    import jax
+    preserve = kwargs.pop("preserve_rng_state", None)  # reference kwarg; rng
+    # is explicit in this framework so nothing to preserve
+    return jax.checkpoint(lambda *a: fn(*a, **kwargs))(*args)

@@ -69,3 +69,24 @@ def compiled_cost_analysis(fn: Callable, *args, **kwargs) -> dict:
         return dict(compiled.cost_analysis())
     except Exception:
         return {}
+
+
+class InputSpec:
+    """Ref: paddle.static.InputSpec / paddle.jit input signatures.
+
+    Under XLA a spec is a ShapeDtypeStruct; None dims mark varying axes
+    (each distinct size triggers one retrace, same as the reference's
+    bucketing)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_shape_struct(self, fill=1):
+        import jax.numpy as jnp
+        shape = tuple(fill if s is None else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, jnp.dtype(self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"

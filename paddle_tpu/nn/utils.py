@@ -1,0 +1,88 @@
+"""Parameter utilities (ref: ``python/paddle/nn/utils/``): clip_grad_norm_,
+clip_grad_value_, parameters_to_vector, vector_to_parameters, weight_norm,
+spectral_norm.
+
+Functional flavours: "in-place" reference APIs return NEW pytrees here
+(params are immutable jax arrays)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+    "vector_to_parameters", "weight_norm", "remove_weight_norm",
+    "spectral_norm",
+]
+
+
+def clip_grad_norm_(grads, max_norm, norm_type=2.0):
+    """Global-norm clip over a grad pytree -> (clipped_grads, total_norm)."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if g is not None]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in leaves])) ** (1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    clipped = jax.tree_util.tree_map(
+        lambda g: None if g is None else (g * scale).astype(g.dtype), grads,
+        is_leaf=lambda x: x is None)
+    return clipped, total
+
+
+def clip_grad_value_(grads, clip_value):
+    return jax.tree_util.tree_map(
+        lambda g: None if g is None else jnp.clip(g, -clip_value, clip_value),
+        grads, is_leaf=lambda x: x is None)
+
+
+def parameters_to_vector(params):
+    """Flatten a param pytree into one fp32 vector (ref torch/paddle util)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    return jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
+
+
+def vector_to_parameters(vec, params_like):
+    """Inverse of parameters_to_vector: reshape vec into the given pytree."""
+    leaves, treedef = jax.tree_util.tree_flatten(params_like)
+    out, off = [], 0
+    for l in leaves:
+        n = l.size
+        out.append(vec[off:off + n].reshape(l.shape).astype(l.dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def weight_norm(weight, dim=0, eps=1e-12):
+    """Decompose weight into (g, v): weight = g * v / ||v|| along dim.
+    Returns (g, v) — the trainable reparameterisation (ref:
+    paddle.nn.utils.weight_norm). Use ``remove_weight_norm`` to re-fuse."""
+    axes = tuple(i for i in range(weight.ndim) if i != dim % weight.ndim)
+    g = jnp.sqrt(jnp.sum(jnp.square(weight.astype(jnp.float32)), axis=axes,
+                         keepdims=True) + eps).astype(weight.dtype)
+    return g, weight
+
+
+def remove_weight_norm(g, v, dim=0, eps=1e-12):
+    """Fuse (g, v) back into a plain weight."""
+    axes = tuple(i for i in range(v.ndim) if i != dim % v.ndim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes,
+                            keepdims=True) + eps).astype(v.dtype)
+    return g * v / norm
+
+
+def spectral_norm(weight, n_power_iterations=20, eps=1e-12, dim=0):
+    """One-shot spectral normalisation of a weight (ref layer form lives at
+    paddle_tpu.nn.SpectralNorm; this is the functional util)."""
+    mat = jnp.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    mat = mat.astype(jnp.float32)
+    u = jnp.ones((mat.shape[0],), jnp.float32) / jnp.sqrt(mat.shape[0])
+    for _ in range(n_power_iterations):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return (weight / sigma.astype(weight.dtype))

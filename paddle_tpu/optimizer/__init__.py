@@ -48,10 +48,16 @@ def _tree_map(f, *trees):
 
 
 def _map_params(f, params, *rest):
-    """Map over float param leaves, passing through None / int leaves."""
+    """Map over float param leaves, passing through None / int leaves.
+    A leaf whose companion (e.g. grad) is None — a non-trainable buffer —
+    also passes through unchanged."""
     def g(p, *r):
         if p is None or not hasattr(p, "dtype") or not jnp.issubdtype(p.dtype, jnp.floating):
             return p
+        if any(x is None for x in r):
+            # no grad (non-trainable buffer): keep the param AND its slot
+            # values unchanged, matching f's (p_new, *slots_new) convention
+            return p if len(r) <= 1 else (p,) + tuple(r[1:])
         return f(p, *r)
     return _tree_map(g, params, *rest)
 
@@ -124,7 +130,15 @@ class Optimizer:
     def init(self, params) -> dict:
         state = {"step": jnp.zeros((), jnp.int32)}
         if self.multi_precision:
-            state["master"] = _map_params(lambda p: p.astype(jnp.float32), params)
+            # master copies ONLY for reduced-precision float params — an
+            # fp32 "copy" via astype (or a passthrough leaf) would alias the
+            # param buffer, which breaks donation (same buffer donated
+            # twice) and wastes HBM
+            state["master"] = _tree_map(
+                lambda p: p.astype(jnp.float32)
+                if (p is not None and hasattr(p, "dtype")
+                    and jnp.issubdtype(p.dtype, jnp.floating)
+                    and p.dtype != jnp.float32) else None, params)
         state.update(self._init_slots(params))
         return state
 
@@ -151,14 +165,21 @@ class Optimizer:
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)
         lr = self._lr(state)
-        compute = state.get("master", params) if self.multi_precision else params
+        if self.multi_precision:
+            compute = _tree_map(
+                lambda p, m: m if m is not None else p, params, state["master"])
+        else:
+            compute = params
         new_compute, new_state = self._update(compute, grads, state, lr)
         new_state["step"] = state["step"] + 1
         if self.multi_precision:
-            new_state["master"] = new_compute
+            # keep master only where one existed (non-fp32 params)
+            new_state["master"] = _tree_map(
+                lambda m, c: c if m is not None else None,
+                state["master"], new_compute)
             new_params = _tree_map(
-                lambda p, m: m.astype(p.dtype) if m is not None and hasattr(p, "dtype") else p,
-                params, new_compute)
+                lambda p, m, c: c if m is None else c.astype(p.dtype),
+                params, state["master"], new_compute)
         else:
             new_params = new_compute
         return new_params, new_state
@@ -451,3 +472,80 @@ class Lion(Optimizer):
         pairs = _map_params(upd, params, grads, state["moment"])
         get = lambda i: _pluck(pairs, i)
         return get(0), {**state, "moment": get(1)}
+
+
+# -- incubate extras (ref python/paddle/incubate/optimizer/) -----------------
+
+class LookAhead(Optimizer):
+    """Ref: paddle.incubate.LookAhead — wraps an inner optimizer; every k
+    steps the slow weights absorb the fast ones: slow += alpha*(fast-slow).
+    Pure/jit-safe: the sync happens via a traced predicate."""
+
+    def __init__(self, inner: Optimizer, alpha=0.5, k=5):
+        super().__init__(learning_rate=inner.learning_rate)
+        self.inner, self.alpha, self.k = inner, alpha, k
+
+    def init(self, params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "inner": self.inner.init(params),
+            "slow": _map_params(lambda p: p.astype(jnp.float32), params),
+        }
+
+    def step(self, params, grads, state):
+        fast, inner_state = self.inner.step(params, grads, state["inner"])
+        la_step = state["step"] + 1
+        sync = (la_step % self.k == 0)
+
+        def merge(slow, f):
+            if slow is None or f is None or not hasattr(f, "dtype") \
+                    or not jnp.issubdtype(f.dtype, jnp.floating):
+                return slow
+            new_slow = slow + self.alpha * (f.astype(jnp.float32) - slow)
+            return jnp.where(sync, new_slow, slow)
+
+        new_slow = _tree_map(merge, state["slow"], fast)
+
+        def pick(f, slow):
+            if f is None or not hasattr(f, "dtype") \
+                    or not jnp.issubdtype(f.dtype, jnp.floating):
+                return f
+            return jnp.where(sync, slow.astype(f.dtype), f)
+
+        out = _tree_map(pick, fast, new_slow)
+        return out, {"step": la_step, "inner": inner_state, "slow": new_slow}
+
+
+class ExponentialMovingAverage:
+    """Ref: paddle.incubate.ExponentialMovingAverage (functional flavour).
+
+    shadow = ema.init(params); shadow = ema.update(shadow, params) each
+    step; eval_params = ema.apply(shadow, params)."""
+
+    def __init__(self, decay=0.999):
+        self.decay = decay
+
+    def init(self, params):
+        return _map_params(lambda p: p.astype(jnp.float32), params)
+
+    def update(self, shadow, params):
+        d = self.decay
+
+        def upd(s, p):
+            if s is None or p is None or not hasattr(p, "dtype") \
+                    or not jnp.issubdtype(p.dtype, jnp.floating):
+                return s
+            return d * s + (1 - d) * p.astype(jnp.float32)
+
+        return _tree_map(upd, shadow, params)
+
+    def apply(self, shadow, params):
+        """Return params with EMA values (cast back to param dtypes)."""
+
+        def pick(p, s):
+            if p is None or s is None or not hasattr(p, "dtype") \
+                    or not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            return s.astype(p.dtype)
+
+        return _tree_map(pick, params, shadow)

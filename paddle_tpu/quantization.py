@@ -152,10 +152,12 @@ class QATLinear(Module):
         self.weight, self.bias, self.bits = weight, bias, bits
 
     def __call__(self, x):
-        qmax = 2.0 ** (self.bits - 1) - 1
-        _, wscale = quantize_weight(self.weight, bits=self.bits, axis=1)
-        w = fake_quant(self.weight.astype(jnp.float32),
-                       (wscale * qmax).astype(jnp.float32),
+        # per-output-channel absmax scale (no need to materialise the int8
+        # weights during QAT — fake_quant only needs the scale)
+        wscale = jnp.maximum(
+            jnp.max(jnp.abs(self.weight.astype(jnp.float32)),
+                    axis=0, keepdims=True), 1e-8)
+        w = fake_quant(self.weight.astype(jnp.float32), wscale,
                        self.bits).astype(x.dtype)
         y = x @ w
         return y + self.bias if self.bias is not None else y
@@ -169,6 +171,13 @@ def _replace_linears(model, make):
     model = copy.deepcopy(model)  # the pass returns a new model (params
     # are immutable jax arrays, so this copies structure, not buffers)
 
+    def convert_item(item):
+        if isinstance(item, Linear):
+            return make(item)
+        if isinstance(item, Module):
+            convert_tree(item)
+        return item
+
     def convert_tree(m):
         for name in list(vars(m)):
             sub = getattr(m, name)
@@ -176,12 +185,15 @@ def _replace_linears(model, make):
                 object.__setattr__(m, name, make(sub))
             elif isinstance(sub, Module):
                 convert_tree(sub)
-            elif isinstance(sub, (list, tuple)):
+            elif isinstance(sub, list):
                 for i, item in enumerate(sub):
-                    if isinstance(item, Linear) and isinstance(sub, list):
-                        sub[i] = make(item)
-                    elif isinstance(item, Module):
-                        convert_tree(item)
+                    sub[i] = convert_item(item)
+            elif isinstance(sub, tuple):
+                object.__setattr__(
+                    m, name, tuple(convert_item(i) for i in sub))
+            elif isinstance(sub, dict):
+                for k in list(sub):
+                    sub[k] = convert_item(sub[k])
         return m
 
     return convert_tree(model)

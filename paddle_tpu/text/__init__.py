@@ -95,6 +95,11 @@ class SimpleTokenizer:
         if isinstance(texts, str):
             texts = [texts]
         seqs = [self.encode(t, add_special_tokens) for t in texts]
+        if max_len is not None and add_special_tokens:
+            # truncation preserves the closing [SEP] (reference behaviour)
+            sep = self.vocab[self.sep_token]
+            seqs = [s if len(s) <= max_len else s[:max_len - 1] + [sep]
+                    for s in seqs]
         ids, mask = pad_batch(seqs, max_len, self.pad_token_id)
         return {"input_ids": ids, "attention_mask": mask}
 

@@ -1,0 +1,84 @@
+"""nn.utils parameter helpers + LookAhead/EMA + recompute."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.nn import utils as U
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([[0.0]])}
+    clipped, total = U.clip_grad_norm_(grads, max_norm=1.0)
+    assert np.isclose(float(total), 5.0)
+    norm_after = np.sqrt(sum(float(jnp.sum(g ** 2))
+                             for g in jax.tree_util.tree_leaves(clipped)))
+    assert np.isclose(norm_after, 1.0, rtol=1e-5)
+    # under the norm: unchanged
+    c2, t2 = U.clip_grad_norm_(grads, max_norm=100.0)
+    assert np.allclose(np.asarray(c2["a"]), [3.0, 4.0])
+
+
+def test_clip_grad_value_and_vector_roundtrip():
+    grads = {"w": jnp.asarray([[1.5, -2.5]]), "b": jnp.asarray([0.5])}
+    c = U.clip_grad_value_(grads, 1.0)
+    assert float(jnp.max(jnp.abs(c["w"]))) <= 1.0
+    vec = U.parameters_to_vector(grads)
+    assert vec.shape == (3,)
+    back = U.vector_to_parameters(vec, grads)
+    for k in grads:
+        assert np.allclose(np.asarray(back[k]), np.asarray(grads[k]))
+
+
+def test_weight_norm_roundtrip_and_spectral():
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(6, 4).astype(np.float32))
+    g, v = U.weight_norm(w, dim=0)
+    fused = U.remove_weight_norm(g, v, dim=0)
+    assert np.allclose(np.asarray(fused), np.asarray(w), atol=1e-5)
+    wn = U.spectral_norm(w)
+    s = np.linalg.svd(np.asarray(wn), compute_uv=False)
+    assert abs(s[0] - 1.0) < 1e-3
+
+
+def test_lookahead_syncs_slow_weights():
+    pt.seed(0)
+    params = {"w": jnp.asarray([10.0])}
+    inner = opt.SGD(learning_rate=1.0)
+    la = opt.LookAhead(inner, alpha=0.5, k=2)
+    state = la.init(params)
+    g = {"w": jnp.asarray([1.0])}
+    # step 1: fast = 9, no sync
+    params, state = la.step(params, g, state)
+    assert np.isclose(float(params["w"][0]), 9.0)
+    # step 2: fast = 8, sync: slow = 10 + 0.5*(8-10) = 9 -> params = 9
+    params, state = la.step(params, g, state)
+    assert np.isclose(float(params["w"][0]), 9.0)
+    assert np.isclose(float(state["slow"]["w"][0]), 9.0)
+
+
+def test_ema():
+    ema = opt.ExponentialMovingAverage(decay=0.5)
+    params = {"w": jnp.asarray([0.0])}
+    shadow = ema.init(params)
+    shadow = ema.update(shadow, {"w": jnp.asarray([4.0])})
+    assert np.isclose(float(shadow["w"][0]), 2.0)
+    shadow = ema.update(shadow, {"w": jnp.asarray([4.0])})
+    assert np.isclose(float(shadow["w"][0]), 3.0)
+    applied = ema.apply(shadow, params)
+    assert np.isclose(float(applied["w"][0]), 3.0)
+
+
+def test_recompute_matches_plain():
+    from paddle_tpu.distributed import recompute
+
+    def f(x):
+        return jnp.sum(jnp.tanh(x) ** 2)
+
+    x = jnp.asarray(np.random.RandomState(1).randn(16).astype(np.float32))
+    g_plain = jax.grad(f)(x)
+    g_ckpt = jax.grad(lambda x: recompute(f, x))(x)
+    assert np.allclose(np.asarray(g_plain), np.asarray(g_ckpt), atol=1e-6)
