@@ -24,6 +24,8 @@ from paddle_tpu.distributed.sharded import (
     shard_module,
     with_sharding_constraint,
 )
+from paddle_tpu.distributed.ring_attention import make_ring_attention, ring_attention
+from paddle_tpu.distributed.ulysses import make_ulysses_attention, ulysses_attention
 from paddle_tpu.distributed.tensor_parallel import (
     ColumnParallelLinear,
     RowParallelLinear,

@@ -1,0 +1,51 @@
+"""DeepSpeed-Ulysses-style sequence parallelism (ref capability:
+``paddle.distributed.fleet`` sep-parallel / PaddleNLP sequence-parallel
+attention).
+
+Complement to ring attention (`ring_attention.py`): instead of rotating KV
+blocks around the ring, one ``all_to_all`` re-shards activations from
+sequence-sharded to head-sharded, runs ordinary full attention on a head
+slice, and a second ``all_to_all`` restores sequence sharding. Two
+collectives per layer, overlap-friendly on ICI, and the inner attention can
+use the Pallas flash kernel unchanged — the better choice when
+``num_heads >= sp`` and sequence length per chip is small.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.ops import attention as A
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                      scale=None):
+    """Attention over the full sequence with inputs sequence-sharded on
+    ``axis_name``. [B, S_local, H, D] in and out; H must divide by the axis
+    size. Call inside shard_map."""
+    sp = lax.axis_size(axis_name)
+    # seq-sharded -> head-sharded: gather sequence, scatter heads
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = A.scaled_dot_product_attention(qh, kh, vh, is_causal=causal,
+                                         scale=scale)
+    # head-sharded -> seq-sharded
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp"):
+    """Bind ulysses_attention onto a HybridMesh via shard_map: takes/returns
+    [B, S, H, D] arrays sequence-sharded over ``axis_name``."""
+    from jax import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh.mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)

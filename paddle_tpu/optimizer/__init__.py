@@ -489,7 +489,10 @@ class LookAhead(Optimizer):
         return {
             "step": jnp.zeros((), jnp.int32),
             "inner": self.inner.init(params),
-            "slow": _map_params(lambda p: p.astype(jnp.float32), params),
+            # copy=True: an fp32 astype would alias the param buffer and
+            # break donation (same-buffer-donated-twice)
+            "slow": _map_params(
+                lambda p: jnp.array(p, jnp.float32, copy=True), params),
         }
 
     def step(self, params, grads, state):
@@ -513,6 +516,15 @@ class LookAhead(Optimizer):
             return jnp.where(sync, slow.astype(f.dtype), f)
 
         out = _tree_map(pick, fast, new_slow)
+        # a multi_precision inner keeps its own fp32 master weights, and its
+        # next step reads from THOSE — sync must land there too, or it is
+        # overwritten immediately
+        if getattr(self.inner, "multi_precision", False) and \
+                "master" in inner_state:
+            inner_state = {**inner_state, "master": _tree_map(
+                lambda m, s: m if m is None or s is None
+                else jnp.where(sync, s, m),
+                inner_state["master"], new_slow)}
         return out, {"step": la_step, "inner": inner_state, "slow": new_slow}
 
 
@@ -526,7 +538,9 @@ class ExponentialMovingAverage:
         self.decay = decay
 
     def init(self, params):
-        return _map_params(lambda p: p.astype(jnp.float32), params)
+        # copy=True: see LookAhead.init — fp32 astype aliases the buffer
+        return _map_params(
+            lambda p: jnp.array(p, jnp.float32, copy=True), params)
 
     def update(self, shadow, params):
         d = self.decay

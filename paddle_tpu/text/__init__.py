@@ -88,18 +88,25 @@ class SimpleTokenizer:
         if add_special_tokens:
             ids = [self.vocab[self.cls_token]] + ids + [self.vocab[self.sep_token]]
         if max_len is not None:
-            ids = ids[:max_len] + [self.pad_token_id] * (max_len - len(ids))
+            if len(ids) > max_len:
+                # truncation preserves the closing [SEP] (reference behaviour)
+                if add_special_tokens:
+                    ids = ids[:max_len - 1] + [self.vocab[self.sep_token]]
+                else:
+                    ids = ids[:max_len]
+            ids = ids + [self.pad_token_id] * (max_len - len(ids))
         return ids
 
     def __call__(self, texts, max_len=None, add_special_tokens=True):
         if isinstance(texts, str):
             texts = [texts]
-        seqs = [self.encode(t, add_special_tokens) for t in texts]
-        if max_len is not None and add_special_tokens:
-            # truncation preserves the closing [SEP] (reference behaviour)
+        seqs = [self.encode(t, add_special_tokens, max_len=None)
+                for t in texts]
+        if max_len is not None:
             sep = self.vocab[self.sep_token]
-            seqs = [s if len(s) <= max_len else s[:max_len - 1] + [sep]
-                    for s in seqs]
+            seqs = [s if len(s) <= max_len else
+                    (s[:max_len - 1] + [sep] if add_special_tokens
+                     else s[:max_len]) for s in seqs]
         ids, mask = pad_batch(seqs, max_len, self.pad_token_id)
         return {"input_ids": ids, "attention_mask": mask}
 
