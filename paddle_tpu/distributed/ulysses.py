@@ -28,6 +28,10 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     ``axis_name``. [B, S_local, H, D] in and out; H must divide by the axis
     size. Call inside shard_map."""
     sp = lax.axis_size(axis_name)
+    if q.shape[2] % sp != 0:
+        raise ValueError(
+            f"ulysses_attention: num_heads={q.shape[2]} must be divisible by "
+            f"axis '{axis_name}' size {sp}")
     # seq-sharded -> head-sharded: gather sequence, scatter heads
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)

@@ -238,9 +238,10 @@ class T5ForConditionalGeneration(Module):
             hidden = self.t5.decode(tokens[:, :max_new_tokens + 1], enc,
                                     attention_mask)
             hidden = hidden * (cfg.d_model ** -0.5)
-            logits = hidden @ self.t5.shared.T
-            step_logits = jnp.take_along_axis(
-                logits, i[None, None, None].repeat(b, 0), axis=1)[:, 0]
+            # project ONLY step i into the vocab (the [b, L, vocab] matmul
+            # would be ~L× wasted MXU work per decode step)
+            h_i = jax.lax.dynamic_slice_in_dim(hidden, i, 1, axis=1)[:, 0]
+            step_logits = h_i @ self.t5.shared.T
             nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
             nxt = jnp.where(done, eos_token_id, nxt)
             done = done | (nxt == eos_token_id)

@@ -868,3 +868,125 @@ def sequence_mask(lengths, maxlen=None, dtype="bool"):
     maxlen = maxlen or int(jnp.max(lengths))
     row = jnp.arange(maxlen)
     return (row[None, :] < lengths[:, None]).astype(dtype)
+
+
+# -- spatial samplers (ref functional/vision.py: grid_sample / affine_grid) ---
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """Sampling grid from a batch of affine matrices.
+
+    ``theta`` is [N, 2, 3] with ``out_shape`` (N, C, H, W) → grid [N, H, W, 2],
+    or [N, 3, 4] with (N, C, D, H, W) → [N, D, H, W, 3]. Grid coords are in
+    [-1, 1], last axis ordered (x, y[, z]) fastest-varying-first as in the
+    reference (``python/paddle/nn/functional/vision.py``).
+    """
+    theta = jnp.asarray(theta)
+    spatial = out_shape[2:]
+    nd = len(spatial)
+
+    def base(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size, dtype=theta.dtype)
+        step = 2.0 / size
+        return jnp.arange(size, dtype=theta.dtype) * step + (step / 2 - 1.0)
+
+    # axes in (x, y, z) order = reversed spatial order
+    axes = [base(s) for s in reversed(spatial)]
+    mesh = jnp.meshgrid(*axes, indexing="ij")  # each [W,H(,D)] ordered x-major
+    # want output laid out [D,]H,W with last dim (x,y,z): stack then transpose
+    coords = jnp.stack([m for m in mesh], axis=-1)  # [W, H(, D), nd]
+    coords = jnp.transpose(coords, tuple(range(nd - 1, -1, -1)) + (nd,))  # [(D,)H,W,nd]
+    ones = jnp.ones(coords.shape[:-1] + (1,), theta.dtype)
+    hom = jnp.concatenate([coords, ones], axis=-1)          # [(D,)H,W,nd+1]
+    # HIGHEST: grid coords feed gathers — bf16 MXU rounding would shift pixels
+    grid = jnp.einsum("...k,njk->n...j", hom, theta,
+                      precision=lax.Precision.HIGHEST)      # [N,(D,)H,W,nd]
+    return grid
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(coord, size, align_corners):
+    if align_corners:
+        if size == 1:
+            return jnp.zeros_like(coord)
+        span = 2.0 * (size - 1)
+        coord = jnp.abs(coord) % span
+        return jnp.where(coord > size - 1, span - coord, coord)
+    span = 2.0 * size
+    coord = jnp.abs(coord + 0.5) % span
+    coord = jnp.where(coord > size, span - coord, coord) - 0.5
+    return jnp.clip(coord, 0, size - 1)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample ``x`` at ``grid`` locations (ref functional/vision.py).
+
+    4-D: x [N,C,H,W], grid [N,Hg,Wg,2] (x,y in [-1,1]) → [N,C,Hg,Wg].
+    5-D: x [N,C,D,H,W], grid [N,Dg,Hg,Wg,3] → [N,C,Dg,Hg,Wg].
+    Pure gather formulation — XLA lowers to vectorized dynamic-gathers; no
+    scatter, so it fuses into surrounding elementwise work.
+    """
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    assert grid.shape[-1] == nd, "grid last dim must match spatial rank"
+    cdtype = jnp.promote_types(x.dtype, jnp.float32)
+    g = grid.astype(cdtype)
+
+    # per-axis pixel coords; grid order is (x, y[, z]) → spatial axes reversed
+    coords = []
+    for i in range(nd):
+        size = spatial[nd - 1 - i]            # x ↔ last spatial axis
+        c = _unnormalize(g[..., i], size, align_corners)
+        if padding_mode == "reflection":
+            c = _reflect(c, size, align_corners)
+        elif padding_mode == "border":
+            c = jnp.clip(c, 0, size - 1)
+        coords.append(c)
+    coords = coords[::-1]  # now ordered like spatial axes ((z,)y,x)
+
+    x_cl = jnp.moveaxis(x, 1, -1)  # [N, *spatial, C] — channels-last gather
+
+    def gather(idx_list, valid):
+        # idx_list: per-spatial-axis integer index arrays [N, *out_spatial]
+        n = x.shape[0]
+        bidx = jnp.arange(n).reshape((n,) + (1,) * (idx_list[0].ndim - 1))
+        clipped = [jnp.clip(ix, 0, s - 1) for ix, s in zip(idx_list, spatial)]
+        out = x_cl[(bidx,) + tuple(clipped)]   # [N, *out_spatial, C]
+        if valid is not None:
+            out = jnp.where(valid[..., None], out, 0)
+        return out
+
+    if mode == "nearest":
+        idx = [jnp.round(c).astype(jnp.int32) for c in coords]
+        valid = None
+        if padding_mode == "zeros":
+            valid = jnp.ones(idx[0].shape, bool)
+            for ix, s in zip(idx, spatial):
+                valid &= (ix >= 0) & (ix <= s - 1)
+        out = gather(idx, valid)
+        return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+    # bilinear / trilinear: 2^nd corner gathers with product weights
+    lo = [jnp.floor(c).astype(jnp.int32) for c in coords]
+    frac = [c - l for c, l in zip(coords, lo)]
+    out = 0.0
+    for corner in range(1 << nd):
+        idx, w = [], 1.0
+        for axis in range(nd):
+            hi_bit = (corner >> axis) & 1
+            ix = lo[axis] + hi_bit
+            idx.append(ix)
+            w = w * (frac[axis] if hi_bit else (1.0 - frac[axis]))
+        valid = None
+        if padding_mode == "zeros":
+            valid = jnp.ones(idx[0].shape, bool)
+            for ix, s in zip(idx, spatial):
+                valid &= (ix >= 0) & (ix <= s - 1)
+        out = out + gather(idx, valid) * w[..., None].astype(cdtype)
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)

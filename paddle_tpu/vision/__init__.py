@@ -1,1 +1,1 @@
-from paddle_tpu.vision import datasets, models, models_extra, transforms
+from paddle_tpu.vision import datasets, models, models_extra, ops, transforms
