@@ -10,9 +10,10 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from paddle_tpu import amp, core, io, nn, ops, optimizer, utils
-from paddle_tpu import (audio, autograd, distribution, fft, linalg,
+from paddle_tpu import amp, callbacks, core, io, nn, ops, optimizer, utils
+from paddle_tpu import (audio, autograd, distribution, fft, geometric, linalg,
                         quantization, signal, sparse, text)
+from paddle_tpu.summary_utils import flops, summary
 from paddle_tpu.core.device import (
     device_count,
     get_device,

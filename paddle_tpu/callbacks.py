@@ -1,0 +1,208 @@
+"""Training callbacks (ref: ``python/paddle/hapi/callbacks.py``).
+
+Same hook surface as the reference (on_train_begin/…/on_epoch_end etc.),
+driven by :class:`paddle_tpu.hapi.Model.fit` and usable from
+``paddle_tpu.train.Trainer``. Host-side by design — callbacks observe
+scalars the step already syncs, never injecting host work into the
+compiled path.
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+
+import numpy as np
+
+__all__ = [
+    "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+    "EarlyStopping", "LRSchedulerCallback", "ReduceLROnPlateau",
+]
+
+
+def _scheduler_of(model):
+    """The LRScheduler attached to the owning Model/Trainer's optimizer —
+    optimizers store it as ``optimizer.learning_rate`` (see optimizer/__init__)."""
+    from paddle_tpu.optimizer.lr import LRScheduler
+    lr = getattr(getattr(model, "optimizer", None), "learning_rate", None)
+    return lr if isinstance(lr, LRScheduler) else None
+
+
+class Callback:
+    """Hook base (ref hapi/callbacks.py:Callback). ``model`` is the owning
+    Model/Trainer; ``params`` carries epochs/steps metadata."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks=(), model=None, params=None):
+        self.callbacks = list(callbacks or ())
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+        self.stop_training = False
+
+    def _fire(self, name, *args, logs=None):
+        for c in self.callbacks:
+            getattr(c, name)(*args, logs if logs is not None else {})
+            if getattr(c, "stop_training", False):
+                self.stop_training = True
+
+    def on_train_begin(self, logs=None): self._fire("on_train_begin", logs=logs)
+    def on_train_end(self, logs=None): self._fire("on_train_end", logs=logs)
+    def on_epoch_begin(self, e, logs=None): self._fire("on_epoch_begin", e, logs=logs)
+    def on_epoch_end(self, e, logs=None): self._fire("on_epoch_end", e, logs=logs)
+    def on_train_batch_begin(self, s, logs=None): self._fire("on_train_batch_begin", s, logs=logs)
+    def on_train_batch_end(self, s, logs=None): self._fire("on_train_batch_end", s, logs=logs)
+    def on_eval_begin(self, logs=None): self._fire("on_eval_begin", logs=logs)
+    def on_eval_end(self, logs=None): self._fire("on_eval_end", logs=logs)
+
+
+class ProgBarLogger(Callback):
+    """Step/epoch console logger (ref ProgBarLogger; plain-line output
+    instead of a terminal progress bar — robust in non-tty jobs)."""
+
+    def __init__(self, log_freq=10, verbose=1):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        self._seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._seen += 1
+        if self.verbose and step % self.log_freq == 0:
+            items = []
+            for k, v in (logs or {}).items():
+                try:  # accept python/numpy/jax scalars alike
+                    f = float(np.asarray(v).reshape(-1)[0])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if not math.isnan(f):
+                    items.append(f"{k}: {f:.4f}")
+            print(f"[epoch {self._epoch}] step {step} " + " ".join(items))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            rate = self._seen / dt if dt > 0 else float("inf")
+            print(f"[epoch {epoch}] done in {dt:.1f}s ({rate:.1f} steps/s)")
+
+
+class ModelCheckpoint(Callback):
+    """Periodic save (ref ModelCheckpoint): every ``save_freq`` epochs into
+    ``save_dir/{epoch}``, plus ``save_dir/final`` at train end."""
+
+    def __init__(self, save_freq=1, save_dir="checkpoints"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and epoch % self.save_freq == 0:
+            os.makedirs(self.save_dir, exist_ok=True)
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.model is not None:
+            os.makedirs(self.save_dir, exist_ok=True)
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (ref EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, min_delta=0,
+                 baseline=None, verbose=1):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor or monitor.endswith("auc") else "min"
+        self.mode = mode
+        self.stop_training = False
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.stop_training = False  # reset so the instance is reusable
+        self.best = (self.baseline if self.baseline is not None
+                     else (math.inf if self.mode == "min" else -math.inf))
+
+    def _better(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(np.asarray(cur).reshape(-1)[0])
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+                if self.verbose:
+                    print(f"EarlyStopping: no {self.monitor} improvement for "
+                          f"{self.wait} epochs (best {self.best:.6f})")
+
+
+class LRSchedulerCallback(Callback):
+    """Advance an epoch-granularity LR scheduler (ref LRScheduler callback).
+
+    Step-granularity schedules are compiled into the train step in this
+    framework; this callback exists for epoch-driven schedules like
+    StepDecay/MultiStepDecay attached to the optimizer.
+    """
+
+    def __init__(self, by_step=False, by_epoch=True):
+        super().__init__()
+        self.by_epoch = by_epoch and not by_step
+
+    def on_epoch_end(self, epoch, logs=None):
+        sched = _scheduler_of(self.model)
+        if self.by_epoch and sched is not None:
+            sched.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Callback flavour of the ReduceOnPlateau scheduler (ref
+    hapi/callbacks.py:ReduceLROnPlateau) — drives
+    ``optimizer.lr_scheduler.step(metric)`` with the monitored value."""
+
+    def __init__(self, monitor="loss"):
+        super().__init__()
+        self.monitor = monitor
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        sched = _scheduler_of(self.model)
+        if cur is not None and sched is not None:
+            sched.step(float(np.asarray(cur).reshape(-1)[0]))

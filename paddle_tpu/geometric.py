@@ -1,0 +1,158 @@
+"""Graph-learning ops (ref: ``python/paddle/geometric/``).
+
+Paddle's geometric package wraps CUDA scatter/gather kernels
+(``paddle/phi/kernels/graph_send_recv_kernel.cu`` etc.). On TPU these are
+segment reductions — XLA lowers ``jax.ops.segment_*`` to sorted-scatter,
+which vectorises well; ``num_segments``/output size must be static under
+jit (pass ``out_size``), matching the reference's ``out_size`` argument.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _num_segments(segment_ids, n):
+    if n is not None:
+        return int(n)
+    # eager fallback — data-dependent, host sync (same as reference CPU path)
+    return int(jax.device_get(jnp.max(segment_ids))) + 1 if segment_ids.size else 0
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    """Ref ``python/paddle/geometric/math.py:segment_sum``."""
+    n = _num_segments(segment_ids, num_segments)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=n)
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                              segment_ids, num_segments=n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return s / jnp.maximum(cnt.reshape(shape), 1)
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    """Empty segments yield 0 like the reference (not +inf)."""
+    n = _num_segments(segment_ids, num_segments)
+    out = jax.ops.segment_min(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), jnp.int32),
+                              segment_ids, num_segments=n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return jnp.where(cnt.reshape(shape) > 0, out, 0)
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+    out = jax.ops.segment_max(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), jnp.int32),
+                              segment_ids, num_segments=n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return jnp.where(cnt.reshape(shape) > 0, out, 0)
+
+
+_REDUCERS = {"sum": segment_sum, "mean": segment_mean, "min": segment_min,
+             "max": segment_max, "add": segment_sum}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    """Gather source-node features along edges, reduce at destinations
+    (ref ``python/paddle/geometric/message_passing/send_recv.py``)."""
+    msgs = jnp.take(x, src_index, axis=0)
+    n = out_size if out_size is not None else x.shape[0]
+    return _REDUCERS[reduce_op](msgs, dst_index, n)
+
+
+def _combine(xe, e, message_op):
+    if message_op in ("add", "sum"):
+        return xe + e
+    if message_op == "sub":
+        return xe - e
+    if message_op == "mul":
+        return xe * e
+    if message_op == "div":
+        return xe / e
+    raise ValueError(f"unknown message_op {message_op!r}")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    """Like :func:`send_u_recv` but combines edge features ``y`` into the
+    message first (ref send_ue_recv). ``y``: [E, ...] broadcastable to x."""
+    msgs = _combine(jnp.take(x, src_index, axis=0), jnp.asarray(y), message_op)
+    n = out_size if out_size is not None else x.shape[0]
+    return _REDUCERS[reduce_op](msgs, dst_index, n)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add"):
+    """Per-edge message from both endpoint features (ref send_uv): returns
+    [E, ...] with no reduction."""
+    return _combine(jnp.take(x, src_index, axis=0),
+                    jnp.take(y, dst_index, axis=0), message_op)
+
+
+def reindex_graph(x, neighbors, count):
+    """Compact global node ids to local ids (ref reindex_graph). Host-side
+    (hash-map semantics are inherently sequential) — pipeline glue, eager.
+
+    Returns (reindexed_src, reindexed_dst, out_nodes): out_nodes is
+    [x ∪ neighbors] unique-ordered, edges re-labelled into that space.
+    """
+    x_np = np.asarray(x)
+    nbr = np.asarray(neighbors)
+    cnt = np.asarray(count)
+    uniq, first_pos = np.unique(np.concatenate([x_np, nbr]), return_index=True)
+    # preserve first-appearance order like the reference
+    order = np.argsort(first_pos, kind="stable")
+    out_nodes = uniq[order]
+    lookup = {int(v): i for i, v in enumerate(out_nodes)}
+    src = np.array([lookup[int(v)] for v in nbr], np.int64)
+    dst = np.repeat(np.arange(len(x_np)), cnt).astype(np.int64)
+    return jnp.asarray(src), jnp.asarray(dst), jnp.asarray(out_nodes)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, *, seed=0):
+    """Uniform neighbor sampling from CSC graph (ref sample_neighbors).
+    Host-side numpy (data-dependent shapes); returns (neighbors, counts)."""
+    rng = np.random.default_rng(seed)
+    row_np, colptr_np = np.asarray(row), np.asarray(colptr)
+    out, counts = [], []
+    for v in np.asarray(input_nodes):
+        lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+        nbrs = row_np[lo:hi]
+        if 0 <= sample_size < len(nbrs):
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out.append(nbrs)
+        counts.append(len(nbrs))
+    cat = np.concatenate(out) if out else np.empty(0, row_np.dtype)
+    return jnp.asarray(cat), jnp.asarray(np.array(counts, np.int64))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, *, seed=0):
+    """Weight-proportional sampling without replacement (ref
+    weighted_sample_neighbors)."""
+    rng = np.random.default_rng(seed)
+    row_np, colptr_np = np.asarray(row), np.asarray(colptr)
+    w_np = np.asarray(edge_weight, np.float64)
+    out, counts = [], []
+    for v in np.asarray(input_nodes):
+        lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+        nbrs = row_np[lo:hi]
+        if 0 <= sample_size < len(nbrs):
+            p = w_np[lo:hi]
+            p = p / p.sum()
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False, p=p)
+        out.append(nbrs)
+        counts.append(len(nbrs))
+    cat = np.concatenate(out) if out else np.empty(0, row_np.dtype)
+    return jnp.asarray(cat), jnp.asarray(np.array(counts, np.int64))

@@ -44,19 +44,40 @@ class Model:
             self._step_fn = jax.jit(step, donate_argnums=(0,))
         return self
 
-    def fit(self, train_data, eval_data=None, epochs=1, verbose=1, log_freq=50):
+    def fit(self, train_data, eval_data=None, epochs=1, verbose=1,
+            log_freq=50, callbacks=None):
+        from paddle_tpu.callbacks import CallbackList, ProgBarLogger
+        callbacks = list(callbacks or ())
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in callbacks):
+            # reference hapi injects the logger too — all step logging goes
+            # through callbacks, no inline prints
+            callbacks.insert(0, ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        cbs = CallbackList(callbacks, model=self,
+                           params={"epochs": epochs, "verbose": verbose})
         history = []
+        cbs.on_train_begin()
         for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            lv = None
             for i, batch in enumerate(train_data):
                 x, y = batch[0], batch[1]
+                cbs.on_train_batch_begin(i)
                 self._state, lv = self._step_fn(self._state, jnp.asarray(x), jnp.asarray(y))
-                if verbose and i % log_freq == 0:
-                    rec = {"epoch": epoch, "step": i, "loss": float(lv)}
-                    history.append(rec)
-                    print(f"[epoch {epoch}] step {i} loss {rec['loss']:.4f}")
+                if i % log_freq == 0:
+                    history.append({"epoch": epoch, "step": i, "loss": float(lv)})
+                # callbacks get the device scalar and sync only if they read
+                # it — keeps dispatch async between logging steps
+                cbs.on_train_batch_end(i, logs={"loss": lv})
             self.network = self._state.model
+            logs = {"loss": float(lv) if lv is not None else None}
             if eval_data is not None:
-                history.append({"epoch": epoch, **self.evaluate(eval_data, verbose=0)})
+                ev = self.evaluate(eval_data, verbose=0)
+                logs.update(ev)
+                history.append({"epoch": epoch, **ev})
+            cbs.on_epoch_end(epoch, logs=logs)
+            if cbs.stop_training:
+                break
+        cbs.on_train_end()
         return history
 
     def evaluate(self, eval_data, verbose=1):

@@ -1,0 +1,90 @@
+"""Model summary + FLOPs (ref: ``python/paddle/hapi/model_summary.py`` and
+``python/paddle/hapi/dynamic_flops.py``).
+
+``summary`` walks the pytree module (no forward hooks needed — structure is
+static) and shape-infers the output with ``jax.eval_shape`` (zero FLOPs, no
+device memory). ``flops`` asks XLA's compiled cost model instead of the
+reference's hand-maintained per-layer FLOP table — exact for whatever the
+model actually lowers to.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["summary", "flops"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None, print_fn=print):
+    """Layer table + parameter counts (ref ``paddle.summary``).
+
+    ``input_size``: shape tuple or list of shape tuples (batch dim included,
+    None → 1). Returns {'total_params': .., 'trainable_params': ..,
+    'output_shape': ..}.
+    """
+    owned = {}  # id(module) -> direct param count
+    for _path, _name, leaf, owner in net._iter_named():
+        if hasattr(leaf, "shape"):
+            owned[id(owner)] = owned.get(id(owner), 0) + int(np.prod(leaf.shape))
+
+    lines = ["-" * 64,
+             f"{'Layer (type)':<40}{'Param #':>20}",
+             "=" * 64]
+    for mod in net.sublayers(include_self=True):
+        lines.append(f"{type(mod).__name__:<40}{owned.get(id(mod), 0):>20,}")
+    total = net.num_parameters()
+    lines.append("=" * 64)
+    lines.append(f"Total params: {total:,}")
+
+    out_desc = None
+    if input_size is not None or input is not None:
+        if input is not None:
+            args = input if isinstance(input, (list, tuple)) else (input,)
+            specs = [jax.ShapeDtypeStruct(jnp.asarray(a).shape,
+                                          jnp.asarray(a).dtype) for a in args]
+        else:
+            if not input_size:
+                raise ValueError("summary() needs a non-empty input_size")
+            sizes = (input_size if isinstance(input_size[0], (list, tuple))
+                     else [input_size])
+            dts = dtypes or [jnp.float32] * len(sizes)
+            specs = [jax.ShapeDtypeStruct(
+                tuple(1 if d is None else d for d in s), dt)
+                for s, dt in zip(sizes, dts)]
+        out = jax.eval_shape(lambda *xs: net(*xs), *specs)
+        out_desc = jax.tree_util.tree_map(lambda s: tuple(s.shape), out)
+        lines.append(f"Output shape: {out_desc}")
+    lines.append("-" * 64)
+    if print_fn:
+        print_fn("\n".join(lines))
+    return {"total_params": total, "trainable_params": total,
+            "output_shape": out_desc}
+
+
+def flops(net, input_size=None, inputs=None, print_fn=print):
+    """FLOPs of one forward pass from XLA's compiled cost analysis (ref
+    ``paddle.flops``; here exact-for-the-lowering instead of a per-layer
+    estimate table). Returns total FLOPs as an int (0 if the backend does
+    not expose a cost model)."""
+    if inputs is None:
+        if not input_size:
+            raise ValueError("flops() needs input_size or inputs")
+        sizes = (input_size if isinstance(input_size[0], (list, tuple))
+                 else [input_size])
+        inputs = [jnp.zeros(tuple(1 if d is None else d for d in s),
+                            jnp.float32) for s in sizes]
+    elif not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    fn = jax.jit(lambda m, *xs: m(*xs))
+    compiled = fn.lower(net, *inputs).compile()
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        total = int(cost.get("flops", 0))
+    except Exception:
+        total = 0
+    if print_fn:
+        print_fn(f"FLOPs: {total:,}")
+    return total

@@ -95,6 +95,8 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         # reference iterates only the listed categories — drop the rest
         allowed = np.isin(np.asarray(category_idxs), np.asarray(list(categories)))
         order = order[allowed[order]]
+        if order.size == 0:
+            return jnp.zeros((0,), jnp.int32)
     sorted_boxes = boxes[order]
     if category_idxs is not None:
         # offset each category into its own disjoint coordinate region
