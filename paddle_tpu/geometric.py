@@ -150,8 +150,12 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
         nbrs = row_np[lo:hi]
         if 0 <= sample_size < len(nbrs):
             p = w_np[lo:hi]
-            p = p / p.sum()
-            nbrs = rng.choice(nbrs, size=sample_size, replace=False, p=p)
+            tot = p.sum()
+            if tot > 0:
+                nbrs = rng.choice(nbrs, size=sample_size, replace=False,
+                                  p=p / tot)
+            else:  # all-zero weights (pruned edges): uniform fallback
+                nbrs = rng.choice(nbrs, size=sample_size, replace=False)
         out.append(nbrs)
         counts.append(len(nbrs))
     cat = np.concatenate(out) if out else np.empty(0, row_np.dtype)

@@ -71,7 +71,9 @@ class Model:
             self.network = self._state.model
             logs = {"loss": float(lv) if lv is not None else None}
             if eval_data is not None:
+                cbs.on_eval_begin()
                 ev = self.evaluate(eval_data, verbose=0)
+                cbs.on_eval_end(logs=ev)
                 logs.update(ev)
                 history.append({"epoch": epoch, **ev})
             cbs.on_epoch_end(epoch, logs=logs)

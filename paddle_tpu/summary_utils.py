@@ -23,9 +23,10 @@ def summary(net, input_size=None, dtypes=None, input=None, print_fn=print):
     None → 1). Returns {'total_params': .., 'trainable_params': ..,
     'output_shape': ..}.
     """
-    owned = {}  # id(module) -> direct param count
-    for _path, _name, leaf, owner in net._iter_named():
-        if hasattr(leaf, "shape"):
+    owned = {}  # id(module) -> direct param count (buffers excluded, so the
+    # column sums to the num_parameters() total)
+    for _path, name, leaf, owner in net._iter_named():
+        if hasattr(leaf, "shape") and name not in owner._buffers:
             owned[id(owner)] = owned.get(id(owner), 0) + int(np.prod(leaf.shape))
 
     lines = ["-" * 64,

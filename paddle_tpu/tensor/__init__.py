@@ -843,3 +843,166 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     n1 = jnp.linalg.norm(x1, axis=axis)
     n2 = jnp.linalg.norm(x2, axis=axis)
     return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+# -- complex ops (ref python/paddle/tensor/attribute.py, creation.py) --------
+
+conj = jnp.conj
+real = jnp.real
+imag = jnp.imag
+angle = jnp.angle
+
+
+def complex(real_part, imag_part):
+    return jax.lax.complex(jnp.asarray(real_part, jnp.float32),
+                           jnp.asarray(imag_part, jnp.float32))
+
+
+def polar(abs_val, angle_val):
+    return complex(abs_val * jnp.cos(angle_val), abs_val * jnp.sin(angle_val))
+
+
+# -- misc math gap-fill (ref python/paddle/tensor/math.py) -------------------
+
+copysign = jnp.copysign
+signbit = jnp.signbit
+ldexp = jnp.ldexp
+nextafter = jnp.nextafter
+i0 = jax.scipy.special.i0
+i0e = jax.scipy.special.i0e
+i1 = jax.scipy.special.i1
+i1e = jax.scipy.special.i1e
+gammaln = jax.scipy.special.gammaln
+multigammaln = jax.scipy.special.multigammaln
+
+
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def frexp(x):
+    return jnp.frexp(x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jax.scipy.integrate.trapezoid(y, x=x, axis=axis)
+    return jax.scipy.integrate.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    """Running trapezoid integral (one fewer element along axis)."""
+    y = jnp.asarray(y)
+    n = y.shape[axis]
+    y0 = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    avg = (y0 + y1) * 0.5
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = n
+            x = x.reshape(shape)
+        d = jnp.diff(x, axis=axis)
+        return jnp.cumsum(avg * d, axis=axis)
+    return jnp.cumsum(avg * (1.0 if dx is None else dx), axis=axis)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def renorm(x, p, axis, max_norm):
+    """Clamp the p-norm of every slice along ``axis`` to ``max_norm``."""
+    x = jnp.asarray(x)
+    axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return x * factor
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def take(x, index, mode="raise"):
+    """Flattened gather (ref math.py:take). mode: 'raise'|'wrap'|'clip' —
+    'raise' clamps like 'clip' on device (no exceptions under jit)."""
+    flat = jnp.asarray(x).reshape(-1)
+    idx = jnp.asarray(index)
+    if mode == "wrap":
+        idx = idx % flat.shape[0]
+    else:
+        idx = jnp.clip(idx, -flat.shape[0], flat.shape[0] - 1)
+    return flat[idx].reshape(idx.shape)
+
+
+# -- split/shape gap-fill (ref python/paddle/tensor/manipulation.py) ---------
+
+def tensor_split(x, num_or_indices, axis=0):
+    return jnp.array_split(x, num_or_indices, axis=axis)
+
+
+def hsplit(x, num_or_indices):
+    return jnp.hsplit(x, num_or_indices)
+
+
+def vsplit(x, num_or_indices):
+    return jnp.vsplit(x, num_or_indices)
+
+
+def dsplit(x, num_or_indices):
+    return jnp.dsplit(x, num_or_indices)
+
+
+atleast_1d = jnp.atleast_1d
+atleast_2d = jnp.atleast_2d
+atleast_3d = jnp.atleast_3d
+
+
+def index_fill(x, index, axis, value):
+    x = jnp.asarray(x)
+    idx = jnp.asarray(index)
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[idx].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+def masked_scatter(x, mask, value):
+    """Fill True positions of ``mask`` with consecutive elements of ``value``
+    (ref manipulation.py:masked_scatter). Static-shape formulation: the k-th
+    True position (row-major) takes value.flatten()[k]."""
+    x = jnp.asarray(x)
+    m = jnp.broadcast_to(jnp.asarray(mask, bool), x.shape).reshape(-1)
+    v = jnp.asarray(value).reshape(-1)
+    pos = jnp.cumsum(m) - 1  # index into v for each True slot
+    flat = x.reshape(-1)
+    out = jnp.where(m, v[jnp.clip(pos, 0, v.shape[0] - 1)], flat)
+    return out.reshape(x.shape)
+
+
+bitwise_left_shift = jnp.left_shift
+bitwise_right_shift = jnp.right_shift
+
+
+def poisson(x):
+    x = jnp.asarray(x)
+    out = jax.random.poisson(_k(), x)
+    # jnp.issubdtype, not dtype.kind: ml_dtypes (bfloat16) report kind 'V'
+    return out.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else out
+
+
+def standard_gamma(x):
+    return jax.random.gamma(_k(), jnp.asarray(x))
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def log_normal(mean=1.0, std=2.0, shape=(1,), dtype=None):
+    return jnp.exp(jax.random.normal(_k(), shape,
+                                     dtype=dtype or get_default_dtype()) * std + mean)

@@ -109,3 +109,95 @@ def test_scatter_gather_nd():
     idx = jnp.array([[0, 1], [2, 2]])
     g = pt.gather_nd(jnp.arange(9.0).reshape(3, 3), idx)
     np.testing.assert_allclose(np.asarray(g), [1.0, 8.0])
+
+
+# -- round-1 gap-fill ops (complex, integrals, scatter variants) -------------
+
+class TestGapFillOps:
+    def test_complex_polar(self):
+        import paddle_tpu as pt
+        r = np.asarray(pt.polar(jnp.array([2.0]), jnp.array([np.pi / 2])))
+        np.testing.assert_allclose(r.real, 0.0, atol=1e-6)
+        np.testing.assert_allclose(r.imag, 2.0, atol=1e-6)
+        z = pt.complex(jnp.array([1.0]), jnp.array([-1.0]))
+        assert np.asarray(pt.conj(z)).imag[0] == 1.0
+        np.testing.assert_allclose(np.asarray(pt.angle(z)), -np.pi / 4, atol=1e-6)
+
+    def test_trapezoid_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        y = np.random.default_rng(0).standard_normal((3, 7)).astype(np.float32)
+        x = np.sort(np.random.default_rng(1).standard_normal(7)).astype(np.float32)
+        import paddle_tpu as pt
+        np.testing.assert_allclose(
+            np.asarray(pt.trapezoid(jnp.asarray(y), x=jnp.asarray(x))),
+            torch.trapezoid(torch.tensor(y), x=torch.tensor(x)).numpy(), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pt.cumulative_trapezoid(jnp.asarray(y), dx=0.5)),
+            torch.cumulative_trapezoid(torch.tensor(y), dx=0.5).numpy(), atol=1e-5)
+
+    def test_logcumsumexp_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.default_rng(2).standard_normal((4, 5)).astype(np.float32)
+        import paddle_tpu as pt
+        np.testing.assert_allclose(
+            np.asarray(pt.logcumsumexp(jnp.asarray(x), axis=1)),
+            torch.logcumsumexp(torch.tensor(x), dim=1).numpy(), atol=1e-5)
+
+    def test_renorm_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.default_rng(3).standard_normal((3, 4, 5)).astype(np.float32)
+        import paddle_tpu as pt
+        got = np.asarray(pt.renorm(jnp.asarray(x), 2.0, 0, 1.0))
+        ref = torch.renorm(torch.tensor(x), 2, 0, 1.0).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_take_modes(self):
+        import paddle_tpu as pt
+        x = jnp.arange(6).reshape(2, 3)
+        np.testing.assert_array_equal(
+            np.asarray(pt.take(x, jnp.array([0, 7]), "wrap")), [0, 1])
+        np.testing.assert_array_equal(
+            np.asarray(pt.take(x, jnp.array([0, 7]), "clip")), [0, 5])
+        np.testing.assert_array_equal(
+            np.asarray(pt.take(x, jnp.array([-1]), "wrap")), [5])
+
+    def test_splits_and_atleast(self):
+        import paddle_tpu as pt
+        parts = pt.tensor_split(jnp.arange(7), 3)
+        assert [p.shape[0] for p in parts] == [3, 2, 2]
+        a, b = pt.hsplit(jnp.ones((2, 4)), 2)
+        assert a.shape == (2, 2)
+        assert pt.atleast_2d(jnp.array(1.0)).shape == (1, 1)
+
+    def test_index_fill_and_masked_scatter(self):
+        torch = pytest.importorskip("torch")
+        import paddle_tpu as pt
+        x = np.random.default_rng(4).standard_normal((3, 4)).astype(np.float32)
+        got = np.asarray(pt.index_fill(jnp.asarray(x), jnp.array([0, 2]), 1, 9.0))
+        ref = torch.tensor(x).index_fill(1, torch.tensor([0, 2]), 9.0).numpy()
+        np.testing.assert_allclose(got, ref)
+        mask = x > 0
+        vals = np.arange(mask.sum(), dtype=np.float32) + 100
+        got = np.asarray(pt.masked_scatter(jnp.asarray(x), jnp.asarray(mask),
+                                           jnp.asarray(vals)))
+        ref = torch.tensor(x).masked_scatter(torch.tensor(mask),
+                                             torch.tensor(vals)).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_random_families(self):
+        import paddle_tpu as pt
+        pt.seed(0)
+        p = np.asarray(pt.poisson(jnp.full((2000,), 4.0)))
+        assert p.dtype == np.float32 and abs(p.mean() - 4.0) < 0.3
+        g = np.asarray(pt.standard_gamma(jnp.full((2000,), 3.0)))
+        assert abs(g.mean() - 3.0) < 0.3
+        ln = np.asarray(pt.log_normal(0.0, 0.25, (2000,)))
+        assert ln.min() > 0
+
+    def test_special_functions(self):
+        import paddle_tpu as pt
+        np.testing.assert_allclose(float(pt.i0(jnp.array(1.0))), 1.2660658, atol=1e-4)
+        np.testing.assert_allclose(float(pt.polygamma(jnp.array(2.0), 1)),
+                                   0.6449341, atol=1e-4)
+        m, e = pt.frexp(jnp.array([10.0]))
+        np.testing.assert_allclose(np.asarray(m) * 2.0 ** np.asarray(e), 10.0)
