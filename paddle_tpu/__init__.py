@@ -43,6 +43,12 @@ from paddle_tpu.jit import to_static, no_grad, grad
 from paddle_tpu.train.checkpoint import load, save
 
 jit = jit_module.jit
+# paddle-style namespace access (paddle.jit.save/load/to_static) — the `jit`
+# name is the callable, with the module surface attached as attributes
+jit.save = jit_module.save
+jit.load = jit_module.load
+jit.to_static = jit_module.to_static
+jit.InputSpec = jit_module.InputSpec
 
 
 def __getattr__(name):

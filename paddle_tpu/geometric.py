@@ -150,11 +150,10 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
         nbrs = row_np[lo:hi]
         if 0 <= sample_size < len(nbrs):
             p = w_np[lo:hi]
-            tot = p.sum()
-            if tot > 0:
+            if np.count_nonzero(p) >= sample_size:
                 nbrs = rng.choice(nbrs, size=sample_size, replace=False,
-                                  p=p / tot)
-            else:  # all-zero weights (pruned edges): uniform fallback
+                                  p=p / p.sum())
+            else:  # too few positive-weight edges: uniform fallback
                 nbrs = rng.choice(nbrs, size=sample_size, replace=False)
         out.append(nbrs)
         counts.append(len(nbrs))

@@ -85,16 +85,25 @@ class Model:
     def evaluate(self, eval_data, verbose=1):
         for m in self.metrics:
             m.reset()
-        model = (self._state.model if self._state is not None else self.network).eval()
-        fwd = jax.jit(lambda m, x: m(x))
-        losses = []
-        for batch in eval_data:
-            x, y = batch[0], batch[1]
-            out = fwd(model, jnp.asarray(x))
-            if self.loss is not None:
-                losses.append(float(self.loss(out, jnp.asarray(y))))
-            for m in self.metrics:
-                m.update(np.asarray(out), np.asarray(y))
+        model = self._state.model if self._state is not None else self.network
+        # eval() mutates in place AND `training` is static pytree aux — flip
+        # it without restoring and the next train step silently retraces
+        # with dropout off. Snapshot per-layer modes and restore at the end.
+        modes = [m.training for m in model.sublayers(include_self=True)]
+        model.eval()
+        try:
+            fwd = jax.jit(lambda m, x: m(x))
+            losses = []
+            for batch in eval_data:
+                x, y = batch[0], batch[1]
+                out = fwd(model, jnp.asarray(x))
+                if self.loss is not None:
+                    losses.append(float(self.loss(out, jnp.asarray(y))))
+                for m in self.metrics:
+                    m.update(np.asarray(out), np.asarray(y))
+        finally:
+            for sub, was in zip(model.sublayers(include_self=True), modes):
+                object.__setattr__(sub, "training", was)
         res = {"eval_loss": float(np.mean(losses)) if losses else None}
         for m in self.metrics:
             res[f"eval_{m.name()}"] = m.accumulate()
@@ -103,10 +112,16 @@ class Model:
         return res
 
     def predict(self, test_data):
-        model = (self._state.model if self._state is not None else self.network).eval()
-        fwd = jax.jit(lambda m, x: m(x))
-        return [np.asarray(fwd(model, jnp.asarray(b[0] if isinstance(b, (tuple, list)) else b)))
-                for b in test_data]
+        model = self._state.model if self._state is not None else self.network
+        modes = [m.training for m in model.sublayers(include_self=True)]
+        model.eval()
+        try:
+            fwd = jax.jit(lambda m, x: m(x))
+            return [np.asarray(fwd(model, jnp.asarray(b[0] if isinstance(b, (tuple, list)) else b)))
+                    for b in test_data]
+        finally:
+            for sub, was in zip(model.sublayers(include_self=True), modes):
+                object.__setattr__(sub, "training", was)
 
     def save(self, path):
         net = self._state.model if self._state is not None else self.network

@@ -71,6 +71,72 @@ def compiled_cost_analysis(fn: Callable, *args, **kwargs) -> dict:
         return {}
 
 
+def save(fn_or_module, path: str, input_spec=None, example_args=None):
+    """Serialize a traced program to disk (ref ``paddle.jit.save``: dygraph →
+    inference Program + params). TPU-native form: ``jax.export`` serializes
+    the StableHLO module + embedded weights — one artifact, loadable and
+    runnable without the Python model class (the same deploy story as the
+    reference's ``.pdmodel``/``.pdiparams`` pair).
+
+    ``fn_or_module``: a Module (its ``__call__`` is exported) or a function.
+    Provide ``input_spec`` (list of :class:`InputSpec`) or ``example_args``.
+    """
+    from jax import export as jexport
+
+    import jax.numpy as jnp
+
+    if example_args is None:
+        if input_spec is None:
+            raise ValueError("jit.save needs input_spec or example_args")
+        # None dims become export symbols so the artifact accepts any size
+        # along them (paddle InputSpec(None, ...) semantics)
+        scope = jexport.SymbolicScope()
+        example_args = []
+        for i, s in enumerate(input_spec):
+            dims = [f"_d{i}_{j}" if d is None else str(d)
+                    for j, d in enumerate(s.shape)]
+            shape = jexport.symbolic_shape(",".join(dims), scope=scope)
+            example_args.append(jax.ShapeDtypeStruct(shape, jnp.dtype(s.dtype)))
+        example_args = tuple(example_args)
+    elif not isinstance(example_args, (tuple, list)):
+        example_args = (example_args,)
+
+    from paddle_tpu.core.module import Module
+    if isinstance(fn_or_module, Module):
+        mod = fn_or_module
+        # snapshot per-layer modes: eval() mutates in place and the caller
+        # may be mid-training
+        modes = [m.training for m in mod.sublayers(include_self=True)]
+        mod.eval()
+        fn = lambda *xs: mod(*xs)
+    else:
+        mod, modes = None, None
+        fn = fn_or_module
+    try:
+        exported = jexport.export(jax.jit(fn))(*example_args)
+        data = exported.serialize()
+    finally:
+        if mod is not None:
+            for m, was in zip(mod.sublayers(include_self=True), modes):
+                object.__setattr__(m, "training", was)
+    if not path.endswith(".stablehlo"):
+        path = path + ".stablehlo"
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def load(path: str):
+    """Load a program saved by :func:`save`; returns a callable running the
+    compiled artifact (ref ``paddle.jit.load``)."""
+    from jax import export as jexport
+    if not path.endswith(".stablehlo"):
+        path = path + ".stablehlo"
+    with open(path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    return jax.jit(exported.call)
+
+
 class InputSpec:
     """Ref: paddle.static.InputSpec / paddle.jit input signatures.
 

@@ -1,1 +1,2 @@
-from paddle_tpu.vision import datasets, models, models_extra, ops, transforms
+from paddle_tpu.vision import (datasets, models, models_extra, ops, transforms,
+                               vit)

@@ -1,0 +1,99 @@
+"""Vision Transformer family (ref capability: PaddleClas ``ppcls/arch/
+backbone/model_zoo/vision_transformer.py`` — ViT-Ti/S/B/L, DeiT variants).
+
+TPU-first notes: patch embedding is one strided conv (maps to the MXU as an
+im2col matmul); the token stream [B, 1+N, D] keeps D on the 128-lane axis;
+encoder blocks are pre-LN (``normalize_before=True``) transformer layers
+reused from ``paddle_tpu.nn`` so flash attention and AMP policies apply
+unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Conv2D, Dropout, LayerNorm, Linear
+from paddle_tpu.nn.transformer import TransformerEncoder, TransformerEncoderLayer
+
+__all__ = ["VisionTransformer", "vit_tiny_patch16_224", "vit_small_patch16_224",
+           "vit_base_patch16_224", "vit_base_patch32_224", "vit_large_patch16_224"]
+
+
+class PatchEmbed(Module):
+    """Image → patch tokens via one strided conv (im2col matmul on MXU)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768,
+                 dtype=None):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = Conv2D(in_chans, embed_dim, patch_size, stride=patch_size,
+                           dtype=dtype)
+
+    def __call__(self, x):
+        x = self.proj(x)                       # [B, D, H/p, W/p]
+        b, d = x.shape[0], x.shape[1]
+        return x.reshape(b, d, -1).transpose(0, 2, 1)  # [B, N, D]
+
+
+class VisionTransformer(Module):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, drop_rate=0.0, class_num=None, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        num_classes = class_num if class_num is not None else num_classes
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim, dtype=dtype)
+        n = self.patch_embed.num_patches
+        self.cls_token = I.TruncatedNormal(std=0.02)((1, 1, embed_dim), dtype)
+        self.pos_embed = I.TruncatedNormal(std=0.02)((1, n + 1, embed_dim), dtype)
+        self.pos_drop = Dropout(drop_rate)
+        self.encoder = TransformerEncoder(
+            lambda: TransformerEncoderLayer(
+                embed_dim, num_heads, int(embed_dim * mlp_ratio),
+                dropout=drop_rate, activation="gelu", normalize_before=True,
+                dtype=dtype),
+            depth)
+        self.norm = LayerNorm(embed_dim, dtype=dtype)
+        self.head = Linear(embed_dim, num_classes, dtype=dtype)
+
+    def forward_features(self, x, rng=None):
+        b = x.shape[0]
+        x = self.patch_embed(x)
+        cls = jnp.broadcast_to(self.cls_token, (b, 1, x.shape[-1]))
+        x = jnp.concatenate([cls.astype(x.dtype), x], axis=1)
+        x = self.pos_drop(x + self.pos_embed.astype(x.dtype), rng=rng)
+        x = self.encoder(x, rng=rng)
+        return self.norm(x)
+
+    def __call__(self, x, rng=None):
+        feats = self.forward_features(x, rng=rng)
+        return self.head(feats[:, 0])          # classify on the cls token
+
+
+def _vit(patch, dim, depth, heads, **kw):
+    return VisionTransformer(patch_size=patch, embed_dim=dim, depth=depth,
+                             num_heads=heads, **kw)
+
+
+def vit_tiny_patch16_224(**kw):
+    return _vit(16, 192, 12, 3, **kw)
+
+
+def vit_small_patch16_224(**kw):
+    return _vit(16, 384, 12, 6, **kw)
+
+
+def vit_base_patch16_224(**kw):
+    return _vit(16, 768, 12, 12, **kw)
+
+
+def vit_base_patch32_224(**kw):
+    return _vit(32, 768, 12, 12, **kw)
+
+
+def vit_large_patch16_224(**kw):
+    return _vit(16, 1024, 24, 16, **kw)

@@ -174,3 +174,47 @@ def test_fleet_facade():
         ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (4, 16)))
         out = jax.jit(lambda mm, i: mm(i))(ms, ids)
     assert out.shape == (4, 16, cfg.vocab_size)
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32))
+    ref = np.asarray(net(x))
+    assert net.training  # fresh modules are in train mode
+    p = pt.jit.save(net, str(tmp_path / "net"), example_args=x)
+    assert net.training  # save() must not leave the module in eval mode
+    f = pt.jit.load(p)
+    np.testing.assert_allclose(np.asarray(f(x)), ref, atol=1e-6)
+    # InputSpec None dims export as symbolic: any batch size works
+    p2 = pt.jit.save(net, str(tmp_path / "net2"),
+                     input_spec=[pt.jit.InputSpec((None, 8))])
+    f2 = pt.jit.load(p2)
+    assert f2(jnp.ones((1, 8))).shape == (1, 4)
+    assert f2(jnp.ones((5, 8))).shape == (5, 4)
+
+
+def test_evaluate_restores_train_mode():
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi import Model
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Dropout(0.5), nn.Linear(8, 2))
+    m = Model(net)
+    m.prepare(optimizer=opt.SGD(learning_rate=0.1),
+              loss=lambda out, y: nn.functional.cross_entropy(out, y))
+    rng = np.random.default_rng(0)
+    data = [(rng.standard_normal((4, 4)).astype(np.float32),
+             rng.integers(0, 2, 4))]
+    m.fit(data, eval_data=data, epochs=2, verbose=0)
+    assert all(s.training for s in m._state.model.sublayers(include_self=True))
+    m.predict(data)
+    assert all(s.training for s in m._state.model.sublayers(include_self=True))
