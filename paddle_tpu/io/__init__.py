@@ -12,5 +12,6 @@ from paddle_tpu.io.sampler import (
     Sampler,
     SequenceSampler,
 )
-from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.dataloader import (DataLoader, WorkerInfo,
+                                      default_collate_fn, get_worker_info)
 from paddle_tpu.io.token_bin import TokenBinDataset

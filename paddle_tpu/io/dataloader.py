@@ -1,13 +1,20 @@
 """DataLoader (ref: ``python/paddle/io/dataloader/dataloader_iter.py``).
 
 The reference spawns multiprocessing workers feeding a pinned-memory queue.
-TPU-native host pipeline: a thread pool (numpy collation releases the GIL
-for the heavy copies) + a bounded prefetch queue, overlapping host batch
-prep with device steps. For token-LM training prefer the native C++ reader
-(paddle_tpu.io.token_bin.TokenBinDataset) which does mmap + prefetch in C++.
+Here (no CUDA pinned memory on the host→TPU path):
+
+- map-style + ``num_workers>0`` → forked worker processes pulling
+  index-batches from a task queue, results reassembled IN ORDER (the
+  reference's ``_DataLoaderIterMultiProcess`` reordering), so determinism
+  matches num_workers=0.
+- iterable datasets → one producer thread with a bounded prefetch queue
+  (numpy collation releases the GIL for the heavy copies).
+- token-LM training → prefer the native C++ reader
+  (``paddle_tpu.io.token_bin.TokenBinDataset``): mmap + prefetch in C++.
 """
 from __future__ import annotations
 
+import multiprocessing as mp
 import queue
 import threading
 from typing import Callable, Optional
@@ -16,6 +23,35 @@ import numpy as np
 
 from paddle_tpu.io.dataset import Dataset, IterableDataset
 from paddle_tpu.io.sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+
+
+def get_worker_info():
+    """Inside a worker: (id, num_workers, seed); None in the main process
+    (ref ``paddle.io.get_worker_info``)."""
+    return getattr(_worker_info, "info", None)
+
+
+def _mp_worker(dataset, collate_fn, task_q, result_q, wid, num_workers, seed):
+    _worker_info.info = WorkerInfo(wid, num_workers, seed)
+    while True:
+        task = task_q.get()
+        if task is None:
+            break
+        seq, idxs = task
+        try:
+            batch = collate_fn([dataset[i] for i in idxs])
+            result_q.put((seq, batch, None))
+        except Exception as e:  # surface the real error in the parent
+            result_q.put((seq, None, f"{type(e).__name__}: {e}"))
 
 
 def default_collate_fn(samples):
@@ -32,11 +68,14 @@ class DataLoader:
     def __init__(self, dataset: Dataset, batch_size: int = 1, shuffle: bool = False,
                  drop_last: bool = False, collate_fn: Optional[Callable] = None,
                  num_workers: int = 0, prefetch_factor: int = 2,
-                 batch_sampler: Optional[BatchSampler] = None, seed=None):
+                 batch_sampler: Optional[BatchSampler] = None, seed=None,
+                 mp_start_method: str = "fork"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.seed = seed
+        self.mp_start_method = mp_start_method
         self.iterable = isinstance(dataset, IterableDataset)
         if self.iterable:
             self.batch_sampler = None
@@ -70,13 +109,22 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._batches()
             return
+        if self.iterable:
+            yield from self._threaded_iter()
+            return
+        yield from self._mp_iter()
+
+    def _threaded_iter(self):
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         _END = object()
+        failure = []
 
         def producer():
             try:
                 for b in self._batches():
                     q.put(b)
+            except BaseException as e:  # re-raised in the consumer
+                failure.append(e)
             finally:
                 q.put(_END)
 
@@ -88,3 +136,69 @@ class DataLoader:
                 break
             yield item
         t.join()
+        if failure:
+            raise failure[0]
+
+    def _mp_iter(self):
+        """Worker-process pool with in-order reassembly.
+
+        Default start method is ``fork`` (cheap, no pickling — same choice as
+        the reference loader on Linux). Workers must only run host/numpy code;
+        if the dataset touches JAX, pass ``mp_start_method='spawn'`` — fork
+        from a process with an initialized JAX runtime can deadlock.
+        """
+        ctx = mp.get_context(self.mp_start_method)
+        task_q = ctx.Queue()
+        result_q = ctx.Queue()
+        nw = self.num_workers
+        seed = self.seed or 0
+        workers = [ctx.Process(target=_mp_worker,
+                               args=(self.dataset, self.collate_fn, task_q,
+                                     result_q, w, nw, seed + w), daemon=True)
+                   for w in range(nw)]
+        for w in workers:
+            w.start()
+        try:
+            batches = iter(self.batch_sampler)
+            inflight = 0
+            seq_sent = 0
+            for _ in range(nw * self.prefetch_factor):  # prime the pipeline
+                try:
+                    task_q.put((seq_sent, next(batches)))
+                    seq_sent += 1
+                    inflight += 1
+                except StopIteration:
+                    break
+            pending = {}
+            seq_want = 0
+            while inflight:
+                try:
+                    seq, batch, err = result_q.get(timeout=5.0)
+                except queue.Empty:
+                    dead = [w for w in workers if not w.is_alive()]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker exited unexpectedly (exitcode "
+                            f"{dead[0].exitcode}) — killed by OOM or a crash "
+                            f"in dataset code")
+                    continue
+                inflight -= 1
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                pending[seq] = batch
+                try:
+                    task_q.put((seq_sent, next(batches)))
+                    seq_sent += 1
+                    inflight += 1
+                except StopIteration:
+                    pass
+                while seq_want in pending:  # emit in submission order
+                    yield pending.pop(seq_want)
+                    seq_want += 1
+        finally:
+            for _ in workers:
+                task_q.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()

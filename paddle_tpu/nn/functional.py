@@ -147,13 +147,16 @@ def alpha_dropout(x, p=0.5, training=True, *, rng=None):
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     axes = tuple(range(x.ndim - len(tuple(normalized_shape) if not isinstance(normalized_shape, int) else (normalized_shape,)), x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + epsilon)
+    # statistics in fp32: bf16 mean/var loses ~3 decimal digits, which is
+    # visible in deep pre-LN stacks
+    x32 = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + epsilon)
     if weight is not None:
-        out = out * weight
+        out = out * weight.astype(out.dtype)
     if bias is not None:
-        out = out + bias
+        out = out + bias.astype(out.dtype)
     return out.astype(x.dtype)
 
 

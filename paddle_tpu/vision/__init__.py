@@ -1,2 +1,2 @@
-from paddle_tpu.vision import (datasets, models, models_extra, ops, transforms,
-                               vit)
+from paddle_tpu.vision import (convnext, datasets, models, models_extra, ops,
+                               swin, transforms, vit)

@@ -1,0 +1,114 @@
+"""ConvNeXt family (ref capability: PaddleClas ``ppcls/arch/backbone/
+model_zoo/convnext.py``).
+
+TPU notes: blocks run channels-LAST internally — the 7×7 depthwise conv and
+the two pointwise matmuls then keep channels on the 128-lane axis, and the
+LayerNorm over channels is a lane-wise reduce. Only the stem/downsample
+convs see NCHW at the API boundary (reference layout).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Conv2D, LayerNorm, Linear
+
+__all__ = ["ConvNeXt", "convnext_tiny", "convnext_small", "convnext_base",
+           "convnext_large"]
+
+
+def _LayerNormLast(dim, eps=1e-6, dtype=None):
+    """Trailing-axis LayerNorm (fp32 stats live in F.layer_norm now)."""
+    return LayerNorm(dim, epsilon=eps, dtype=dtype)
+
+
+class _Block(Module):
+    """dwconv7x7 → LN → pw 4x → GELU → pw → layer-scale → residual."""
+
+    def __init__(self, dim, layer_scale_init=1e-6, drop_path=0.0, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.dwconv = Conv2D(dim, dim, 7, padding=3, groups=dim, dtype=dtype)
+        self.norm = _LayerNormLast(dim, dtype=dtype)
+        self.pwconv1 = Linear(dim, 4 * dim, dtype=dtype)
+        self.pwconv2 = Linear(4 * dim, dim, dtype=dtype)
+        self.gamma = I.Constant(layer_scale_init)((dim,), dtype)
+        self.drop_path = drop_path
+
+    def __call__(self, x, rng=None):
+        # x: NCHW
+        y = self.dwconv(x)
+        y = jnp.transpose(y, (0, 2, 3, 1))       # NHWC: lanes = channels
+        y = self.norm(y)
+        y = self.pwconv2(jax.nn.gelu(self.pwconv1(y)))
+        y = (self.gamma.astype(y.dtype) * y)
+        y = jnp.transpose(y, (0, 3, 1, 2))
+        if self.drop_path > 0 and self.training and rng is not None:
+            keep = 1.0 - self.drop_path
+            mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, 1, 1))
+            y = y * mask.astype(y.dtype) / keep
+        return x + y
+
+
+class ConvNeXt(Module):
+    def __init__(self, in_chans=3, num_classes=1000, depths=(3, 3, 9, 3),
+                 dims=(96, 192, 384, 768), drop_path_rate=0.0,
+                 layer_scale_init=1e-6, class_num=None, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        num_classes = class_num if class_num is not None else num_classes
+        self.stem = Conv2D(in_chans, dims[0], 4, stride=4, dtype=dtype)
+        self.stem_norm = _LayerNormLast(dims[0], dtype=dtype)
+        self.down_norms = []
+        self.down_convs = []
+        for i in range(3):
+            self.down_norms.append(_LayerNormLast(dims[i], dtype=dtype))
+            self.down_convs.append(Conv2D(dims[i], dims[i + 1], 2, stride=2,
+                                          dtype=dtype))
+        rates = [float(r) for r in
+                 jnp.linspace(0, drop_path_rate, sum(depths))]
+        self.stages = []
+        k = 0
+        for i, depth in enumerate(depths):
+            self.stages.append([_Block(dims[i], layer_scale_init, rates[k + j],
+                                       dtype=dtype) for j in range(depth)])
+            k += depth
+        self.head_norm = _LayerNormLast(dims[-1], dtype=dtype)
+        self.head = Linear(dims[-1], num_classes, dtype=dtype)
+
+    def _nhwc_norm(self, x, norm):
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        x = norm(x)
+        return jnp.transpose(x, (0, 3, 1, 2))
+
+    def __call__(self, x, rng=None):
+        x = self._nhwc_norm(self.stem(x), self.stem_norm)
+        for i, stage in enumerate(self.stages):
+            if i > 0:
+                x = self.down_convs[i - 1](
+                    self._nhwc_norm(x, self.down_norms[i - 1]))
+            for j, blk in enumerate(stage):
+                sub = (None if rng is None
+                       else jax.random.fold_in(rng, i * 100 + j))
+                x = blk(x, rng=sub)
+        x = x.mean(axis=(2, 3))                   # global average pool
+        return self.head(self.head_norm(x))
+
+
+def convnext_tiny(**kw):
+    return ConvNeXt(depths=(3, 3, 9, 3), dims=(96, 192, 384, 768), **kw)
+
+
+def convnext_small(**kw):
+    return ConvNeXt(depths=(3, 3, 27, 3), dims=(96, 192, 384, 768), **kw)
+
+
+def convnext_base(**kw):
+    return ConvNeXt(depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024), **kw)
+
+
+def convnext_large(**kw):
+    return ConvNeXt(depths=(3, 3, 27, 3), dims=(192, 384, 768, 1536), **kw)

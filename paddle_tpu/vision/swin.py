@@ -1,0 +1,201 @@
+"""Swin Transformer (ref capability: PaddleClas ``ppcls/arch/backbone/
+model_zoo/swin_transformer.py``).
+
+TPU notes: window partition is pure reshape/transpose (no gather); windowed
+attention batches all windows into one [B·nW, w², C] attention call so the
+MXU sees one large batched matmul; the shifted-window mask is precomputed
+per stage resolution (static shapes) and added to logits.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Conv2D, Linear
+from paddle_tpu.nn.layers import LayerNorm
+
+__all__ = ["SwinTransformer", "swin_tiny_patch4_window7_224",
+           "swin_small_patch4_window7_224", "swin_base_patch4_window7_224"]
+
+
+def window_partition(x, w):
+    """[B, H, W, C] → [B*nW, w*w, C] (reshape/transpose only)."""
+    b, h, wd, c = x.shape
+    x = x.reshape(b, h // w, w, wd // w, w, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(-1, w * w, c)
+
+
+def window_reverse(x, w, h, wd):
+    b = x.shape[0] // ((h // w) * (wd // w))
+    x = x.reshape(b, h // w, wd // w, w, w, -1)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, h, wd, -1)
+
+
+def _relative_index(w):
+    coords = np.stack(np.meshgrid(np.arange(w), np.arange(w), indexing="ij"))
+    flat = coords.reshape(2, -1)
+    rel = flat[:, :, None] - flat[:, None, :]          # [2, w², w²]
+    rel = rel.transpose(1, 2, 0) + (w - 1)
+    return (rel[..., 0] * (2 * w - 1) + rel[..., 1]).astype(np.int32)
+
+
+class WindowAttention(Module):
+    def __init__(self, dim, num_heads, window, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.qkv = Linear(dim, 3 * dim, dtype=dtype)
+        self.proj = Linear(dim, dim, dtype=dtype)
+        self.rel_bias = I.TruncatedNormal(std=0.02)(
+            ((2 * window - 1) ** 2, num_heads), dtype)
+        self.register_buffer("rel_index", jnp.asarray(_relative_index(window)))
+        self.num_heads = num_heads
+        self.window = window
+
+    def __call__(self, x, mask=None):
+        bnw, n, c = x.shape
+        nh = self.num_heads
+        qkv = self.qkv(x).reshape(bnw, n, 3, nh, c // nh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [bnw, n, nh, d]
+        bias = self.rel_bias[self.rel_index.reshape(-1)]
+        bias = jnp.transpose(bias.reshape(n, n, nh), (2, 0, 1))  # [nh, n, n]
+        attn_mask = bias[None].astype(jnp.float32)           # [1, nh, n, n]
+        if mask is not None:                                  # [nW, n, n]
+            nw = mask.shape[0]
+            m = jnp.tile(mask, (bnw // nw, 1, 1))[:, None]   # [bnw, 1, n, n]
+            attn_mask = attn_mask + m.astype(jnp.float32)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+        return self.proj(out.reshape(bnw, n, c))
+
+
+class SwinBlock(Module):
+    def __init__(self, dim, num_heads, window, shift, resolution,
+                 mlp_ratio=4.0, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.norm1 = LayerNorm(dim, dtype=dtype)
+        self.attn = WindowAttention(dim, num_heads, window, dtype=dtype)
+        self.norm2 = LayerNorm(dim, dtype=dtype)
+        self.fc1 = Linear(dim, int(dim * mlp_ratio), dtype=dtype)
+        self.fc2 = Linear(int(dim * mlp_ratio), dim, dtype=dtype)
+        self.window, self.shift = window, shift
+        self.resolution = resolution
+        if shift > 0:
+            self.register_buffer("attn_mask",
+                                 jnp.asarray(self._shift_mask(resolution)))
+        else:
+            self.attn_mask = None
+
+    def _shift_mask(self, res):
+        """Additive mask isolating the wrapped regions after cyclic shift
+        (precomputed on host: static per resolution)."""
+        h = w = res
+        ws, sh = self.window, self.shift
+        img = np.zeros((1, h, w, 1), np.float32)
+        cnt = 0
+        for hs in (slice(0, -ws), slice(-ws, -sh), slice(-sh, None)):
+            for wsl in (slice(0, -ws), slice(-ws, -sh), slice(-sh, None)):
+                img[:, hs, wsl, :] = cnt
+                cnt += 1
+        win = np.asarray(window_partition(jnp.asarray(img), ws))[:, :, 0]
+        diff = win[:, None, :] - win[:, :, None]
+        return np.where(diff != 0, -1e9, 0.0).astype(np.float32)
+
+    def __call__(self, x):
+        # x: [B, H*W, C] at this stage's resolution
+        h = w = self.resolution
+        b, _, c = x.shape
+        shortcut = x
+        y = self.norm1(x).reshape(b, h, w, c)
+        if self.shift > 0:
+            y = jnp.roll(y, (-self.shift, -self.shift), axis=(1, 2))
+        wins = window_partition(y, self.window)
+        wins = self.attn(wins, mask=self.attn_mask)
+        y = window_reverse(wins, self.window, h, w)
+        if self.shift > 0:
+            y = jnp.roll(y, (self.shift, self.shift), axis=(1, 2))
+        x = shortcut + y.reshape(b, h * w, c)
+        return x + self.fc2(jax.nn.gelu(self.fc1(self.norm2(x))))
+
+
+class PatchMerging(Module):
+    def __init__(self, dim, resolution, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        self.norm = LayerNorm(4 * dim, dtype=dtype)
+        self.reduction = Linear(4 * dim, 2 * dim, bias_attr=False, dtype=dtype)
+        self.resolution = resolution
+
+    def __call__(self, x):
+        h = w = self.resolution
+        b, _, c = x.shape
+        x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, (h // 2) * (w // 2),
+                                                         4 * c)
+        return self.reduction(self.norm(x))
+
+
+class SwinTransformer(Module):
+    def __init__(self, img_size=224, patch_size=4, in_chans=3,
+                 num_classes=1000, embed_dim=96, depths=(2, 2, 6, 2),
+                 num_heads=(3, 6, 12, 24), window_size=7, mlp_ratio=4.0,
+                 class_num=None, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        num_classes = class_num if class_num is not None else num_classes
+        self.patch_embed = Conv2D(in_chans, embed_dim, patch_size,
+                                  stride=patch_size, dtype=dtype)
+        self.patch_norm = LayerNorm(embed_dim, dtype=dtype)
+        res = img_size // patch_size
+        self.stages = []
+        self.mergers = []
+        dim = embed_dim
+        for i, depth in enumerate(depths):
+            # reference behavior: when the stage fits in one window, use
+            # window=resolution and NO shift (shifting a single window would
+            # mask genuinely-adjacent tokens)
+            win = min(window_size, res)
+            shift = 0 if res <= window_size else window_size // 2
+            blocks = [SwinBlock(dim, num_heads[i], win,
+                                0 if j % 2 == 0 else shift,
+                                res, mlp_ratio, dtype=dtype)
+                      for j in range(depth)]
+            self.stages.append(blocks)
+            if i < len(depths) - 1:
+                self.mergers.append(PatchMerging(dim, res, dtype=dtype))
+                dim *= 2
+                res //= 2
+        self.norm = LayerNorm(dim, dtype=dtype)
+        self.head = Linear(dim, num_classes, dtype=dtype)
+
+    def __call__(self, x):
+        x = self.patch_embed(x)                    # [B, C, H/p, W/p]
+        b, c = x.shape[0], x.shape[1]
+        x = x.reshape(b, c, -1).transpose(0, 2, 1)
+        x = self.patch_norm(x)
+        for i, blocks in enumerate(self.stages):
+            for blk in blocks:
+                x = blk(x)
+            if i < len(self.stages) - 1:
+                x = self.mergers[i](x)
+        x = self.norm(x).mean(axis=1)
+        return self.head(x)
+
+
+def swin_tiny_patch4_window7_224(**kw):
+    return SwinTransformer(depths=(2, 2, 6, 2), embed_dim=96, **kw)
+
+
+def swin_small_patch4_window7_224(**kw):
+    return SwinTransformer(depths=(2, 2, 18, 2), embed_dim=96, **kw)
+
+
+def swin_base_patch4_window7_224(**kw):
+    return SwinTransformer(depths=(2, 2, 18, 2), embed_dim=128,
+                           num_heads=(4, 8, 16, 32), **kw)

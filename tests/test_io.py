@@ -90,3 +90,92 @@ def test_native_token_bin(tmp_path):
     joined = tokens.astype(np.int32)
     pos = np.where(joined == x0[0])[0]
     assert any((joined[p:p + 64] == x0).all() for p in pos if p + 64 <= len(joined))
+
+
+# -- multiprocess workers ----------------------------------------------------
+
+class _SquareDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i * i], np.int64)
+
+
+class _FailingDataset(_SquareDataset):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return super().__getitem__(i)
+
+
+def test_mp_workers_match_serial():
+    from paddle_tpu.io import DataLoader
+    ds = _SquareDataset(23)
+    serial = [b for b in DataLoader(ds, batch_size=4, num_workers=0)]
+    parallel = [b for b in DataLoader(ds, batch_size=4, num_workers=3)]
+    assert len(serial) == len(parallel)
+    for a, b in zip(serial, parallel):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mp_workers_shuffle_deterministic():
+    from paddle_tpu.io import DataLoader
+    ds = _SquareDataset(17)
+    a = [b for b in DataLoader(ds, batch_size=4, shuffle=True, seed=7,
+                               num_workers=2)]
+    b = [b for b in DataLoader(ds, batch_size=4, shuffle=True, seed=7,
+                               num_workers=0)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_mp_worker_error_propagates():
+    from paddle_tpu.io import DataLoader
+    import pytest as _pytest
+    ds = _FailingDataset(8)
+    with _pytest.raises(RuntimeError, match="boom at 5"):
+        list(DataLoader(ds, batch_size=2, num_workers=2))
+
+
+def test_get_worker_info():
+    from paddle_tpu.io import DataLoader, get_worker_info
+    assert get_worker_info() is None
+
+    class _InfoDataset(_SquareDataset):
+        def __getitem__(self, i):
+            info = get_worker_info()
+            assert info is not None and 0 <= info.id < info.num_workers
+            return np.asarray([info.num_workers], np.int64)
+
+    out = list(DataLoader(_InfoDataset(6), batch_size=2, num_workers=2))
+    assert all(int(b[0, 0]) == 2 for b in out)
+
+
+def test_threaded_iterable_error_propagates():
+    from paddle_tpu.io import DataLoader, IterableDataset
+    import pytest as _pytest
+
+    class _Boom(IterableDataset):
+        def __iter__(self):
+            yield np.zeros(1)
+            raise ValueError("iterable boom")
+
+    with _pytest.raises(ValueError, match="iterable boom"):
+        list(DataLoader(_Boom(), batch_size=1, num_workers=1))
+
+
+def test_worker_seed_from_loader_seed():
+    from paddle_tpu.io import DataLoader, get_worker_info
+
+    class _SeedDataset(_SquareDataset):
+        def __getitem__(self, i):
+            return np.asarray([get_worker_info().seed], np.int64)
+
+    out = list(DataLoader(_SeedDataset(4), batch_size=1, num_workers=2,
+                          seed=1234))
+    seeds = {int(b[0, 0]) for b in out}
+    assert seeds <= {1234, 1235} and len(seeds) >= 1

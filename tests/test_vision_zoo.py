@@ -117,3 +117,57 @@ def test_vit_configs_param_counts():
     n = net.num_parameters()
     # ViT-Ti ~5.7M including head; sanity band
     assert 4e6 < n < 8e6
+
+
+def test_convnext_forward_grad():
+    import paddle_tpu as pt
+    from paddle_tpu.vision import convnext
+    import jax, jax.numpy as jnp, numpy as np
+    from paddle_tpu.core.module import value_and_grad
+    import paddle_tpu.nn.functional as F
+
+    pt.seed(0)
+    net = convnext.ConvNeXt(depths=(1, 1, 2, 1), dims=(16, 32, 64, 128),
+                            num_classes=7, dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 3, 64, 64)),
+                    jnp.float32)
+    out = net(x)
+    assert out.shape == (2, 7) and np.isfinite(np.asarray(out)).all()
+    loss, grads = value_and_grad(
+        lambda m, x, y: F.cross_entropy(m(x), y))(net, x, jnp.array([0, 3]))
+    assert np.isfinite(float(loss))
+    g = np.asarray(grads.stages[0][0].gamma)
+    assert np.abs(g).sum() > 0
+
+
+def test_swin_forward_shapes_and_shift_mask():
+    import paddle_tpu as pt
+    from paddle_tpu.vision import swin
+    import jax.numpy as jnp, numpy as np
+
+    pt.seed(0)
+    net = swin.SwinTransformer(img_size=32, patch_size=4, window_size=4,
+                               embed_dim=24, depths=(2, 2), num_heads=(2, 4),
+                               num_classes=5, dtype=jnp.float32)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 3, 32, 32)),
+                    jnp.float32)
+    out = net(x)
+    assert out.shape == (2, 5) and np.isfinite(np.asarray(out)).all()
+    # stage 0 (res 8 > window 4): odd block is shifted with a blocking mask
+    blk = net.stages[0][1]
+    assert blk.shift > 0 and blk.attn_mask is not None
+    m = np.asarray(blk.attn_mask)
+    assert (m < -1e8).any() and (m == 0).any()
+    # stage 1 (res 4 == window): whole map is one window — shift disabled
+    assert all(b.shift == 0 for b in net.stages[1])
+    assert all(b.window == 4 for b in net.stages[1])
+
+
+def test_swin_window_roundtrip():
+    from paddle_tpu.vision.swin import window_partition, window_reverse
+    import jax.numpy as jnp, numpy as np
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 8, 8, 5)))
+    w = window_partition(x, 4)
+    assert w.shape == (2 * 4, 16, 5)
+    back = window_reverse(w, 4, 8, 8)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
