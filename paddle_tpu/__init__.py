@@ -11,8 +11,10 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from paddle_tpu import amp, callbacks, core, io, nn, ops, optimizer, utils
-from paddle_tpu import (audio, autograd, distribution, fft, geometric, linalg,
-                        quantization, signal, sparse, text)
+from paddle_tpu import (audio, autograd, distribution, fft, geometric, hub,
+                        linalg, onnx, quantization, signal, sparse, static,
+                        text)
+from paddle_tpu.core import device
 from paddle_tpu.summary_utils import flops, summary
 from paddle_tpu.core.device import (
     device_count,

@@ -1,0 +1,27 @@
+"""``paddle.onnx`` surface (ref: ``python/paddle/onnx/export.py``).
+
+ONNX export is a documented out-of-scope gap for the TPU training framework
+(SURVEY.md §2.10): there is no onnx runtime in this environment and the
+TPU-native interchange format is StableHLO. ``export`` therefore produces a
+``jax.export`` StableHLO artifact (portable across XLA runtimes) and raises
+with instructions if a literal ``.onnx`` file is required.
+"""
+from __future__ import annotations
+
+from paddle_tpu.jit import save as _jit_save
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **kw):
+    """Export ``layer`` to a portable serialized-StableHLO artifact (the
+    TPU-native analogue of the reference's ONNX graph). ``opset_version`` is
+    accepted for signature parity and ignored."""
+    if str(path).endswith(".onnx"):
+        # a literal .onnx graph cannot be produced here — never silently
+        # hand back a differently-named artifact
+        raise NotImplementedError(
+            "paddle_tpu does not emit ONNX graphs; it exports StableHLO "
+            "(same deploy role). Pass a path without .onnx or use "
+            "paddle_tpu.jit.save.")
+    return _jit_save(layer, str(path), input_spec=input_spec)

@@ -1,0 +1,55 @@
+"""``paddle.static`` compatibility surface (ref: ``python/paddle/static/``).
+
+The reference's static graph (Program/Executor/scope) is subsumed by XLA:
+``jax.jit`` IS the static graph (SURVEY.md §2.10). This module keeps the
+entry points users actually touch — InputSpec, save/load_inference_model —
+and routes them to the jit/export machinery so static-mode scripts port
+without rewrites. Program/Executor-level APIs raise with a pointer to the
+TPU-native equivalent rather than silently no-op.
+"""
+from __future__ import annotations
+
+from paddle_tpu.jit import InputSpec, load as _jit_load, save as _jit_save
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "Program", "Executor", "default_main_program"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars=None, executor=None,
+                         program=None, model=None, **kw):
+    """Ref ``paddle.static.save_inference_model``. Here: export the model (or
+    jittable fn) with the feed specs to a StableHLO artifact."""
+    target = model if model is not None else fetch_vars
+    if target is None or isinstance(target, (list, tuple)):
+        raise ValueError(
+            "save_inference_model: pass the Module/function as `model=` (the "
+            "Program/Executor form has no equivalent — jit.save exports the "
+            "traced computation directly)")
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    return _jit_save(target, path_prefix, input_spec=list(specs))
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    """Ref ``paddle.static.load_inference_model`` → a callable program."""
+    return _jit_load(path_prefix)
+
+
+class _Removed:
+    _msg = ("paddle.static Program/Executor do not exist in paddle_tpu: "
+            "jax.jit is the graph mode. Use paddle_tpu.jit / jit.save / "
+            "jit.load (SURVEY.md §2.10).")
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(self._msg)
+
+
+class Program(_Removed):
+    pass
+
+
+class Executor(_Removed):
+    pass
+
+
+def default_main_program():
+    raise NotImplementedError(_Removed._msg)

@@ -1,1 +1,4 @@
 from paddle_tpu.train.step import make_train_step, TrainState
+from paddle_tpu.train.elastic import ElasticRunner, run_elastic
+from paddle_tpu.train.trainer import Trainer, TrainerArgs
+from paddle_tpu.train.checkpoint import CheckpointManager

@@ -1,0 +1,65 @@
+"""Elastic / fault-tolerant training (ref: ``paddle.distributed.fleet.elastic``
+and the Fleet controller's restart loop).
+
+The reference restarts dead pods and re-joins collectives; on TPU pods the
+scheduler replaces the slice, so elasticity here means: checkpoint
+continuously, detect failure (exception, stall, NaN storm), restore the
+LATEST checkpoint into a FRESH trainer and continue — bounded restarts with
+backoff. Pure host logic over the jitted step (no in-graph state).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from paddle_tpu.train.checkpoint import CheckpointManager
+from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip
+
+__all__ = ["ElasticRunner", "run_elastic"]
+
+
+class ElasticRunner:
+    def __init__(self, make_trainer: Callable[[], "Trainer"],
+                 max_restarts: int = 3, backoff_s: float = 5.0,
+                 stall_timeout_s: Optional[float] = None):
+        self.make_trainer = make_trainer
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.stall_timeout_s = stall_timeout_s
+        self.restarts = 0
+        self.failures: list[str] = []
+
+    def run(self, data_fn: Callable[[], object], eval_fn=None):
+        """``data_fn`` must return a FRESH data iterator per (re)start —
+        after a failure the stream is rebuilt, then fast-forwarded by the
+        restored step counter via the trainer's resume."""
+        while True:
+            trainer = self.make_trainer().resume()
+            dog = None
+            if self.stall_timeout_s:
+                mgr = CheckpointManager(trainer.args.ckpt_dir)
+                dog = StallWatchdog(
+                    self.stall_timeout_s,
+                    on_trip=lambda: mgr.save(int(trainer.state.step) + 1,
+                                             trainer.state)).start()
+                trainer.watchdog = dog  # poked EVERY step inside fit
+            try:
+                out = trainer.fit(data_fn(), eval_fn=eval_fn)
+                return out
+            except (WatchdogTrip, FloatingPointError, RuntimeError) as e:
+                self.failures.append(f"{type(e).__name__}: {e}")
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise RuntimeError(
+                        f"elastic: gave up after {self.max_restarts} restarts; "
+                        f"failures={self.failures}") from e
+                time.sleep(self.backoff_s)
+            finally:
+                if dog is not None:
+                    dog.stop()
+
+
+def run_elastic(make_trainer, data_fn, max_restarts=3, backoff_s=5.0,
+                stall_timeout_s=None, eval_fn=None):
+    return ElasticRunner(make_trainer, max_restarts, backoff_s,
+                         stall_timeout_s).run(data_fn, eval_fn=eval_fn)

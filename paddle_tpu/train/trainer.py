@@ -31,6 +31,7 @@ class TrainerArgs:
     peak_flops: float = 197e12
     nan_guard: bool = True                # skip update & count on non-finite loss
     max_bad_steps: int = 25               # trip watchdog after this many
+    resume_reskip: bool = True            # fast-forward a fresh stream on resume
 
 
 class Trainer:
@@ -45,6 +46,7 @@ class Trainer:
         self._step_fn = self._build_step()
         self.history: list[dict] = []
         self._bad_steps = 0
+        self.watchdog = None           # StallWatchdog, poked every step
 
     def _build_step(self):
         loss_fn = self.loss_fn
@@ -102,9 +104,18 @@ class Trainer:
         tokens_since = 0
         start_step = int(self.state.step)
         it = iter(data_iter)
+        if start_step and args.resume_reskip:
+            # align a FRESH stream with the restored step counter — without
+            # this a resumed run re-trains the first batches and never sees
+            # the tail. Pass resume_reskip=False if the iterator is already
+            # positioned.
+            for _ in range(start_step * accum):
+                next(it)
         for _ in range(start_step, args.max_steps):
             micro = [self._to_batch(next(it)) for _ in range(accum)]
             self.state, loss = self._step_fn(self.state, *micro)
+            if self.watchdog is not None:
+                self.watchdog.poke()   # raises WatchdogTrip if stalled
             step_no = int(self.state.step)
             loss_val = float(loss)
 

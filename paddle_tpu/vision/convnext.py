@@ -20,11 +20,6 @@ __all__ = ["ConvNeXt", "convnext_tiny", "convnext_small", "convnext_base",
            "convnext_large"]
 
 
-def _LayerNormLast(dim, eps=1e-6, dtype=None):
-    """Trailing-axis LayerNorm (fp32 stats live in F.layer_norm now)."""
-    return LayerNorm(dim, epsilon=eps, dtype=dtype)
-
-
 class _Block(Module):
     """dwconv7x7 → LN → pw 4x → GELU → pw → layer-scale → residual."""
 
@@ -32,7 +27,7 @@ class _Block(Module):
         super().__init__()
         dtype = dtype or get_default_dtype()
         self.dwconv = Conv2D(dim, dim, 7, padding=3, groups=dim, dtype=dtype)
-        self.norm = _LayerNormLast(dim, dtype=dtype)
+        self.norm = LayerNorm(dim, epsilon=1e-6, dtype=dtype)
         self.pwconv1 = Linear(dim, 4 * dim, dtype=dtype)
         self.pwconv2 = Linear(4 * dim, dim, dtype=dtype)
         self.gamma = I.Constant(layer_scale_init)((dim,), dtype)
@@ -61,11 +56,11 @@ class ConvNeXt(Module):
         dtype = dtype or get_default_dtype()
         num_classes = class_num if class_num is not None else num_classes
         self.stem = Conv2D(in_chans, dims[0], 4, stride=4, dtype=dtype)
-        self.stem_norm = _LayerNormLast(dims[0], dtype=dtype)
+        self.stem_norm = LayerNorm(dims[0], epsilon=1e-6, dtype=dtype)
         self.down_norms = []
         self.down_convs = []
         for i in range(3):
-            self.down_norms.append(_LayerNormLast(dims[i], dtype=dtype))
+            self.down_norms.append(LayerNorm(dims[i], epsilon=1e-6, dtype=dtype))
             self.down_convs.append(Conv2D(dims[i], dims[i + 1], 2, stride=2,
                                           dtype=dtype))
         rates = [float(r) for r in
@@ -76,7 +71,7 @@ class ConvNeXt(Module):
             self.stages.append([_Block(dims[i], layer_scale_init, rates[k + j],
                                        dtype=dtype) for j in range(depth)])
             k += depth
-        self.head_norm = _LayerNormLast(dims[-1], dtype=dtype)
+        self.head_norm = LayerNorm(dims[-1], epsilon=1e-6, dtype=dtype)
         self.head = Linear(dims[-1], num_classes, dtype=dtype)
 
     def _nhwc_norm(self, x, norm):

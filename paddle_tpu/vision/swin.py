@@ -162,6 +162,11 @@ class SwinTransformer(Module):
             # mask genuinely-adjacent tokens)
             win = min(window_size, res)
             shift = 0 if res <= window_size else window_size // 2
+            if res % win != 0:
+                raise ValueError(
+                    f"stage {i}: resolution {res} is not a multiple of "
+                    f"window {win}; pick img_size/patch_size so every stage "
+                    f"resolution divides the window (e.g. 224/4 with window 7)")
             blocks = [SwinBlock(dim, num_heads[i], win,
                                 0 if j % 2 == 0 else shift,
                                 res, mlp_ratio, dtype=dtype)

@@ -79,3 +79,38 @@ def test_llama_scan_layers_matches_loop():
     assert np.isfinite(float(loss))
     stacked_grad = grads.model.layers_stacked.self_attn.qkv_proj
     assert stacked_grad.shape[0] == cfg_scan.num_hidden_layers
+
+
+def test_static_shim_and_onnx_export(tmp_path):
+    import numpy as np
+    import jax.numpy as jnp
+    import pytest as _pytest
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 3))
+    p = pt.static.save_inference_model(
+        str(tmp_path / "im"), [pt.static.InputSpec((None, 4))], model=net)
+    f = pt.static.load_inference_model(p)
+    assert f(jnp.ones((2, 4))).shape == (2, 3)
+    with _pytest.raises(NotImplementedError):
+        pt.static.Program()
+    # onnx.export routes to the StableHLO artifact; .onnx path raises clearly
+    p2 = pt.onnx.export(net, str(tmp_path / "m"),
+                        input_spec=[pt.static.InputSpec((1, 4))])
+    assert p2.endswith(".stablehlo")
+    with _pytest.raises(NotImplementedError):
+        pt.onnx.export(net, str(tmp_path / "m.onnx"),
+                       input_spec=[pt.static.InputSpec((1, 4))])
+
+
+def test_hub_local(tmp_path):
+    import paddle_tpu as pt
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    '''a tiny test model'''\n"
+        "    return {'scale': scale}\n")
+    assert "tiny_model" in pt.hub.list(str(tmp_path))
+    assert "tiny" in pt.hub.help(str(tmp_path), "tiny_model")
+    assert pt.hub.load(str(tmp_path), "tiny_model", scale=3) == {"scale": 3}

@@ -218,3 +218,85 @@ def test_evaluate_restores_train_mode():
     assert all(s.training for s in m._state.model.sublayers(include_self=True))
     m.predict(data)
     assert all(s.training for s in m._state.model.sublayers(include_self=True))
+
+
+def test_elastic_restarts_and_resumes(tmp_path):
+    """Inject a crash mid-training; elastic must restore the latest
+    checkpoint and finish all steps."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.train import Trainer, TrainerArgs, run_elastic
+
+    pt.seed(0)
+    crashes = {"left": 1}
+
+    def loss_fn(m, x, y):
+        return nn.functional.mse_loss(m(x), y)
+
+    def make_trainer():
+        pt.seed(0)  # deterministic init; resume() restores real progress
+        net = nn.Sequential(nn.Linear(3, 8), nn.Tanh(), nn.Linear(8, 1))
+        return Trainer(net, opt.SGD(learning_rate=0.05), loss_fn,
+                       TrainerArgs(max_steps=12, log_every=2, ckpt_every=2,
+                                   ckpt_dir=str(tmp_path), nan_guard=False))
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((256, 3)).astype(np.float32)
+    Y = (X @ np.array([[1.0], [-2.0], [0.5]], np.float32)).astype(np.float32)
+
+    def data_fn():
+        def gen():
+            i = 0
+            while True:
+                if crashes["left"] and i == 6:
+                    crashes["left"] -= 1
+                    raise RuntimeError("injected failure")
+                sl = slice((i * 16) % 240, (i * 16) % 240 + 16)
+                yield X[sl], Y[sl]
+                i += 1
+        return gen()
+
+    state = run_elastic(make_trainer, data_fn, max_restarts=2, backoff_s=0.0)
+    assert int(state.step) >= 12
+    assert crashes["left"] == 0  # the injected crash actually fired
+
+    # the crashed+resumed trajectory must equal an uncrashed one: resume
+    # fast-forwards the fresh stream, so the trained batch sequence matches
+    import shutil
+    shutil.rmtree(tmp_path)
+    crashes["left"] = 0
+    ref_state = run_elastic(make_trainer, data_fn, max_restarts=0,
+                            backoff_s=0.0)
+    w_crashed = np.asarray(state.model[0].weight, np.float32)
+    w_clean = np.asarray(ref_state.model[0].weight, np.float32)
+    np.testing.assert_allclose(w_crashed, w_clean, rtol=1e-5, atol=1e-6)
+
+
+def test_elastic_gives_up(tmp_path):
+    import numpy as np
+    import pytest as _pytest
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.train import Trainer, TrainerArgs, run_elastic
+
+    pt.seed(0)
+
+    def make_trainer():
+        net = nn.Sequential(nn.Linear(2, 1))
+        return Trainer(net, opt.SGD(learning_rate=0.1),
+                       lambda m, x, y: nn.functional.mse_loss(m(x), y),
+                       TrainerArgs(max_steps=5, ckpt_every=0,
+                                   ckpt_dir=str(tmp_path)))
+
+    def data_fn():
+        def gen():
+            raise RuntimeError("always broken")
+            yield  # pragma: no cover
+        return gen()
+
+    with _pytest.raises(RuntimeError, match="gave up"):
+        run_elastic(make_trainer, data_fn, max_restarts=1, backoff_s=0.0)
