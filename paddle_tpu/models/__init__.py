@@ -15,3 +15,5 @@ from paddle_tpu.models.resnet import (
     resnet101,
     resnet152,
 )
+from paddle_tpu.models.conformer import (ConformerConfig, ConformerEncoder,
+                                         ConformerForCTC)

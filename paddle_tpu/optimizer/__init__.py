@@ -474,6 +474,107 @@ class Lion(Optimizer):
         return get(0), {**state, "moment": get(1)}
 
 
+class Adafactor(Optimizer):
+    """Factored-second-moment optimizer (Shazeer & Stern). The canonical
+    low-memory choice for large TPU training runs: matrices keep row+col
+    EMAs instead of a full second moment — O(r+c) slot memory vs Adam's
+    O(r·c). (Reference capability: paddle.incubate optimizer family; this
+    member is TPU-native rather than a port.)
+
+    ``learning_rate=None`` enables the paper's relative-step schedule
+    min(1e-2, 1/sqrt(t)) scaled by RMS(param).
+    """
+
+    def __init__(self, learning_rate=None, beta1=None, decay_rate=0.8,
+                 epsilon1=1e-30, epsilon2=1e-3, clip_threshold=1.0,
+                 scale_parameter=True, **kw):
+        super().__init__(learning_rate if learning_rate is not None else 1.0, **kw)
+        self.relative_step = learning_rate is None
+        self.beta1 = beta1
+        self.decay_rate = decay_rate
+        self.eps1, self.eps2 = epsilon1, epsilon2
+        self.clip_threshold = clip_threshold
+        self.scale_parameter = scale_parameter
+
+    @staticmethod
+    def _factored(p):
+        return p.ndim >= 2 and p.shape[-1] > 1 and p.shape[-2] > 1
+
+    def _init_slots(self, params):
+        def vr(p):
+            if self._factored(p):
+                return jnp.zeros(p.shape[:-1], jnp.float32)
+            return jnp.zeros(p.shape, jnp.float32)  # full v for vectors
+
+        def vc(p):
+            if self._factored(p):
+                return jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)
+            return jnp.zeros((1,), jnp.float32)     # unused placeholder
+
+        slots = {"vr": _map_params(vr, params), "vc": _map_params(vc, params)}
+        if self.beta1 is not None:
+            slots["m"] = _map_params(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return slots
+
+    def _update(self, params, grads, state, lr):
+        t = state["step"].astype(jnp.float32) + 1.0
+        rho = 1.0 - t ** (-self.decay_rate)
+        eps1, eps2, d = self.eps1, self.eps2, self.clip_threshold
+        ms = state.get("m")
+        mask = self._decay_mask(params)
+
+        def upd(p, g, vr, vc, m=None, do_decay=True):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            g2 = g32 * g32 + eps1
+            if self._factored(p):
+                vr_new = rho * vr + (1 - rho) * g2.mean(axis=-1)
+                vc_new = rho * vc + (1 - rho) * g2.mean(axis=-2)
+                # v̂_ij = vr_i vc_j / mean_i(vr) — rank-1 reconstruction
+                denom = jnp.maximum(vr_new.mean(axis=-1, keepdims=True), eps1)
+                u = g32 * jax.lax.rsqrt(
+                    (vr_new / denom)[..., None] * vc_new[..., None, :] + eps1)
+            else:
+                vr_new = rho * vr + (1 - rho) * g2
+                vc_new = vc
+                u = g32 * jax.lax.rsqrt(vr_new + eps1)
+            rms_u = jnp.sqrt(jnp.mean(u * u) + eps1)
+            u = u / jnp.maximum(1.0, rms_u / d)
+            if m is not None:
+                b1 = self.beta1
+                u = b1 * m + (1 - b1) * u
+                m_new = u
+            else:
+                m_new = None
+            if self.relative_step:
+                step_lr = jnp.minimum(1e-2, 1.0 / jnp.sqrt(t))
+            else:
+                step_lr = lr
+            if self.scale_parameter:
+                step_lr = step_lr * jnp.maximum(eps2, jnp.sqrt(jnp.mean(p32 * p32)))
+            if self.weight_decay and do_decay:
+                p32 = p32 * (1.0 - step_lr * self.weight_decay)
+            out = (p32 - step_lr * u).astype(p.dtype)
+            return (out, vr_new, vc_new) if m_new is None else (out, vr_new, vc_new, m_new)
+
+        if ms is not None and mask is not None:
+            pairs = _map_params(lambda p, g, vr, vc, m, dm: upd(p, g, vr, vc, m, dm),
+                                params, grads, state["vr"], state["vc"], ms, mask)
+        elif ms is not None:
+            pairs = _map_params(upd, params, grads, state["vr"], state["vc"], ms)
+        elif mask is not None:
+            pairs = _map_params(lambda p, g, vr, vc, dm: upd(p, g, vr, vc, None, dm),
+                                params, grads, state["vr"], state["vc"], mask)
+        else:
+            pairs = _map_params(upd, params, grads, state["vr"], state["vc"])
+        get = lambda i: _pluck(pairs, i)
+        new_state = {**state, "vr": get(1), "vc": get(2)}
+        if ms is not None:
+            new_state["m"] = get(3)
+        return get(0), new_state
+
+
 # -- incubate extras (ref python/paddle/incubate/optimizer/) -----------------
 
 class LookAhead(Optimizer):

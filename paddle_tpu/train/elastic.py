@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
-from paddle_tpu.train.checkpoint import CheckpointManager
+
 from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip
 
 __all__ = ["ElasticRunner", "run_elastic"]
@@ -35,13 +35,16 @@ class ElasticRunner:
         restored step counter via the trainer's resume."""
         while True:
             trainer = self.make_trainer().resume()
+            # streams are rebuilt fresh by data_fn each (re)start, so the
+            # trainer must fast-forward them to the restored step
+            trainer.args.resume_reskip = True
             dog = None
             if self.stall_timeout_s:
-                mgr = CheckpointManager(trainer.args.ckpt_dir)
-                dog = StallWatchdog(
-                    self.stall_timeout_s,
-                    on_trip=lambda: mgr.save(int(trainer.state.step) + 1,
-                                             trainer.state)).start()
+                # NO emergency save on trip: during a hung step the live
+                # TrainState holds unfulfilled/donated buffers and reading
+                # it from the watchdog thread blocks or throws. Recovery
+                # comes from the trainer's periodic ckpt_every saves.
+                dog = StallWatchdog(self.stall_timeout_s).start()
                 trainer.watchdog = dog  # poked EVERY step inside fit
             try:
                 out = trainer.fit(data_fn(), eval_fn=eval_fn)

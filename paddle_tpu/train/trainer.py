@@ -31,7 +31,9 @@ class TrainerArgs:
     peak_flops: float = 197e12
     nan_guard: bool = True                # skip update & count on non-finite loss
     max_bad_steps: int = 25               # trip watchdog after this many
-    resume_reskip: bool = True            # fast-forward a fresh stream on resume
+    resume_reskip: bool = False           # fast-forward a FRESH stream on resume
+    # (leave False when the caller positions the iterator; ElasticRunner
+    # always rebuilds streams from scratch and turns this on)
 
 
 class Trainer:
@@ -103,6 +105,8 @@ class Trainer:
         t_last = time.perf_counter()
         tokens_since = 0
         start_step = int(self.state.step)
+        if start_step >= args.max_steps:
+            return self.state       # already done — consume nothing
         it = iter(data_iter)
         if start_step and args.resume_reskip:
             # align a FRESH stream with the restored step counter — without

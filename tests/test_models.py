@@ -140,3 +140,69 @@ def test_generation_sampling_shapes():
     out2 = generate(m, prompt, max_new_tokens=4, temperature=0.8, top_p=0.9,
                     rng=jax.random.PRNGKey(0))
     assert out2.shape == (1, 7)
+
+
+# -- Conformer CTC -----------------------------------------------------------
+
+class TestConformer:
+    def test_forward_shapes_and_lengths(self):
+        import paddle_tpu as pt
+        from paddle_tpu.models.conformer import ConformerConfig, ConformerForCTC
+        import jax.numpy as jnp, numpy as np
+
+        pt.seed(0)
+        cfg = ConformerConfig.tiny()
+        model = ConformerForCTC(cfg)
+        feats = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 37, cfg.n_mels)), jnp.float32)
+        lens = jnp.asarray([37, 20])
+        logits, out_len = model(feats, lens)
+        assert logits.shape[0] == 2 and logits.shape[2] == cfg.vocab_size
+        assert int(out_len[0]) == logits.shape[1]
+        assert int(out_len[1]) == (20 + 3) // 4
+
+    def test_ctc_loss_decreases(self):
+        import paddle_tpu as pt
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.models.conformer import ConformerConfig, ConformerForCTC
+        from paddle_tpu.train import make_train_step
+        from paddle_tpu.train.step import init_state
+        import jax.numpy as jnp, numpy as np
+
+        pt.seed(0)
+        cfg = ConformerConfig.tiny()
+        model = ConformerForCTC(cfg)
+        rng = np.random.default_rng(1)
+        feats = jnp.asarray(rng.standard_normal((2, 32, cfg.n_mels)), jnp.float32)
+        flens = jnp.asarray([32, 32])
+        labels = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 5)))
+        llens = jnp.asarray([5, 4])
+
+        optimizer = opt.Adam(learning_rate=3e-3)
+        state = init_state(model, optimizer)
+        step = make_train_step(
+            lambda m, f, fl, y, yl: m.loss(f, fl, y, yl), optimizer)
+        losses = []
+        for _ in range(10):
+            state, loss = step(state, feats, flens, labels, llens)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_greedy_decode_collapses(self):
+        import paddle_tpu as pt
+        from paddle_tpu.models.conformer import ConformerConfig, ConformerForCTC
+        import jax.numpy as jnp, numpy as np
+
+        pt.seed(0)
+        cfg = ConformerConfig.tiny()
+        model = ConformerForCTC(cfg)
+        feats = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (1, 16, cfg.n_mels)), jnp.float32)
+        ids, out_len = model.greedy_decode(feats)
+        arr = np.asarray(ids)[0]
+        kept = arr[arr >= 0]
+        assert (kept != 0).all()           # no blanks survive
+        assert not (np.diff(np.nonzero(arr >= 0)[0]) == 1)[
+            np.diff(kept, prepend=kept[0] if len(kept) else 0)[1:] == 0].any() \
+            if len(kept) > 1 else True     # no adjacent duplicates

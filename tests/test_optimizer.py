@@ -196,3 +196,67 @@ def test_reduce_on_plateau():
     s.step(1.0)
     s.step(1.0)  # no improvement for > patience steps -> halve
     assert s.get_lr() == 0.5
+
+
+# -- Adafactor ---------------------------------------------------------------
+
+class TestAdafactor:
+    def test_slot_memory_is_factored(self):
+        import paddle_tpu.optimizer as opt
+        import jax.numpy as jnp
+        params = {"w": jnp.zeros((64, 32)), "b": jnp.zeros((32,))}
+        st = opt.Adafactor().init(params)
+        assert st["vr"]["w"].shape == (64,)
+        assert st["vc"]["w"].shape == (32,)
+        assert st["vr"]["b"].shape == (32,)   # vectors keep full v
+
+    def test_converges_on_quadratic(self):
+        import paddle_tpu.optimizer as opt
+        import jax, jax.numpy as jnp, numpy as np
+        rng = np.random.default_rng(0)
+        A = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        target = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        params = {"w": jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)}
+        o = opt.Adafactor(learning_rate=0.05, scale_parameter=False)
+        st = o.init(params)
+        loss = lambda p: jnp.mean((p["w"] * A - target) ** 2)
+        l0 = float(loss(params))
+        step = jax.jit(lambda p, s: o.step(p, jax.grad(loss)(p), s))
+        for _ in range(300):
+            params, st = step(params, st)
+        assert float(loss(params)) < 0.2 * l0
+
+    def test_beta1_and_fixed_lr(self):
+        import paddle_tpu.optimizer as opt
+        import jax, jax.numpy as jnp
+        params = {"w": jnp.ones((8, 8))}
+        o = opt.Adafactor(learning_rate=0.01, beta1=0.9, scale_parameter=False)
+        st = o.init(params)
+        assert "m" in st
+        g = {"w": jnp.ones((8, 8))}
+        p2, st2 = jax.jit(o.step)(params, g, st)
+        assert float(jnp.abs(p2["w"] - params["w"]).max()) > 0
+        assert int(st2["step"]) == 1
+
+    def test_trains_llama_tiny(self):
+        import paddle_tpu as pt
+        import paddle_tpu.optimizer as opt
+        import numpy as np
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.train import make_train_step
+        from paddle_tpu.train.step import init_state
+
+        pt.seed(0)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        o = opt.Adafactor()
+        state = init_state(model, o)
+        step = make_train_step(lambda m, i, l: m.loss(i, l), o)
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, cfg.vocab_size, (2, 16))
+        labels = np.concatenate([ids[:, 1:], -100 * np.ones((2, 1), ids.dtype)], 1)
+        losses = []
+        for _ in range(8):
+            state, loss = step(state, ids, labels)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
