@@ -11,7 +11,7 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from paddle_tpu import amp, callbacks, core, io, nn, ops, optimizer, utils
-from paddle_tpu import (audio, autograd, distribution, fft, geometric, hub,
+from paddle_tpu import (audio, autograd, distribution, fft, geometric, hub, incubate,
                         linalg, onnx, quantization, signal, sparse, static,
                         text)
 from paddle_tpu.core import device

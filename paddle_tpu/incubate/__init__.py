@@ -1,0 +1,18 @@
+"""``paddle.incubate`` namespace parity (ref: ``python/paddle/incubate/``).
+
+Everything here is implemented elsewhere in the package under its TPU-native
+home; this module re-exports with the reference's incubate paths so ported
+code finds it: ``incubate.nn.functional.fused_*``, ``incubate.LookAhead``,
+``incubate.distributed.models.moe``…
+"""
+from paddle_tpu.incubate import nn, optimizer, distributed
+from paddle_tpu.optimizer import ExponentialMovingAverage, LookAhead, Lion
+
+__all__ = ["nn", "optimizer", "distributed", "LookAhead",
+           "ExponentialMovingAverage", "Lion", "softmax_mask_fuse"]
+
+
+def softmax_mask_fuse(x, mask):
+    """ref incubate.softmax_mask_fuse — XLA fuses this chain natively."""
+    import jax
+    return jax.nn.softmax(x + mask.astype(x.dtype), axis=-1)

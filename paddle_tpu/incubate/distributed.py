@@ -1,0 +1,6 @@
+"""``paddle.incubate.distributed`` re-exports (MoE expert parallel)."""
+from types import SimpleNamespace
+
+from paddle_tpu.distributed import moe as _moe
+
+models = SimpleNamespace(moe=_moe)

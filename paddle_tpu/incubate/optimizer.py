@@ -1,0 +1,3 @@
+"""``paddle.incubate.optimizer`` re-exports."""
+from paddle_tpu.optimizer import (ExponentialMovingAverage, LookAhead, Lion,
+                                  Adafactor)

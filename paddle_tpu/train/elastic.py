@@ -39,6 +39,12 @@ class ElasticRunner:
             # trainer must fast-forward them to the restored step
             trainer.args.resume_reskip = True
             dog = None
+            if self.stall_timeout_s and not trainer.args.ckpt_every:
+                import warnings
+                warnings.warn(
+                    "ElasticRunner: stall_timeout_s is set but ckpt_every=0 — "
+                    "a stall restart would lose ALL progress. Set "
+                    "TrainerArgs(ckpt_every=N) so recovery has checkpoints.")
             if self.stall_timeout_s:
                 # NO emergency save on trip: during a hung step the live
                 # TrainState holds unfulfilled/donated buffers and reading

@@ -108,3 +108,310 @@ class RandomHorizontalFlip:
             chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[-1]
             return arr[:, :, ::-1].copy() if chw else arr[:, ::-1].copy()
         return arr
+
+
+def _is_chw(arr):
+    return (arr.ndim == 3 and arr.shape[0] in (1, 3)
+            and arr.shape[0] < arr.shape[-1])
+
+
+def _to_hwc(arr):
+    """→ (hwc_array, was_chw). 2-D stays [H, W, 1]."""
+    if arr.ndim == 2:
+        return arr[:, :, None], False
+    if _is_chw(arr):
+        return np.transpose(arr, (1, 2, 0)), True
+    return arr, False
+
+
+def _from_hwc(arr, was_chw, orig_ndim):
+    if orig_ndim == 2:
+        return arr[:, :, 0]
+    return np.transpose(arr, (2, 0, 1)) if was_chw else arr
+
+
+# -- functional mirror (ref python/paddle/vision/transforms/functional.py) ---
+
+def hflip(img):
+    arr = np.asarray(img)
+    return arr[:, :, ::-1].copy() if _is_chw(arr) else arr[:, ::-1].copy()
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    return arr[:, ::-1].copy() if _is_chw(arr) else arr[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    if _is_chw(arr):
+        return arr[:, top:top + height, left:left + width]
+    return arr[top:top + height, left:left + width]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        (pl, pt), (pr, pb) = (padding[0], padding[1]), (padding[0], padding[1])
+    else:
+        pl, pt, pr, pb = padding
+    hwc, was_chw = _to_hwc(arr)
+    kw = {"mode": padding_mode}
+    if padding_mode == "constant":
+        kw["constant_values"] = fill
+    out = np.pad(hwc, ((pt, pb), (pl, pr), (0, 0)), **kw)
+    return _from_hwc(out, was_chw, arr.ndim)
+
+
+def adjust_brightness(img, factor):
+    arr = np.asarray(img).astype(np.float32)
+    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    out = np.clip(arr * factor, 0, hi)
+    return out.astype(np.asarray(img).dtype)
+
+
+def adjust_contrast(img, factor):
+    arr = np.asarray(img).astype(np.float32)
+    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    hwc, _ = _to_hwc(arr)
+    mean = _rgb_to_gray(hwc).mean()
+    out = np.clip(mean + factor * (arr - mean), 0, hi)
+    return out.astype(np.asarray(img).dtype)
+
+
+def adjust_saturation(img, factor):
+    arr = np.asarray(img).astype(np.float32)
+    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    hwc, was_chw = _to_hwc(arr)
+    gray = _rgb_to_gray(hwc)[..., None]
+    out = np.clip(gray + factor * (hwc - gray), 0, hi)
+    return _from_hwc(out, was_chw, arr.ndim).astype(np.asarray(img).dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5] — rotate hue via HSV roundtrip. Grayscale
+    images are returned unchanged (reference behavior)."""
+    src = np.asarray(img)
+    arr = src.astype(np.float32)
+    scale = 255.0 if src.dtype == np.uint8 else 1.0
+    if arr.ndim == 2 or _to_hwc(arr)[0].shape[-1] < 3:
+        return src
+    hwc, was_chw = _to_hwc(arr / scale)
+    r, g, b = hwc[..., 0], hwc[..., 1], hwc[..., 2]
+    mx, mn = hwc.max(-1), hwc.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.empty_like(hwc)
+    for k, (rr, gg, bb) in enumerate([(v, t, p), (q, v, p), (p, v, t),
+                                      (p, q, v), (t, p, v), (v, p, q)]):
+        m = i == k
+        out[..., 0] = np.where(m, rr, out[..., 0]) if k else np.where(m, rr, 0)
+        out[..., 1] = np.where(m, gg, out[..., 1]) if k else np.where(m, gg, 0)
+        out[..., 2] = np.where(m, bb, out[..., 2]) if k else np.where(m, bb, 0)
+    out = _from_hwc(out * scale, was_chw, arr.ndim)
+    return np.clip(out, 0, scale).astype(src.dtype)
+
+
+def _rgb_to_gray(hwc):
+    if hwc.shape[-1] == 1:
+        return hwc[..., 0]
+    return (0.299 * hwc[..., 0] + 0.587 * hwc[..., 1] + 0.114 * hwc[..., 2])
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img).astype(np.float32)
+    hwc, was_chw = _to_hwc(arr)
+    g = _rgb_to_gray(hwc)[..., None]
+    out = np.repeat(g, num_output_channels, axis=-1)
+    return _from_hwc(out, was_chw, 3).astype(np.asarray(img).dtype)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, fill=0.0):
+    """Rotate around the image center (degrees CCW) — inverse-map bilinear
+    sampling in numpy (host-side pipeline, like the reference's CPU path)."""
+    src = np.asarray(img)
+    arr = src.astype(np.float32)
+    hwc, was_chw = _to_hwc(arr)
+    h, w = hwc.shape[:2]
+    # positive angle = counter-clockwise in image coords (y down), matching
+    # the reference; the inverse map therefore rotates by -angle
+    theta = -np.deg2rad(angle)
+    c, s = np.cos(theta), np.sin(theta)
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    if expand:  # enlarge the canvas to hold the whole rotated image
+        oh = int(np.ceil(abs(h * c) + abs(w * s)))
+        ow = int(np.ceil(abs(w * c) + abs(h * s)))
+    else:
+        oh, ow = h, w
+    ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    # inverse rotation of output coords into source coords
+    xs = c * (xx - ocx) + s * (yy - ocy) + cx
+    ys = -s * (xx - ocx) + c * (yy - ocy) + cy
+    if interpolation == "nearest":
+        xi = np.round(xs).astype(np.int64)
+        yi = np.round(ys).astype(np.int64)
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        out = np.where(valid[..., None],
+                       hwc[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)], fill)
+    else:
+        x0 = np.floor(xs).astype(np.int64)
+        y0 = np.floor(ys).astype(np.int64)
+        lx, ly = xs - x0, ys - y0
+        out = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yi, xi = y0 + dy, x0 + dx
+                wgt = ((ly if dy else 1 - ly) * (lx if dx else 1 - lx))[..., None]
+                inb = ((xi >= 0) & (xi < w) & (yi >= 0) & (yi < h))[..., None]
+                v = hwc[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)]
+                out = out + np.where(inb, wgt * v, wgt * fill)
+    return _from_hwc(out, was_chw, src.ndim).astype(src.dtype)
+
+
+def erase(img, i, j, h, w, v=0):
+    arr = np.asarray(img).copy()
+    if _is_chw(arr):
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+# -- transform classes (ref python/paddle/vision/transforms/transforms.py) ---
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, seed=None):
+        self.prob = prob
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        return vflip(img) if self.rng.rand() < self.prob else np.asarray(img)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", seed=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _to_hwc(arr)
+        h, w = hwc.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * self.rng.uniform(*self.scale)
+            ar = np.exp(self.rng.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = self.rng.randint(0, h - ch + 1)
+                j = self.rng.randint(0, w - cw + 1)
+                patch = hwc[i:i + ch, j:j + cw]
+                break
+        else:  # fallback: center crop
+            m = min(h, w)
+            i, j = (h - m) // 2, (w - m) // 2
+            patch = hwc[i:i + m, j:j + m]
+        out = Resize(self.size, self.interpolation)(patch)
+        return _from_hwc(np.asarray(out), was_chw, arr.ndim)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, seed=None):
+        self.brightness, self.contrast = brightness, contrast
+        self.saturation, self.hue = saturation, hue
+        self.rng = np.random.RandomState(seed)
+
+    def _factor(self, amt):
+        return self.rng.uniform(max(0, 1 - amt), 1 + amt)
+
+    def __call__(self, img):
+        out = np.asarray(img)
+        ops = []
+        if self.brightness:
+            ops.append(lambda x: adjust_brightness(x, self._factor(self.brightness)))
+        if self.contrast:
+            ops.append(lambda x: adjust_contrast(x, self._factor(self.contrast)))
+        if self.saturation:
+            ops.append(lambda x: adjust_saturation(x, self._factor(self.saturation)))
+        if self.hue:
+            ops.append(lambda x: adjust_hue(x, self.rng.uniform(-self.hue, self.hue)))
+        self.rng.shuffle(ops)
+        for op in ops:
+            out = op(out)
+        return out
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def __call__(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="bilinear", fill=0.0, seed=None):
+        self.degrees = ((-degrees, degrees) if isinstance(degrees, (int, float))
+                        else tuple(degrees))
+        self.interpolation, self.fill = interpolation, fill
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        angle = self.rng.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, fill=self.fill)
+
+
+class RandomErasing:
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, seed=None):
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.rng.rand() >= self.prob:
+            return arr
+        chw = _is_chw(arr)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        for _ in range(10):
+            target = h * w * self.rng.uniform(*self.scale)
+            ar = np.exp(self.rng.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = self.rng.randint(0, h - eh + 1)
+                j = self.rng.randint(0, w - ew + 1)
+                return erase(arr, i, j, eh, ew, self.value)
+        return arr

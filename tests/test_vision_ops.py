@@ -341,3 +341,94 @@ def test_layer_wrappers():
     assert V.RoIPool(3)(x, boxes, [1]).shape == (1, 4, 3, 3)
     x2 = rng.standard_normal((1, 4 * 4, 8, 8)).astype(np.float32)
     assert V.PSRoIPool(2)(x2, boxes, [1]).shape == (1, 4, 2, 2)
+
+
+# -- transforms (host-side) --------------------------------------------------
+
+class TestTransforms:
+    def _img(self):
+        return np.random.default_rng(3).uniform(0, 255, (16, 20, 3)).astype(np.uint8)
+
+    def test_flip_involution_and_chw(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        np.testing.assert_array_equal(T.vflip(T.vflip(img)), img)
+        np.testing.assert_array_equal(T.hflip(T.hflip(img)), img)
+        chw = np.transpose(img, (2, 0, 1))
+        assert T.vflip(chw).shape == chw.shape
+        np.testing.assert_array_equal(
+            np.transpose(T.vflip(chw), (1, 2, 0)), T.vflip(img))
+
+    def test_pad_and_crop(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        p = T.pad(img, (1, 2, 3, 4))  # l, t, r, b
+        assert p.shape == (16 + 2 + 4, 20 + 1 + 3, 3)
+        np.testing.assert_array_equal(T.crop(p, 2, 1, 16, 20), img)
+
+    def test_adjustments_identity_at_one(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img, atol=1)
+        np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img, atol=1)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2)
+        # brightness 0.5 halves values
+        np.testing.assert_allclose(T.adjust_brightness(img, 0.5),
+                                   (img * 0.5).astype(np.uint8), atol=1)
+
+    def test_rotation_identity_and_90(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img().astype(np.float32)[:16, :16]  # square for 90°
+        np.testing.assert_allclose(T.rotate(img, 0), img, atol=1e-3)
+        r90 = T.rotate(img, 90)
+        # 90° CCW of HWC = np.rot90 on the spatial axes
+        np.testing.assert_allclose(r90, np.rot90(img, 1, (0, 1)), atol=1e-2)
+
+    def test_grayscale_and_erasing(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        g3 = T.Grayscale(3)(img)
+        assert g3.shape == img.shape
+        np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+        e = T.RandomErasing(prob=1.0, value=7, seed=0)(img)
+        assert (e == 7).any() and e.shape == img.shape
+
+    def test_random_resized_crop_and_jitter_shapes(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        out = T.RandomResizedCrop((10, 12), seed=1)(img)
+        assert out.shape == (10, 12, 3)
+        out = T.ColorJitter(0.3, 0.3, 0.3, 0.1, seed=1)(img)
+        assert out.shape == img.shape and out.dtype == img.dtype
+
+    def test_compose_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+        pipe = T.Compose([T.RandomHorizontalFlip(seed=0), T.Resize(8),
+                          T.ToTensor(),
+                          T.Normalize([0.5] * 3, [0.5] * 3)])
+        out = pipe(self._img())
+        assert out.shape == (3, 8, 8)
+        assert out.min() >= -1.001 and out.max() <= 1.001
+
+    def test_adjust_ops_chw_and_grayscale(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        chw = np.transpose(img, (2, 0, 1))
+        # contrast must agree across layouts
+        a = T.adjust_contrast(img, 0.5)
+        b = np.transpose(T.adjust_contrast(chw, 0.5), (1, 2, 0))
+        np.testing.assert_allclose(a.astype(int), b.astype(int), atol=1)
+        # hue on grayscale is a no-op, not a crash
+        gray = img[..., 0]
+        np.testing.assert_array_equal(T.adjust_hue(gray, 0.3), gray)
+
+    def test_rotate_expand(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img().astype(np.float32)
+        out = T.rotate(img, 45, expand=True)
+        assert out.shape[0] > img.shape[0] and out.shape[1] > img.shape[1]
+        # content preserved: sum of a rotated constant image stays ~constant
+        ones = np.ones((10, 10, 1), np.float32)
+        r = T.rotate(ones, 45, expand=True)
+        np.testing.assert_allclose(r.sum(), 100.0, rtol=0.05)
