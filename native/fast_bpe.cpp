@@ -1,0 +1,82 @@
+// Native byte-level BPE encoder (capability ref: PaddleNLP FastTokenizer —
+// the reference ships a C++ tokenizer runtime; this is the TPU-framework's
+// equivalent for the host-side input pipeline).
+//
+// Design: Python trains the merge table (offline); this library runs the hot
+// per-text encode loop. Greedy lowest-rank merging over a byte sequence,
+// pair lookup in a flat hash map. ctypes ABI, no C++ types across the
+// boundary. Calls release the GIL (ctypes does that), so a Python thread
+// pool parallelizes batch encoding across cores.
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+    size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+        return (static_cast<size_t>(p.first) << 32) ^
+               static_cast<uint32_t>(p.second);
+    }
+};
+
+struct Bpe {
+    // (left,right) -> {rank, merged_id}
+    std::unordered_map<std::pair<int32_t, int32_t>, std::pair<int32_t, int32_t>,
+                       PairHash> merges;
+    int32_t byte_ids[256];
+};
+
+}  // namespace
+
+extern "C" {
+
+// merges: n rows of [left_id, right_id, merged_id], ordered by rank (row
+// index IS the rank). byte_ids: 256 entries mapping byte -> initial token id.
+void* bpe_new(const int32_t* merges, int64_t n, const int32_t* byte_ids) {
+    Bpe* b = new Bpe();
+    b->merges.reserve(static_cast<size_t>(n) * 2);
+    for (int64_t i = 0; i < n; ++i) {
+        b->merges[{merges[i * 3], merges[i * 3 + 1]}] = {
+            static_cast<int32_t>(i), merges[i * 3 + 2]};
+    }
+    std::memcpy(b->byte_ids, byte_ids, 256 * sizeof(int32_t));
+    return b;
+}
+
+void bpe_free(void* handle) { delete static_cast<Bpe*>(handle); }
+
+// Encode utf-8 `text` (len bytes) into `out` (capacity max_out).
+// Returns number of ids written, or -(needed) if max_out is too small.
+int64_t bpe_encode(void* handle, const uint8_t* text, int64_t len,
+                   int32_t* out, int64_t max_out) {
+    const Bpe* b = static_cast<const Bpe*>(handle);
+    std::vector<int32_t> ids;
+    ids.reserve(len);
+    for (int64_t i = 0; i < len; ++i) ids.push_back(b->byte_ids[text[i]]);
+
+    // greedy BPE: repeatedly merge the lowest-rank adjacent pair
+    while (ids.size() >= 2) {
+        int32_t best_rank = INT32_MAX, best_pos = -1, best_id = 0;
+        for (size_t i = 0; i + 1 < ids.size(); ++i) {
+            auto it = b->merges.find({ids[i], ids[i + 1]});
+            if (it != b->merges.end() && it->second.first < best_rank) {
+                best_rank = it->second.first;
+                best_pos = static_cast<int32_t>(i);
+                best_id = it->second.second;
+            }
+        }
+        if (best_pos < 0) break;
+        ids[best_pos] = best_id;
+        ids.erase(ids.begin() + best_pos + 1);
+    }
+
+    if (static_cast<int64_t>(ids.size()) > max_out)
+        return -static_cast<int64_t>(ids.size());
+    std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+    return static_cast<int64_t>(ids.size());
+}
+
+}  // extern "C"

@@ -6,12 +6,8 @@ from paddle_tpu.nn.transformer import (MultiHeadAttention as FusedMultiHeadAtten
                                        TransformerEncoderLayer as FusedTransformerEncoderLayer)
 from paddle_tpu.ops import (fused_dropout_add, fused_layer_norm, fused_linear,
                             fused_linear_activation, fused_rms_norm)
-from paddle_tpu.ops.attention import flash_attention
-
-try:
-    from paddle_tpu.ops.pallas.rope import fused_rotary_position_embedding
-except ImportError:  # pallas rope exposes via ops.attention
-    from paddle_tpu.ops.attention import apply_rope as fused_rotary_position_embedding
+from paddle_tpu.ops.attention import (flash_attention,
+                                      fused_rotary_position_embedding)
 
 functional = SimpleNamespace(
     fused_rms_norm=fused_rms_norm,

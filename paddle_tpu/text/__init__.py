@@ -18,7 +18,7 @@ import re
 
 import numpy as np
 
-__all__ = ["SimpleTokenizer", "AutoTokenizer", "pad_batch"]
+__all__ = ["SimpleTokenizer", "AutoTokenizer", "BPETokenizer", "pad_batch"]
 
 
 def pad_batch(seqs, max_len=None, pad_id=0):
@@ -128,3 +128,4 @@ class AutoTokenizer:
     def from_pretrained(path, **kw):
         from transformers import AutoTokenizer as _HFAuto
         return _HFAuto.from_pretrained(path, local_files_only=True, **kw)
+from paddle_tpu.text.bpe import BPETokenizer

@@ -165,29 +165,37 @@ def pad(img, padding, fill=0, padding_mode="constant"):
     return _from_hwc(out, was_chw, arr.ndim)
 
 
+def _value_ceiling(arr):
+    """0-255 for uint8 AND for floats still in the 0-255 range (Resize keeps
+    uint8 inputs there); 1.0 only for genuinely normalized floats."""
+    if arr.dtype == np.uint8 or float(arr.max(initial=0.0)) > 1.5:
+        return 255.0
+    return 1.0
+
+
 def adjust_brightness(img, factor):
-    arr = np.asarray(img).astype(np.float32)
-    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
-    out = np.clip(arr * factor, 0, hi)
-    return out.astype(np.asarray(img).dtype)
+    src = np.asarray(img)
+    arr = src.astype(np.float32)
+    out = np.clip(arr * factor, 0, _value_ceiling(src))
+    return out.astype(src.dtype)
 
 
 def adjust_contrast(img, factor):
-    arr = np.asarray(img).astype(np.float32)
-    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    src = np.asarray(img)
+    arr = src.astype(np.float32)
     hwc, _ = _to_hwc(arr)
     mean = _rgb_to_gray(hwc).mean()
-    out = np.clip(mean + factor * (arr - mean), 0, hi)
-    return out.astype(np.asarray(img).dtype)
+    out = np.clip(mean + factor * (arr - mean), 0, _value_ceiling(src))
+    return out.astype(src.dtype)
 
 
 def adjust_saturation(img, factor):
-    arr = np.asarray(img).astype(np.float32)
-    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    src = np.asarray(img)
+    arr = src.astype(np.float32)
     hwc, was_chw = _to_hwc(arr)
     gray = _rgb_to_gray(hwc)[..., None]
-    out = np.clip(gray + factor * (hwc - gray), 0, hi)
-    return _from_hwc(out, was_chw, arr.ndim).astype(np.asarray(img).dtype)
+    out = np.clip(gray + factor * (hwc - gray), 0, _value_ceiling(src))
+    return _from_hwc(out, was_chw, arr.ndim).astype(src.dtype)
 
 
 def adjust_hue(img, hue_factor):

@@ -39,3 +39,44 @@ def test_pad_batch_truncates():
     ids, mask = pad_batch([[1, 2, 3, 4, 5], [6]], max_len=3, pad_id=9)
     assert ids.tolist() == [[1, 2, 3], [6, 9, 9]]
     assert mask.tolist() == [[1, 1, 1], [1, 0, 0]]
+
+
+# -- native byte-level BPE ---------------------------------------------------
+
+class TestBPE:
+    def _tok(self, native=True):
+        from paddle_tpu.text.bpe import BPETokenizer
+        texts = ["the quick brown fox jumps over the lazy dog",
+                 "pack my box with five dozen liquor jugs"] * 30
+        return BPETokenizer.train(texts, vocab_size=320, use_native=native)
+
+    def test_native_matches_python(self):
+        tok = self._tok()
+        from paddle_tpu.text.bpe import BPETokenizer
+        pytok = BPETokenizer(tok.merges, tok.special_tokens, use_native=False)
+        for s in ["the quick brown fox", "jugs of liquor", "unseen wørds ✓",
+                  "", "a", "double  space", " leading"]:
+            assert tok.encode(s) == pytok.encode(s), s
+
+    def test_roundtrip_and_compression(self):
+        tok = self._tok()
+        s = "the quick brown fox jumps over the lazy dog"
+        ids = tok.encode(s)
+        assert tok.decode(ids) == s
+        assert len(ids) < len(s.encode())  # merges actually compress
+
+    def test_save_load(self, tmp_path):
+        tok = self._tok()
+        p = str(tmp_path / "bpe.json")
+        tok.save(p)
+        from paddle_tpu.text.bpe import BPETokenizer
+        back = BPETokenizer.load(p)
+        s = "the lazy dog packs jugs"
+        assert back.encode(s) == tok.encode(s)
+        assert back.vocab_size == tok.vocab_size
+
+    def test_batch_threads(self):
+        tok = self._tok()
+        texts = ["the quick brown fox"] * 64
+        out = tok.encode_batch(texts, num_threads=4)
+        assert len(out) == 64 and all(o == out[0] for o in out)
