@@ -140,3 +140,4 @@ class GradScaler:
             lambda old, new: jnp.where(found_inf, old, new)
             if old is not None and hasattr(old, "dtype") else old,
             params, new_params, is_leaf=lambda x: x is None)
+from paddle_tpu.amp import debugging

@@ -1,0 +1,93 @@
+"""AMP debugging utilities (ref: ``python/paddle/amp/debugging.py`` —
+check_numerics, operator stats collection, accuracy comparison).
+
+TPU-native: op statistics come from the lowered StableHLO (the compiled
+truth about which ops run in which dtype — the reference instruments the
+dygraph op stream instead), and numeric checks are host-side over pytrees.
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["check_numerics", "collect_operator_stats", "compare_accuracy",
+           "count_nonfinite"]
+
+
+def count_nonfinite(tree):
+    """(n_nan, n_inf) across every float leaf."""
+    n_nan = n_inf = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            # native dtype: a float32 downcast would overflow finite fp64
+            # (and ml_dtypes handle isnan/isinf natively)
+            a = np.asarray(leaf)
+            n_nan += int(np.isnan(a).sum())
+            n_inf += int(np.isinf(a).sum())
+    return n_nan, n_inf
+
+
+def check_numerics(tree, name="tensor", raise_on_error=True):
+    """Raise (or warn) if any float leaf contains nan/inf (ref
+    ``paddle.amp.debugging.check_numerics``). Host-side, eager."""
+    n_nan, n_inf = count_nonfinite(tree)
+    if n_nan or n_inf:
+        msg = f"check_numerics({name}): {n_nan} NaN, {n_inf} Inf values"
+        if raise_on_error:
+            raise FloatingPointError(msg)
+        import warnings
+        warnings.warn(msg)
+        return False
+    return True
+
+
+_OP_RE = re.compile(r"stablehlo\.(\w+)")
+_TYPE_RE = re.compile(r"tensor<[^>]*?(f32|f16|bf16|f64|i32|i8|i64)>")
+
+
+def collect_operator_stats(fn, *args, print_fn=print, **kwargs):
+    """Count ops per (op_kind, result dtype) in the lowered program (ref
+    ``paddle.amp.debugging.collect_operator_stats``). Answers the AMP
+    question 'which matmuls stayed fp32?' from the compiled truth."""
+    text = jax.jit(fn).lower(*args, **kwargs).as_text()
+    stats: Counter = Counter()
+    for line in text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        t = _TYPE_RE.search(line.split("->")[-1] if "->" in line else line)
+        stats[(m.group(1), t.group(1) if t else "?")] += 1
+    if print_fn:
+        width = max((len(k[0]) for k in stats), default=4)
+        for (op, dt), n in sorted(stats.items(), key=lambda kv: -kv[1]):
+            print_fn(f"{op:<{width}}  {dt:>5}  x{n}")
+    return dict(stats)
+
+
+def compare_accuracy(run_fp32, run_low, *args, atol=1e-2, rtol=1e-2,
+                     print_fn=print):
+    """Run the same computation in two precisions and report per-leaf max
+    abs/rel error (ref ``paddle.amp.debugging.compare_accuracy``)."""
+    out_hi = run_fp32(*args)
+    out_lo = run_low(*args)
+    flat_hi = jax.tree_util.tree_leaves(out_hi)
+    flat_lo = jax.tree_util.tree_leaves(out_lo)
+    report = []
+    ok = True
+    for i, (a, b) in enumerate(zip(flat_hi, flat_lo)):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        abs_err = float(np.max(np.abs(a - b))) if a.size else 0.0
+        rel_err = float(np.max(np.abs(a - b) / (np.abs(a) + 1e-9))) if a.size else 0.0
+        good = abs_err <= atol or rel_err <= rtol
+        ok &= good
+        report.append({"leaf": i, "abs_err": abs_err, "rel_err": rel_err,
+                       "ok": good})
+        if print_fn:
+            print_fn(f"leaf {i}: abs {abs_err:.3e} rel {rel_err:.3e} "
+                     f"{'OK' if good else 'MISMATCH'}")
+    return ok, report

@@ -24,7 +24,9 @@ from paddle_tpu.distributed.sharded import (
     shard_module,
     with_sharding_constraint,
 )
-from paddle_tpu.distributed.ring_attention import make_ring_attention, ring_attention
+from paddle_tpu.distributed.ring_attention import (
+    make_ring_attention, make_zigzag_ring_attention, ring_attention,
+    zigzag_inverse_permutation, zigzag_permutation, zigzag_ring_attention)
 from paddle_tpu.distributed.ulysses import make_ulysses_attention, ulysses_attention
 from paddle_tpu.distributed.tensor_parallel import (
     ColumnParallelLinear,

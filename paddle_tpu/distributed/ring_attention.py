@@ -29,6 +29,10 @@ def _block_attend(q, k, v, scale, mask):
         s = jnp.where(mask[None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # [B,H,Sq]
     p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # a fully-masked row has m = NEG_INF and exp(s - m) = 1 — zero the
+        # masked entries explicitly so dead rows contribute l = 0, not Sk
+        p = jnp.where(mask[None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)  # [B,H,Sq]
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
     return o, m, l
@@ -97,5 +101,137 @@ def make_ring_attention(mesh, causal=True):
                        in_specs=(spec, spec, spec), out_specs=spec)
     def attend(q, k, v):
         return ring_attention(q, k, v, axis_name="sp", causal=causal)
+
+    return attend
+
+
+# -- zigzag (load-balanced causal) ring attention ----------------------------
+#
+# With contiguous block sharding, causal masking makes rank r do r+1 visible
+# kv blocks while rank 0 does one — the ring's wall-clock is set by the last
+# rank (~2× waste). Zigzag assignment (rank r holds chunks r and 2n-1-r of a
+# 2n-chunk split) gives every rank one early and one late chunk, so visible
+# work is equal across ranks. Same trick as the public zigzag/striped ring
+# attention formulations; outputs stay in zigzag layout (invert with
+# zigzag_inverse_permutation).
+
+def zigzag_permutation(seq_len: int, n_shards: int):
+    """Index array mapping zigzag order → original positions: apply
+    ``x[:, perm]`` BEFORE sharding on sp."""
+    import numpy as np
+    assert seq_len % (2 * n_shards) == 0, "2*n_shards must divide seq_len"
+    c = seq_len // (2 * n_shards)
+    order = []
+    for r in range(n_shards):
+        order.extend(range(r * c, (r + 1) * c))                       # chunk r
+        order.extend(range((2 * n_shards - 1 - r) * c,
+                           (2 * n_shards - r) * c))                   # chunk 2n-1-r
+    return np.asarray(order)
+
+
+def zigzag_inverse_permutation(seq_len: int, n_shards: int):
+    import numpy as np
+    perm = zigzag_permutation(seq_len, n_shards)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return inv
+
+
+def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp",
+                          scale: float | None = None):
+    """Causal ring attention over zigzag-laid-out shards (see
+    zigzag_permutation). [B, S/sp, H, D] per member; the local sequence is
+    [chunk_my, chunk_{2n-1-my}] (chunks A and B).
+
+    Per ring step this computes exactly TWO s2×s2 block-attends — the dead
+    quadrants are never evaluated, which is the point of the zigzag layout.
+    With chunk ids a = my < n ≤ b = 2n-1-my and kv ids c = src, d = 2n-1-src:
+      * A never sees D (a < n ≤ d), B always fully sees C (b ≥ n > c)
+      * step 0 (src == my): A·C causal + B·C full + B·D causal
+      * src < my: A·C full + B·C full          (B·D dead: b < d)
+      * src > my: B·C full + B·D full          (A·C dead: a < c)
+    The traced src<my / src>my choice is made by SELECTING OPERANDS
+    (qA vs qB, C vs D) into one dense block-attend — shapes stay static.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    assert s_loc % 2 == 0, "zigzag needs an even local length"
+    s2 = s_loc // 2
+    scale = scale if scale is not None else d ** -0.5
+
+    tril = jnp.tril(jnp.ones((s2, s2), bool))
+    qA, qB = q[:, :s2], q[:, s2:]
+
+    # accumulators per half
+    def zero_acc():
+        return (jnp.zeros((b, s2, h, d), jnp.float32),
+                jnp.full((b, h, s2), _NEG_INF, jnp.float32),
+                jnp.zeros((b, h, s2), jnp.float32))
+
+    accA, accB = zero_acc(), zero_acc()
+
+    def merge(acc, o_b, m_b, l_b):
+        o, m, l = acc
+        m_new = jnp.maximum(m, m_b)
+        c1 = jnp.exp(m - m_new)
+        c2 = jnp.exp(m_b - m_new)
+        o = (o * jnp.moveaxis(c1, 1, 2)[..., None]
+             + o_b * jnp.moveaxis(c2, 1, 2)[..., None])
+        return o, m_new, l * c1 + l_b * c2
+
+    def merge_where(pred, acc, o_b, m_b, l_b):
+        """Merge only where pred (per-member traced bool)."""
+        o, m, l = acc
+        o2, m2, l2 = merge(acc, o_b, m_b, l_b)
+        sel = lambda x2, x1: jnp.where(pred, x2, x1)
+        return sel(o2, o), sel(m2, m), sel(l2, l)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_blk, v_blk = k, v
+    for step in range(n):
+        kC, vC = k_blk[:, :s2], v_blk[:, :s2]
+        kD, vD = k_blk[:, s2:], v_blk[:, s2:]
+        if step == 0:
+            # diagonal: A·A causal, B·[A full | B causal]
+            accA = merge(accA, *_block_attend(qA, kC, vC, scale, tril))
+            accB = merge(accB, *_block_attend(qB, kC, vC, scale, None))
+            accB = merge(accB, *_block_attend(qB, kD, vD, scale, tril))
+        else:
+            src = (my - step) % n
+            pred = src < my              # else src > my (never equal here)
+            # block 1: B·C — visible in both cases
+            accB = merge(accB, *_block_attend(qB, kC, vC, scale, None))
+            # block 2: A·C (pred) or B·D (!pred) — select operands, one dense
+            qx = jnp.where(pred, qA, qB)
+            ky = jnp.where(pred, kC, kD)
+            vy = jnp.where(pred, vC, vD)
+            o_b, m_b, l_b = _block_attend(qx, ky, vy, scale, None)
+            accA = merge_where(pred, accA, o_b, m_b, l_b)
+            accB = merge_where(~pred, accB, o_b, m_b, l_b)
+        if step != n - 1:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+
+    def finalize(acc):
+        o, m, l = acc
+        return o / jnp.maximum(jnp.moveaxis(l, 1, 2)[..., None], 1e-30)
+
+    out = jnp.concatenate([finalize(accA), finalize(accB)], axis=1)
+    return out.astype(q.dtype)
+
+
+def make_zigzag_ring_attention(mesh):
+    """shard_map-wrapped zigzag ring attention (inputs already in zigzag
+    layout, S sharded over sp)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(("dp", "fsdp"), "sp", None, None)
+
+    @functools.partial(shard_map, mesh=mesh.mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
+    def attend(q, k, v):
+        return zigzag_ring_attention(q, k, v, axis_name="sp")
 
     return attend

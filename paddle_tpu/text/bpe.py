@@ -22,18 +22,23 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 def _load_native():
     path = os.path.join(_NATIVE_DIR, "libfastbpe.so")
-    if not os.path.exists(path):
-        src = os.path.join(_NATIVE_DIR, "fast_bpe.cpp")
-        if os.path.exists(src):
-            import subprocess
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR, "libfastbpe.so"],
-                               check=True, capture_output=True)
-            except Exception:
+    src = os.path.join(_NATIVE_DIR, "fast_bpe.cpp")
+    stale = (os.path.exists(path) and os.path.exists(src)
+             and os.path.getmtime(src) > os.path.getmtime(path))
+    if (not os.path.exists(path) or stale) and os.path.exists(src):
+        import subprocess
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-B", "libfastbpe.so"],
+                           check=True, capture_output=True)
+        except Exception:
+            if not os.path.exists(path):
                 return None
     if not os.path.exists(path):
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:  # wrong arch / platform: pure-Python fallback
+        return None
     lib.bpe_new.restype = ctypes.c_void_p
     lib.bpe_new.argtypes = [ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
                             ctypes.POINTER(ctypes.c_int32)]

@@ -60,8 +60,14 @@ class Resize:
         else:
             h_axis, shape = 0, self.size + (arr.shape[-1],) if arr.ndim == 3 else self.size
         method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[self.interpolation]
-        out = jax.image.resize(jnp.asarray(arr, jnp.float32), shape, method=method)
-        return np.asarray(out)
+        out = np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32), shape,
+                                          method=method))
+        if arr.dtype == np.uint8:
+            # preserve the dtype contract: uint8 in → uint8 out, so the
+            # 0-255 vs 0-1 value-range question never depends on pipeline
+            # position (reference behavior)
+            out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+        return out
 
 
 class CenterCrop:
@@ -166,11 +172,10 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 
 def _value_ceiling(arr):
-    """0-255 for uint8 AND for floats still in the 0-255 range (Resize keeps
-    uint8 inputs there); 1.0 only for genuinely normalized floats."""
-    if arr.dtype == np.uint8 or float(arr.max(initial=0.0)) > 1.5:
-        return 255.0
-    return 1.0
+    """Dtype contract, never data-dependent: uint8 images live in 0-255,
+    float images in 0-1 (ToTensor's output). Resize preserves uint8, so a
+    pipeline never silently switches range mid-stream."""
+    return 255.0 if arr.dtype == np.uint8 else 1.0
 
 
 def adjust_brightness(img, factor):

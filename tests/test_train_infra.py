@@ -300,3 +300,27 @@ def test_elastic_gives_up(tmp_path):
 
     with _pytest.raises(RuntimeError, match="gave up"):
         run_elastic(make_trainer, data_fn, max_restarts=1, backoff_s=0.0)
+
+
+def test_amp_debugging():
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest as _pytest
+    from paddle_tpu.amp import debugging as D
+
+    assert D.check_numerics({"a": jnp.ones(3)}) is True
+    with _pytest.raises(FloatingPointError, match="1 NaN"):
+        D.check_numerics({"a": jnp.asarray([1.0, np.nan])})
+
+    stats = D.collect_operator_stats(
+        lambda x, w: (x @ w).astype(jnp.bfloat16) @ w.T.astype(jnp.bfloat16),
+        jnp.ones((4, 8)), jnp.ones((8, 8)), print_fn=None)
+    dots = {k: v for k, v in stats.items() if k[0] == "dot_general"}
+    assert sum(dots.values()) == 2
+    assert any(dt == "bf16" for (_, dt) in dots)
+
+    ok, rep = D.compare_accuracy(
+        lambda x: x * 2.0,
+        lambda x: (x.astype(jnp.bfloat16) * 2.0).astype(jnp.float32),
+        jnp.linspace(0, 1, 16), print_fn=None)
+    assert ok and len(rep) == 1
