@@ -76,6 +76,10 @@ def compare_accuracy(run_fp32, run_low, *args, atol=1e-2, rtol=1e-2,
     out_lo = run_low(*args)
     flat_hi = jax.tree_util.tree_leaves(out_hi)
     flat_lo = jax.tree_util.tree_leaves(out_lo)
+    if len(flat_hi) != len(flat_lo):
+        raise ValueError(
+            f"fp32/low-precision outputs have different structures "
+            f"({len(flat_hi)} vs {len(flat_lo)} leaves); cannot compare")
     report = []
     ok = True
     for i, (a, b) in enumerate(zip(flat_hi, flat_lo)):

@@ -22,9 +22,20 @@ from paddle_tpu.nn.loss import (
     SoftMarginLoss,
     TripletMarginLoss,
 )
-from paddle_tpu.nn.rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell
+from paddle_tpu.nn.rnn import (
+    GRU,
+    RNN,
+    BiRNN,
+    GRUCell,
+    LSTM,
+    LSTMCell,
+    SimpleRNN,
+    SimpleRNNCell,
+)
 from paddle_tpu.nn.transformer import (
     MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
     TransformerDecoderLayer,
     TransformerEncoder,
     TransformerEncoderLayer,

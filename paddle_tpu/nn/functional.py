@@ -129,6 +129,10 @@ def dropout2d(x, p=0.5, training=True, *, rng=None):
     return dropout(x, p, training, rng=rng, axis=(0, 1))  # drop whole channels NCHW
 
 
+def dropout3d(x, p=0.5, training=True, *, rng=None):
+    return dropout(x, p, training, rng=rng, axis=(0, 1))  # NCDHW channel drop
+
+
 def alpha_dropout(x, p=0.5, training=True, *, rng=None):
     if not training or p == 0.0:
         return x
@@ -366,9 +370,117 @@ def _pool(x, init, op, kernel, stride, padding, data_format="NCHW"):
     return lax.reduce_window(x, init, op, dims, strides, pads)
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+def _max_pool_with_mask(x, kernel, stride, padding):
+    """Max pool returning (out, flat-argmax-indices) — ref pooling.py
+    ``return_mask=True``. NC{spatial} layout; indices are flat over the
+    *unpadded* spatial dims, matching the reference. Built on patch
+    extraction so it stays one fused XLA op chain (no host loops)."""
+    nd = x.ndim - 2
+    k = _norm_tuple(kernel, nd)
+    s = _norm_tuple(stride or kernel, nd)
+    p = _norm_tuple(padding, nd)
+    # finite dtype-min, not -inf: patch extraction is a conv with a 0/1
+    # identity kernel and 0 * -inf would poison borders with NaN
+    neg = jnp.asarray(jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
+                 constant_values=neg)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    patches = lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s,
+        padding=[(0, 0)] * nd,
+        dimension_numbers=("NC" + "HWD"[:nd], "OI" + "HWD"[:nd],
+                           "NC" + "HWD"[:nd]))
+    out_sp = patches.shape[2:]
+    ksize = 1
+    for ki in k:
+        ksize *= ki
+    pr = patches.reshape((n, c, ksize) + out_sp)
+    out = pr.max(axis=2)
+    arg = pr.argmax(axis=2)  # window-local flat index, (k0, k1, ...) order
+    # decompose local index into per-dim offsets, add window origin, un-pad
+    flat = jnp.zeros_like(arg)
+    rem = arg
+    for d in range(nd):
+        tail = 1
+        for ki in k[d + 1:]:
+            tail *= ki
+        loc = rem // tail
+        rem = rem % tail
+        origin = jnp.arange(out_sp[d]) * s[d] - p[d]
+        origin = origin.reshape((1, 1) + tuple(
+            out_sp[d] if i == d else 1 for i in range(nd)))
+        gidx = loc + origin
+        tail_sp = 1
+        for si in spatial[d + 1:]:
+            tail_sp *= si
+        flat = flat + gidx * tail_sp
+    return out, flat
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW",
+               return_mask=False):
+    if return_mask:
+        assert data_format == "NCHW"
+        return _max_pool_with_mask(x, kernel_size, stride, padding)
     return _pool(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
                  lax.max, kernel_size, stride, padding, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, data_format="NCDHW",
+               return_mask=False):
+    if return_mask:
+        assert data_format == "NCDHW"
+        return _max_pool_with_mask(x, kernel_size, stride, padding)
+    # _pool only distinguishes channel-first vs channel-last
+    fmt = "NCHW" if data_format == "NCDHW" else "NHWC"
+    return _pool(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                 lax.max, kernel_size, stride, padding, fmt)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, data_format="NCDHW",
+               exclusive=True):
+    fmt = "NCHW" if data_format == "NCDHW" else "NHWC"
+    return avg_pool2d(x, kernel_size, stride, padding, fmt, exclusive)
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, nd):
+    k = _norm_tuple(kernel_size, nd)
+    s = _norm_tuple(stride or kernel_size, nd)
+    p = _norm_tuple(padding, nd)
+    n, c = x.shape[:2]
+    in_sp = x.shape[2:]
+    if output_size is None:
+        out_sp = tuple((in_sp[d] - 1) * s[d] - 2 * p[d] + k[d]
+                       for d in range(nd))
+    else:
+        out_sp = tuple(output_size[-nd:])
+    total = 1
+    for si in out_sp:
+        total *= si
+    vals = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1)
+    flat = jnp.zeros((n, c, total), x.dtype)
+    out = flat.at[jnp.arange(n)[:, None, None],
+                  jnp.arange(c)[None, :, None], idx].set(vals)
+    return out.reshape((n, c) + out_sp)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Inverse of max_pool1d with return_mask (ref pooling.py:max_unpool1d)."""
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW",
@@ -386,7 +498,9 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW",
     return summed / denom
 
 
-def max_pool1d(x, kernel_size, stride=None, padding=0):
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding)
     return max_pool2d(x[..., None], (_norm_tuple(kernel_size, 1)[0], 1),
                       (_norm_tuple(stride or kernel_size, 1)[0], 1),
                       (_norm_tuple(padding, 1)[0], 0))[..., 0]
@@ -993,3 +1107,22 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 valid &= (ix >= 0) & (ix <= s - 1)
         out = out + gather(idx, valid) * w[..., None].astype(cdtype)
     return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+
+# -- beam-search utilities (ref functional/extension.py) ---------------------
+
+def gather_tree(ids, parents):
+    """Reconstruct full beam sequences from per-step ids + parent pointers
+    (ref ``paddle.nn.functional.gather_tree``). Shapes: [T, B, beam].
+
+    Lowered as a single reverse ``lax.scan`` — the backtrace is sequential
+    by nature but stays on-device (no host loop)."""
+    def step(beam, xs):
+        idt, part = xs
+        out = jnp.take_along_axis(idt, beam, axis=-1)
+        return jnp.take_along_axis(part, beam, axis=-1), out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=ids.dtype),
+                            ids.shape[1:])
+    _, outs = lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return outs[::-1]

@@ -39,6 +39,8 @@ __all__ = [
     "ChannelShuffle", "CosineSimilarity", "PairwiseDistance", "InstanceNorm1D",
     "InstanceNorm3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool3D",
     "AdaptiveMaxPool1D", "SpectralNorm",
+    "MaxPool3D", "AvgPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "Softmax2D", "Dropout3D",
 ]
 
 
@@ -120,6 +122,22 @@ class Dropout2D(Module):
 
     def __call__(self, x, rng=None):
         return F.dropout2d(x, self.p, training=self.training, rng=rng)
+
+
+class Dropout3D(Module):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def __call__(self, x, rng=None):
+        return F.dropout3d(x, self.p, training=self.training, rng=rng)
+
+
+class Softmax2D(Module):
+    """Softmax over the channel axis of NCHW input (ref activation.py:Softmax2D)."""
+
+    def __call__(self, x):
+        return F.softmax(x, axis=-3)
 
 
 class AlphaDropout(Module):
@@ -413,12 +431,58 @@ class LocalResponseNorm(Module):
 # -- pooling layers ---------------------------------------------------------
 
 class MaxPool2D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.return_mask = return_mask
+
+    def __call__(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask)
+
+
+class MaxPool3D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.return_mask = return_mask
+
+    def __call__(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask)
+
+
+class AvgPool3D(Module):
     def __init__(self, kernel_size, stride=None, padding=0):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
 
     def __call__(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class MaxUnPool1D(Module):
+    """Inverse max-pool scatter (ref pooling.py:MaxUnPool1D)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def __call__(self, x, indices, output_size=None):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size)
+
+
+class MaxUnPool2D(MaxUnPool1D):
+    def __call__(self, x, indices, output_size=None):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size)
+
+
+class MaxUnPool3D(MaxUnPool1D):
+    def __call__(self, x, indices, output_size=None):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size)
 
 
 class AvgPool2D(Module):
@@ -431,12 +495,14 @@ class AvgPool2D(Module):
 
 
 class MaxPool1D(Module):
-    def __init__(self, kernel_size, stride=None, padding=0):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.return_mask = return_mask
 
     def __call__(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask)
 
 
 class AvgPool1D(Module):

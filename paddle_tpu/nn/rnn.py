@@ -131,6 +131,68 @@ class _RNNBase(Module):
         return jnp.swapaxes(x_tbc, 0, 1), finals
 
 
+def _cell_step(cell, xt, state):
+    """Uniform (h, new_state) protocol over our cell classes."""
+    if isinstance(cell, LSTMCell):
+        return cell(xt, state)
+    out = cell(xt, state)
+    return out, out
+
+
+def _cell_zero_state(cell, batch, dtype):
+    h = jnp.zeros((batch, cell.hidden_size), dtype)
+    return (h, jnp.zeros_like(h)) if isinstance(cell, LSTMCell) else h
+
+
+class RNN(Module):
+    """Generic cell driver (ref ``python/paddle/nn/layer/rnn.py`` class RNN).
+
+    Wraps any single-step cell and scans it over time with ``lax.scan``.
+    ``forward(inputs, initial_states)`` -> ``(outputs, final_states)``.
+    """
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def __call__(self, inputs, initial_states=None):
+        x_tbc = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        batch = x_tbc.shape[1]
+        state = (initial_states if initial_states is not None
+                 else _cell_zero_state(self.cell, batch, x_tbc.dtype))
+        if self.is_reverse:
+            x_tbc = jnp.flip(x_tbc, axis=0)
+
+        def step(st, xt):
+            h, st = _cell_step(self.cell, xt, st)
+            return st, h
+
+        final, ys = lax.scan(step, state, x_tbc)
+        if self.is_reverse:
+            ys = jnp.flip(ys, axis=0)
+        outputs = ys if self.time_major else jnp.swapaxes(ys, 0, 1)
+        return outputs, final
+
+
+class BiRNN(Module):
+    """Bidirectional cell driver (ref rnn.py class BiRNN): runs ``cell_fw``
+    forward and ``cell_bw`` reversed, concatenating outputs on the feature
+    axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def __call__(self, inputs, initial_states=None):
+        st_fw, st_bw = (None, None) if initial_states is None else initial_states
+        out_fw, fin_fw = self.fw(inputs, st_fw)
+        out_bw, fin_bw = self.bw(inputs, st_bw)
+        return jnp.concatenate([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
 class SimpleRNN(_RNNBase):
     cell_cls = SimpleRNNCell
 

@@ -139,3 +139,69 @@ class TransformerDecoderLayer(Module):
         if not self.normalize_before:
             x = self.norm3(x)
         return x
+
+
+class TransformerDecoder(Module):
+    """Stack of decoder layers (ref transformer.py:TransformerDecoder)."""
+
+    def __init__(self, layer_fn, num_layers, norm=None):
+        super().__init__()
+        self.layers = LayerList([layer_fn() for _ in range(num_layers)])
+        self.norm = norm
+
+    def __call__(self, tgt, memory, tgt_mask=None, memory_mask=None, rng=None):
+        x = tgt
+        for i, layer in enumerate(self.layers):
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = layer(x, memory, tgt_mask=tgt_mask, memory_mask=memory_mask, rng=sub)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+
+class Transformer(Module):
+    """Full encoder-decoder Transformer (ref transformer.py:Transformer).
+
+    Keeps the reference constructor signature; ``custom_encoder`` /
+    ``custom_decoder`` swap in user stacks. ``forward(src, tgt, ...)``
+    returns decoder output [B, T_tgt, d_model].
+    """
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", normalize_before=False,
+                 custom_encoder=None, custom_decoder=None, dtype=None):
+        super().__init__()
+        self.d_model, self.nhead = d_model, nhead
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            self.encoder = TransformerEncoder(
+                lambda: TransformerEncoderLayer(
+                    d_model, nhead, dim_feedforward, dropout, activation,
+                    normalize_before, dtype=dtype),
+                num_encoder_layers,
+                norm=LayerNorm(d_model, dtype=dtype) if normalize_before else None)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            self.decoder = TransformerDecoder(
+                lambda: TransformerDecoderLayer(
+                    d_model, nhead, dim_feedforward, dropout, activation,
+                    normalize_before, dtype=dtype),
+                num_decoder_layers,
+                norm=LayerNorm(d_model, dtype=dtype) if normalize_before else None)
+
+    def __call__(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None,
+                 rng=None):
+        r1, r2 = (None, None) if rng is None else tuple(jax.random.split(rng))
+        memory = self.encoder(src, src_mask=src_mask, rng=r1)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask, rng=r2)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length):
+        """Additive causal mask: 0 on/below diagonal, -inf above."""
+        row = jnp.arange(length)[:, None]
+        col = jnp.arange(length)[None, :]
+        return jnp.where(col <= row, 0.0, -jnp.inf).astype(jnp.float32)

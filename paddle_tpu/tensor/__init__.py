@@ -262,6 +262,25 @@ def quantile(x, q, axis=None, keepdim=False):
     return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
 
 
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def as_strided(x, shape, stride, offset=0):
+    """Strided view (ref manipulation.py:as_strided). JAX arrays have no
+    raw-memory views, so this materialises the equivalent gather: index
+    [i0..ik] reads flat element offset + sum(i*stride)."""
+    flat = jnp.ravel(x)
+    idx = jnp.asarray(offset)
+    for n, s in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(n) * s
+    return flat[idx]
+
+
 def logsumexp(x, axis=None, keepdim=False):
     return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
 

@@ -129,3 +129,5 @@ class AutoTokenizer:
         from transformers import AutoTokenizer as _HFAuto
         return _HFAuto.from_pretrained(path, local_files_only=True, **kw)
 from paddle_tpu.text.bpe import BPETokenizer
+from paddle_tpu.text.viterbi import ViterbiDecoder, viterbi_decode
+from paddle_tpu.text import datasets

@@ -1,0 +1,338 @@
+"""Text datasets (ref: ``python/paddle/text/datasets/``).
+
+File-backed parsers for the reference's dataset archives (zero egress:
+``data_file`` must point at a local copy of the canonical archive — the
+same file the reference's downloader would fetch). Formats:
+
+* ``Imdb``       — aclImdb_v1.tar.gz (ref imdb.py)
+* ``Imikolov``   — PTB simple-examples.tgz (ref imikolov.py)
+* ``UCIHousing`` — housing.data whitespace table (ref uci_housing.py)
+* ``Movielens``  — ml-1m.zip (ref movielens.py)
+* ``Conll05st``  — conll05st tarball (ref conll05.py)
+* ``WMT14`` / ``WMT16`` — tokenized dev+train tarballs (ref wmt14.py/wmt16.py)
+
+All return numpy arrays ready for ``paddle_tpu.io.DataLoader``.
+"""
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+import tarfile
+import zipfile
+from collections import Counter
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "Conll05st",
+           "WMT14", "WMT16"]
+
+
+def _build_dict(counter, min_freq=0, extra=()):
+    words = [w for w, c in counter.most_common() if c >= min_freq]
+    vocab = {}
+    for w in extra:
+        vocab[w] = len(vocab)
+    for w in words:
+        if w not in vocab:
+            vocab[w] = len(vocab)
+    return vocab
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (ref imdb.py). Tokenized docs as int arrays; label
+    0=pos, 1=neg (reference convention). Vocabulary is built from the train
+    split with ``cutoff`` min frequency and a trailing UNK id."""
+
+    def __init__(self, data_file, mode="train", cutoff=150):
+        self.mode = mode
+        pat_doc = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        pat_train = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        tok = re.compile(r"[a-z]+")
+        counter = Counter()
+        docs_raw, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                is_train = pat_train.match(m.name)
+                is_doc = pat_doc.match(m.name)
+                if not (is_train or is_doc):
+                    continue
+                text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
+                words = tok.findall(text)
+                if is_train:
+                    counter.update(words)
+                if is_doc:
+                    docs_raw.append(words)
+                    labels.append(0 if is_doc.group(1) == "pos" else 1)
+        self.word_idx = _build_dict(counter, cutoff)
+        self.word_idx["<unk>"] = unk = len(self.word_idx)
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in d],
+                              np.int64) for d in docs_raw]
+        self.labels = np.array(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (ref imikolov.py). ``data_type='NGRAM'``
+    yields fixed windows, ``'SEQ'`` whole sentences with <s>/<e> marks."""
+
+    def __init__(self, data_file, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        member = {"train": "./simple-examples/data/ptb.train.txt",
+                  "test": "./simple-examples/data/ptb.valid.txt"}[mode]
+        counter = Counter()
+        with tarfile.open(data_file) as tf:
+            names = {m.name.lstrip("./"): m.name for m in tf.getmembers()}
+            train_lines = tf.extractfile(
+                names[member.lstrip("./")] if mode == "train"
+                else names["simple-examples/data/ptb.train.txt"]
+            ).read().decode().splitlines()
+            lines = (train_lines if mode == "train" else tf.extractfile(
+                names[member.lstrip("./")]).read().decode().splitlines())
+        for ln in train_lines:
+            counter.update(ln.split())
+        counter["<unk>"] = -1  # reference drops raw <unk> from the dict build
+        self.word_idx = _build_dict(counter, min_word_freq, extra=("<s>", "<e>"))
+        self.word_idx["<unk>"] = unk = len(self.word_idx)
+        s, e = self.word_idx["<s>"], self.word_idx["<e>"]
+        self.data = []
+        for ln in lines:
+            ids = [s] + [self.word_idx.get(w, unk) for w in ln.split()] + [e]
+            if data_type.upper() == "NGRAM":
+                if len(ids) >= window_size:
+                    for i in range(len(ids) - window_size + 1):
+                        self.data.append(np.array(ids[i:i + window_size],
+                                                  np.int64))
+            else:
+                self.data.append(np.array(ids, np.int64))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (ref uci_housing.py): 13 features
+    normalised by (x - mean) / (max - min) over the full table; first 80%
+    is train, rest test."""
+
+    def __init__(self, data_file, mode="train"):
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        feats = (feats - avg) / (mx - mn)
+        split = int(len(raw) * 0.8)
+        sl = slice(0, split) if mode == "train" else slice(split, None)
+        self.x, self.y = feats[sl], target[sl]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (ref movielens.py). Each item: (user_id, gender,
+    age_bucket, occupation, movie_id, category_ids, title_ids, rating)."""
+
+    def __init__(self, data_file, mode="train", test_ratio=0.1, rand_seed=0):
+        with zipfile.ZipFile(data_file) as zf:
+            base = next(n for n in zf.namelist() if n.endswith("ratings.dat"))
+            root = os.path.dirname(base)
+            users = zf.read(f"{root}/users.dat").decode("latin1").splitlines()
+            movies = zf.read(f"{root}/movies.dat").decode("latin1").splitlines()
+            ratings = zf.read(f"{root}/ratings.dat").decode("latin1").splitlines()
+        self.user_info, self.movie_info = {}, {}
+        cats, title_words = {}, {}
+        for ln in users:
+            uid, gender, age, job, _ = ln.split("::")
+            self.user_info[int(uid)] = (int(uid), 0 if gender == "M" else 1,
+                                        int(age), int(job))
+        for ln in movies:
+            mid, title, genres = ln.split("::")
+            cat_ids = [cats.setdefault(c, len(cats))
+                       for c in genres.strip().split("|")]
+            tw = [title_words.setdefault(w, len(title_words))
+                  for w in re.sub(r"\(\d{4}\)$", "", title).strip().lower().split()]
+            self.movie_info[int(mid)] = (int(mid), np.array(cat_ids, np.int64),
+                                         np.array(tw, np.int64))
+        self.max_movie_id = max(self.movie_info)
+        self.categories_dict, self.title_dict = cats, title_words
+        rng = np.random.RandomState(rand_seed)
+        rows = []
+        for ln in ratings:
+            uid, mid, rating, _ = ln.split("::")
+            if int(mid) not in self.movie_info:
+                continue
+            is_test = rng.rand() < test_ratio
+            if (mode == "test") == is_test:
+                rows.append((int(uid), int(mid), float(rating)))
+        self.rows = rows
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.rows[idx]
+        u = self.user_info[uid]
+        m = self.movie_info[mid]
+        return (*u, *m, np.float32(rating))
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (ref conll05.py). Parses the test-split tarball's
+    ``words``/``props`` gz streams into (sentence, predicate, labels)
+    triples with dicts built from the corpus."""
+
+    def __init__(self, data_file):
+        words_all, props_all = [], []
+        with tarfile.open(data_file) as tf:
+            wname = next(m.name for m in tf.getmembers()
+                         if m.name.endswith("words.gz"))
+            pname = next(m.name for m in tf.getmembers()
+                         if m.name.endswith("props.gz"))
+            words_txt = gzip.decompress(tf.extractfile(wname).read()).decode()
+            props_txt = gzip.decompress(tf.extractfile(pname).read()).decode()
+        sents = [s.split("\n") for s in words_txt.strip().split("\n\n")]
+        props = [[ln.split() for ln in s.split("\n")]
+                 for s in props_txt.strip().split("\n\n")]
+        wdict, ldict = {}, {}
+        self.samples = []
+        for sent, prop in zip(sents, props):
+            toks = [w.strip() for w in sent if w.strip()]
+            if not prop or not prop[0]:
+                continue
+            preds = [r[0] for r in prop]
+            n_frames = len(prop[0]) - 1
+            for f in range(n_frames):
+                tags = self._bio([r[1 + f] for r in prop])
+                pred_pos = next((i for i, p in enumerate(preds)
+                                 if p != "-" and tags[i].endswith("-V")), None)
+                if pred_pos is None:
+                    pred_pos = next(i for i, p in enumerate(preds) if p != "-")
+                wids = np.array([wdict.setdefault(w.lower(), len(wdict))
+                                 for w in toks], np.int64)
+                lids = np.array([ldict.setdefault(t, len(ldict))
+                                 for t in tags], np.int64)
+                self.samples.append((wids, np.int64(pred_pos), lids))
+        self.word_dict, self.label_dict = wdict, ldict
+
+    @staticmethod
+    def _bio(cols):
+        """Convert bracketed props column ((A0* ... *) style) to BIO tags."""
+        tags, stack = [], []
+        for c in cols:
+            opens = re.findall(r"\(([^*()]+)", c)
+            tag = "O"
+            if opens:
+                stack.append(opens[0])
+                tag = "B-" + opens[0]
+            elif stack:
+                tag = "I-" + stack[-1]
+            for _ in range(c.count(")")):
+                if stack:
+                    stack.pop()
+            tags.append(tag)
+        return tags
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+
+class _WMTBase(Dataset):
+    src_lang = "en"
+
+    def _finish(self, src_lines, trg_lines, src_dict_size, trg_dict_size=None):
+        trg_dict_size = trg_dict_size or src_dict_size
+        counter_src, counter_trg = Counter(), Counter()
+        pairs_raw = []
+        for s, t in zip(src_lines, trg_lines):
+            sw, tw = s.split(), t.split()
+            if not sw or not tw:
+                continue
+            counter_src.update(sw)
+            counter_trg.update(tw)
+            pairs_raw.append((sw, tw))
+        specials = ("<s>", "<e>", "<unk>")
+        def clip(counter, size):
+            vocab = {w: i for i, w in enumerate(specials)}
+            for w, _ in counter.most_common(max(size - len(specials), 0)):
+                vocab.setdefault(w, len(vocab))
+            return vocab
+        self.src_dict = clip(counter_src, src_dict_size)
+        self.trg_dict = clip(counter_trg, trg_dict_size)
+        s_id, e_id, unk = 0, 1, 2
+        self.pairs = []
+        for sw, tw in pairs_raw:
+            src = np.array([self.src_dict.get(w, unk) for w in sw], np.int64)
+            # reference yields (src, trg_with_<s>_prefix, trg_with_<e>_suffix)
+            trg_in = np.array([s_id] + [self.trg_dict.get(w, unk) for w in tw],
+                              np.int64)
+            trg_out = np.array([self.trg_dict.get(w, unk) for w in tw] + [e_id],
+                               np.int64)
+            self.pairs.append((src, trg_in, trg_out))
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def __getitem__(self, idx):
+        return self.pairs[idx]
+
+
+class WMT14(_WMTBase):
+    """WMT'14 en→fr (ref wmt14.py): reads the preprocessed dev+train tgz of
+    parallel ``\\t``-separated lines."""
+
+    def __init__(self, data_file, mode="train", dict_size=30000):
+        pat = {"train": "train/", "test": "test/", "gen": "gen/"}[mode]
+        src, trg = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if m.isfile() and pat in m.name:
+                    for ln in tf.extractfile(m).read().decode(
+                            "utf-8", "ignore").splitlines():
+                        cols = ln.split("\t")
+                        if len(cols) >= 2:
+                            src.append(cols[0])
+                            trg.append(cols[1])
+        self._finish(src, trg, dict_size)
+
+
+class WMT16(_WMTBase):
+    """WMT'16 en↔de multimodal (ref wmt16.py): tarball with
+    ``train/val/test`` split files per language."""
+
+    def __init__(self, data_file, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        split = {"train": "train", "val": "val", "test": "test"}[mode]
+        other = "de" if lang == "en" else "en"
+        with tarfile.open(data_file) as tf:
+            names = {m.name: m for m in tf.getmembers() if m.isfile()}
+            def find(suffix):
+                return next((n for n in names
+                             if n.endswith(f"{split}.{suffix}")), None)
+            sname, tname = find(lang), find(other)
+            if sname is None or tname is None:
+                raise FileNotFoundError(
+                    f"no {split}.{lang}/{split}.{other} members in {data_file}")
+            src = tf.extractfile(names[sname]).read().decode(
+                "utf-8", "ignore").splitlines()
+            trg = tf.extractfile(names[tname]).read().decode(
+                "utf-8", "ignore").splitlines()
+        self._finish(src, trg, src_dict_size, trg_dict_size)

@@ -1,0 +1,78 @@
+"""Viterbi decoding for linear-chain CRF tagging (ref:
+``python/paddle/text/viterbi_decode.py`` — ViterbiDecoder / viterbi_decode).
+
+The reference runs a custom CUDA kernel; here the forward DP and the
+backtrace are both single ``lax.scan``s, so the whole decode is one XLA
+program with [B, N, N] batched max-plus contractions on the vector unit.
+
+Semantics match the reference: with ``include_bos_eos_tag=True`` the last
+row of ``transitions`` is the start(BOS)->tag score and the second-to-last
+column is the tag->stop(EOS) score.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.module import Module
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True):
+    """Args: potentials [B, T, N] emission scores, transition_params [N, N],
+    lengths [B] int. Returns (scores [B], paths [B, T] int32; positions past
+    each sequence's length are 0)."""
+    pot = jnp.asarray(potentials)
+    trans = jnp.asarray(transition_params)
+    lengths = jnp.asarray(lengths)
+    b, t, n = pot.shape
+
+    alpha = pot[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[-1][None, :]
+
+    steps = jnp.arange(1, t)
+    emis = jnp.moveaxis(pot[:, 1:], 1, 0)  # [T-1, B, N]
+
+    def fwd(alpha, xs):
+        step, em = xs
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [B, N]
+        new_alpha = jnp.max(scores, axis=1) + em
+        active = (step < lengths)[:, None]
+        alpha = jnp.where(active, new_alpha, alpha)
+        # identity pointer on inactive steps keeps the backtrace a no-op there
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(n, dtype=jnp.int32)[None, :])
+        return alpha, best_prev
+
+    alpha, history = lax.scan(fwd, alpha, (steps, emis))  # history [T-1, B, N]
+
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, -2][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+
+    def back(tag, ptr):
+        prev = jnp.take_along_axis(ptr, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, rest = lax.scan(back, last_tag, history[::-1])  # [T-1, B]
+    paths = jnp.concatenate([rest[::-1], last_tag[None, :]], axis=0)  # [T, B]
+    paths = jnp.moveaxis(paths, 0, 1)  # [B, T]
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    return scores, jnp.where(valid, paths, 0)
+
+
+class ViterbiDecoder(Module):
+    """Layer wrapper (ref ViterbiDecoder): holds transitions, decodes batches."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True):
+        super().__init__()
+        self.transitions = jnp.asarray(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -17,7 +17,17 @@ import numpy as np
 
 from paddle_tpu.io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))  # HWC uint8
 
 
 def _read_idx_images(path):
@@ -121,3 +131,156 @@ class FakeData(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, label
+
+
+class DatasetFolder(Dataset):
+    """Ref: paddle.vision.datasets.DatasetFolder — ``root/class_x/img.ext``
+    directory scanner. Classes are sorted subdirectory names."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        valid = is_valid_file or (
+            lambda p: p.lower().endswith(exts))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    p = os.path.join(dirpath, fname)
+                    if valid(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"found no valid files under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(Dataset):
+    """Ref: paddle.vision.datasets.ImageFolder — flat (unlabelled) image list;
+    ``__getitem__`` returns ``[img]`` like the reference."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        valid = is_valid_file or (lambda p: p.lower().endswith(exts))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                p = os.path.join(dirpath, fname)
+                if valid(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(f"found no valid files under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+
+class Flowers(Dataset):
+    """Ref: paddle.vision.datasets.Flowers (Oxford 102). Reads the jpg
+    tarball + ``imagelabels.mat`` + ``setid.mat`` (scipy) from local files."""
+
+    _splits = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file, label_file, setid_file, mode="train",
+                 transform=None):
+        from scipy.io import loadmat
+        self.labels = loadmat(label_file)["labels"][0]
+        ids = loadmat(setid_file)[self._splits[mode]][0]
+        self.indexes = np.sort(ids)
+        self.transform = transform
+        self._tar_path = data_file
+        self._tar = None
+        with tarfile.open(data_file) as tf:
+            self._names = {os.path.basename(m.name): m.name
+                           for m in tf.getmembers() if m.isfile()}
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+        flower_id = int(self.indexes[idx])
+        if self._tar is None:  # lazy per-process open (worker-pool safe)
+            self._tar = tarfile.open(self._tar_path)
+        name = self._names[f"image_{flower_id:05d}.jpg"]
+        data = self._tar.extractfile(name).read()
+        img = np.asarray(Image.open(_io.BytesIO(data)).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        # raw 1-based label, matching the reference's .mat passthrough
+        return img, int(self.labels[flower_id - 1])
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_tar"] = None
+        return state
+
+
+class VOC2012(Dataset):
+    """Ref: paddle.vision.datasets.VOC2012 — segmentation pairs
+    (image, mask) from the VOCtrainval tar."""
+
+    _list = {"train": "ImageSets/Segmentation/train.txt",
+             "valid": "ImageSets/Segmentation/val.txt",
+             "trainval": "ImageSets/Segmentation/trainval.txt"}
+
+    def __init__(self, data_file, mode="train", transform=None):
+        self.transform = transform
+        self._tar_path = data_file
+        self._tar = None
+        with tarfile.open(data_file) as tf:
+            members = {m.name: m.name for m in tf.getmembers() if m.isfile()}
+            list_name = next(n for n in members
+                             if n.endswith(self._list[mode]))
+            names = tf.extractfile(list_name).read().decode().split()
+            root = list_name.split("ImageSets/")[0]
+        self.pairs = [(f"{root}JPEGImages/{n}.jpg",
+                       f"{root}SegmentationClass/{n}.png") for n in names]
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+        if self._tar is None:
+            self._tar = tarfile.open(self._tar_path)
+        ipath, mpath = self.pairs[idx]
+        img = np.asarray(Image.open(
+            _io.BytesIO(self._tar.extractfile(ipath).read())).convert("RGB"))
+        mask = np.asarray(Image.open(
+            _io.BytesIO(self._tar.extractfile(mpath).read())))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_tar"] = None
+        return state

@@ -1,0 +1,309 @@
+"""Tests for the round-1 gap-fill surface: pooling masks/unpool, full
+Transformer, RNN/BiRNN cell drivers, gather_tree, Viterbi decode,
+nan-reductions, as_strided, folder/text datasets."""
+import io
+import os
+import tarfile
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+# -- pooling with mask + unpool ---------------------------------------------
+
+def test_max_pool2d_return_mask_matches_torch():
+    import torch
+    x = np.random.RandomState(0).randn(2, 3, 8, 10).astype(np.float32)
+    out, mask = F.max_pool2d(jnp.asarray(x), 2, stride=2, return_mask=True)
+    t_out, t_idx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mask), t_idx.numpy())
+
+
+def test_max_pool2d_return_mask_padded():
+    import torch
+    x = np.random.RandomState(1).randn(1, 2, 7, 7).astype(np.float32)
+    out, mask = F.max_pool2d(jnp.asarray(x), 3, stride=2, padding=1,
+                             return_mask=True)
+    t_out, t_idx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 3, stride=2, padding=1, return_indices=True)
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mask), t_idx.numpy())
+
+
+def test_max_unpool2d_roundtrip():
+    import torch
+    x = np.random.RandomState(2).randn(2, 2, 6, 6).astype(np.float32)
+    out, mask = F.max_pool2d(jnp.asarray(x), 2, return_mask=True)
+    up = F.max_unpool2d(out, mask, 2)
+    t_out, t_idx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, return_indices=True)
+    t_up = torch.nn.functional.max_unpool2d(t_out, t_idx, 2)
+    np.testing.assert_allclose(np.asarray(up), t_up.numpy(), rtol=1e-6)
+
+
+def test_max_unpool1d_and_layers():
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 3, 8).astype(np.float32))
+    pool = nn.MaxPool1D(2, return_mask=True)
+    out, mask = pool(x)
+    up = nn.MaxUnPool1D(2)(out, mask)
+    assert up.shape == x.shape
+    # every kept value appears at its original position
+    np.testing.assert_allclose(np.asarray(up).max(-1), np.asarray(out).max(-1))
+
+
+def test_max_pool3d_and_unpool3d():
+    x = jnp.asarray(np.random.RandomState(4).randn(1, 2, 4, 4, 4).astype(np.float32))
+    out, mask = F.max_pool3d(x, 2, return_mask=True)
+    assert out.shape == (1, 2, 2, 2, 2)
+    up = F.max_unpool3d(out, mask, 2)
+    assert up.shape == x.shape
+    np.testing.assert_allclose(np.asarray(up).sum(), np.asarray(out).sum(), rtol=1e-5)
+
+
+# -- transformer / rnn -------------------------------------------------------
+
+def test_full_transformer_forward():
+    m = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                       num_decoder_layers=2, dim_feedforward=32)
+    m.eval()
+    src = jnp.asarray(np.random.RandomState(0).randn(2, 5, 16), jnp.float32)
+    tgt = jnp.asarray(np.random.RandomState(1).randn(2, 4, 16), jnp.float32)
+    out = m(src, tgt)
+    assert out.shape == (2, 4, 16)
+    mask = nn.Transformer.generate_square_subsequent_mask(4)
+    assert mask.shape == (4, 4) and np.isneginf(np.asarray(mask)[0, 1])
+    out2 = m(src, tgt, tgt_mask=mask)
+    assert out2.shape == (2, 4, 16)
+
+
+def test_rnn_wrapper_matches_manual_scan():
+    cell = nn.LSTMCell(4, 6)
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 5, 4), jnp.float32)
+    out, (h, c) = nn.RNN(cell)(x)
+    assert out.shape == (3, 5, 6) and h.shape == (3, 6)
+    # manual unroll
+    hh = jnp.zeros((3, 6)); cc = jnp.zeros((3, 6))
+    for t in range(5):
+        o, (hh, cc) = cell(x[:, t], (hh, cc))
+    np.testing.assert_allclose(np.asarray(out[:, -1]), np.asarray(o), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(hh), rtol=1e-5)
+
+
+def test_birnn_concat_shapes():
+    fw, bw = nn.GRUCell(4, 5), nn.GRUCell(4, 5)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 7, 4), jnp.float32)
+    out, (hf, hb) = nn.BiRNN(fw, bw)(x)
+    assert out.shape == (2, 7, 10)
+    # reverse branch equals running the reversed sequence forward
+    out_r, hr = nn.RNN(bw)(x[:, ::-1])
+    np.testing.assert_allclose(np.asarray(out[:, :, 5:]),
+                               np.asarray(out_r[:, ::-1]), rtol=1e-5)
+
+
+# -- beam utils / viterbi ----------------------------------------------------
+
+def test_gather_tree():
+    ids = jnp.asarray([[[2, 5]], [[6, 1]], [[3, 9]]])       # [T=3, B=1, beam=2]
+    parents = jnp.asarray([[[0, 0]], [[1, 0]], [[0, 1]]])
+    out = np.asarray(F.gather_tree(ids, parents))
+    # beam 0 at t=2 came from parent 0 (t=1) which came from parent 1 (t=0)
+    assert out[:, 0, 0].tolist() == [5, 6, 3]
+    assert out[:, 0, 1].tolist() == [2, 1, 9]
+
+
+def _brute_viterbi(pot, trans, length, bos_eos):
+    import itertools
+    n = pot.shape[-1]
+    best, path = -np.inf, None
+    for seq in itertools.product(range(n), repeat=length):
+        s = pot[0, seq[0]] + (trans[-1, seq[0]] if bos_eos else 0)
+        for t in range(1, length):
+            s += trans[seq[t - 1], seq[t]] + pot[t, seq[t]]
+        if bos_eos:
+            s += trans[seq[length - 1], -2]
+        if s > best:
+            best, path = s, seq
+    return best, list(path)
+
+
+@pytest.mark.parametrize("bos_eos", [True, False])
+def test_viterbi_decode_matches_bruteforce(bos_eos):
+    from paddle_tpu.text import viterbi_decode
+    rs = np.random.RandomState(0)
+    pot = rs.randn(2, 4, 3).astype(np.float32)
+    trans = rs.randn(3, 3).astype(np.float32)
+    lengths = np.array([4, 2])
+    scores, paths = viterbi_decode(pot, trans, lengths, bos_eos)
+    for b in range(2):
+        s, p = _brute_viterbi(pot[b], trans, int(lengths[b]), bos_eos)
+        assert abs(float(scores[b]) - s) < 1e-4
+        assert np.asarray(paths)[b, :lengths[b]].tolist() == p
+        assert np.all(np.asarray(paths)[b, lengths[b]:] == 0)
+
+
+def test_viterbi_decoder_layer():
+    from paddle_tpu.text import ViterbiDecoder
+    dec = ViterbiDecoder(np.eye(3, dtype=np.float32))
+    scores, paths = dec(np.zeros((1, 3, 3), np.float32), np.array([3]))
+    assert paths.shape == (1, 3)
+
+
+# -- tensor gap-fill ---------------------------------------------------------
+
+def test_nanmedian_nanquantile():
+    x = np.array([[1.0, np.nan, 3.0], [np.nan, 5.0, 7.0]], np.float32)
+    np.testing.assert_allclose(np.asarray(pt.nanmedian(jnp.asarray(x))),
+                               np.nanmedian(x))
+    np.testing.assert_allclose(
+        np.asarray(pt.nanquantile(jnp.asarray(x), 0.5, axis=1)),
+        np.nanquantile(x, 0.5, axis=1))
+
+
+def test_as_strided():
+    x = jnp.arange(12.0)
+    out = pt.as_strided(x, [3, 4], [4, 1])
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(12.0).reshape(3, 4))
+    # overlapping windows
+    win = pt.as_strided(x, [5, 3], [2, 1])
+    expect = np.lib.stride_tricks.as_strided(
+        np.arange(12.0), (5, 3), (16, 8))
+    np.testing.assert_array_equal(np.asarray(win), expect)
+
+
+# -- datasets ----------------------------------------------------------------
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    from PIL import Image
+    for cls in ["cat", "dog"]:
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(
+                np.full((4, 4, 3), 100 + i, np.uint8)).save(d / f"{i}.png")
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert len(ds) == 4 and ds.classes == ["cat", "dog"]
+    img, label = ds[0]
+    assert img.shape == (4, 4, 3) and label == 0
+    ifo = ImageFolder(str(tmp_path / "root"))
+    assert len(ifo) == 4 and ifo[0][0].shape == (4, 4, 3)
+
+
+def test_uci_housing(tmp_path):
+    rs = np.random.RandomState(0)
+    data = rs.rand(50, 14)
+    path = tmp_path / "housing.data"
+    np.savetxt(path, data)
+    from paddle_tpu.text.datasets import UCIHousing
+    tr = UCIHousing(str(path), mode="train")
+    te = UCIHousing(str(path), mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_imdb(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for split in ["train", "test"]:
+            for sent, docs in [("pos", ["a great movie", "great fun film"]),
+                               ("neg", ["terrible boring movie", "awful bad"])]:
+                for i, text in enumerate(docs):
+                    data = text.encode()
+                    info = tarfile.TarInfo(f"aclImdb/{split}/{sent}/{i}.txt")
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+    p = tmp_path / "aclImdb_v1.tar.gz"
+    p.write_bytes(buf.getvalue())
+    from paddle_tpu.text.datasets import Imdb
+    ds = Imdb(str(p), mode="train", cutoff=1)
+    assert len(ds) == 4
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert "great" in ds.word_idx
+
+
+def test_imikolov(tmp_path):
+    buf = io.BytesIO()
+    text = "\n".join(["the quick fox", "the lazy dog", "the quick dog"])
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name in ["ptb.train.txt", "ptb.valid.txt"]:
+            data = text.encode()
+            info = tarfile.TarInfo(f"./simple-examples/data/{name}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    p = tmp_path / "simple-examples.tgz"
+    p.write_bytes(buf.getvalue())
+    from paddle_tpu.text.datasets import Imikolov
+    ds = Imikolov(str(p), data_type="NGRAM", window_size=3, mode="train",
+                  min_word_freq=1)
+    assert len(ds) > 0 and ds[0].shape == (3,)
+    seq = Imikolov(str(p), data_type="SEQ", mode="test", min_word_freq=1)
+    assert seq[0][0] == seq.word_idx["<s>"]
+
+
+def test_movielens(tmp_path):
+    p = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("ml-1m/users.dat", "1::M::25::4::90210\n2::F::35::7::10001\n")
+        zf.writestr("ml-1m/movies.dat",
+                    "10::Toy Story (1995)::Animation|Comedy\n"
+                    "20::Heat (1995)::Action\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::10::5::978300760\n2::20::3::978300761\n"
+                    "1::20::4::978300762\n")
+    from paddle_tpu.text.datasets import Movielens
+    ds = Movielens(str(p), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    item = ds[0]
+    assert item[-1] in (3.0, 4.0, 5.0)
+
+
+def test_wmt16(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, lines in [("wmt16/train.en", "a b c\nd e f\n"),
+                            ("wmt16/train.de", "x y\nz w\n")]:
+            data = lines.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    p = tmp_path / "wmt16.tar.gz"
+    p.write_bytes(buf.getvalue())
+    from paddle_tpu.text.datasets import WMT16
+    ds = WMT16(str(p), mode="train")
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    assert trg_in[0] == 0 and trg_out[-1] == 1  # <s> prefix / <e> suffix
+
+
+def test_conll05st(tmp_path):
+    import gzip as _gz
+    words = "The\ncat\nsat\n\nDogs\nbark\n"
+    props = "-\t*\nsit\t(V*)\n-\t*\n\nbark\t(V*)\n-\t*\n"
+    props = props.replace("\t", " ")
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, text in [("conll05st/test.wsj.words.gz", words),
+                           ("conll05st/test.wsj.props.gz", props)]:
+            data = _gz.compress(text.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    p = tmp_path / "conll05st.tar.gz"
+    p.write_bytes(buf.getvalue())
+    from paddle_tpu.text.datasets import Conll05st
+    ds = Conll05st(str(p))
+    assert len(ds) == 2
+    wids, pred, lids = ds[0]
+    assert wids.shape == (3,) and lids.shape == (3,)
