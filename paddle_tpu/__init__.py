@@ -41,7 +41,13 @@ from paddle_tpu.core.random import RngStream, next_key, seed
 from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
 from paddle_tpu.tensor import *  # noqa: F401,F403
 from paddle_tpu import jit as jit_module
-from paddle_tpu.jit import to_static, no_grad, grad
+from paddle_tpu.jit import (
+    to_static,
+    no_grad,
+    grad,
+    set_grad_enabled,
+    is_grad_enabled,
+)
 from paddle_tpu.train.checkpoint import load, save
 
 jit = jit_module.jit

@@ -78,3 +78,73 @@ def axis_index(axis_name: str):
 def barrier(*, axis_name: str):
     """Collectives are compiler-ordered on TPU; a psum serves as sync point."""
     return lax.psum(jnp.zeros((), jnp.float32), axis_name)
+
+
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM, *, axis_name: str):
+    """Reduce to member ``dst`` (ref communication/reduce.py). Other members
+    get their input back unchanged — on TPU the all-reduce already rode ICI;
+    masking to dst would only add work, so this is all_reduce + select."""
+    red = all_reduce(x, op, axis_name=axis_name)
+    return jnp.where(lax.axis_index(axis_name) == dst, red, x)
+
+
+def scatter(x, src: int = 0, *, axis_name: str):
+    """Member ``src``'s value, split over the axis: member i receives the
+    i-th chunk of src's leading dim (ref communication/scatter.py)."""
+    full = broadcast(x, src, axis_name=axis_name)
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    if full.shape[0] % n != 0:
+        raise ValueError(
+            f"scatter: leading dim {full.shape[0]} must divide evenly over "
+            f"{n} members (reference scatter requires an exact split)")
+    chunk = full.shape[0] // n
+    return lax.dynamic_slice_in_dim(full, i * chunk, chunk, axis=0)
+
+
+def gather(x, dst: int = 0, *, axis_name: str, axis: int = 0):
+    """All members' values concatenated; valid on every member (TPU
+    collectives are SPMD — restricting to dst would not save ICI traffic)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def _p2p_edge(x, src: int, dst: int, axis_name: str):
+    out = lax.ppermute(x, axis_name, [(src, dst)])
+    return jnp.where(lax.axis_index(axis_name) == dst, out, x)
+
+
+def send(x, dst: int, *, src: int, axis_name: str):
+    """P2P send (ref communication/send.py). SPMD note: the reference calls
+    send on one rank and recv on another; under XLA every member traces the
+    same program, so both endpoints must be static — ``send``/``recv`` are
+    two names for the same single-edge ppermute. Member ``dst`` receives
+    ``src``'s value; everyone else keeps their input."""
+    return _p2p_edge(x, src, dst, axis_name)
+
+
+def recv(x, src: int, *, dst: int, axis_name: str):
+    """P2P receive — see ``send``."""
+    return _p2p_edge(x, src, dst, axis_name)
+
+
+def all_gather_object(obj, group=None):
+    """Gather arbitrary picklable objects across hosts (ref
+    communication/all_gather.py:all_gather_object). Host-side (not traced):
+    single-process returns [obj]; multi-host pickles into padded uint8
+    arrays and rides ``multihost_utils.process_allgather``."""
+    import pickle
+
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(pickle.dumps(obj), np.uint8)
+    n = np.asarray([data.size], np.int64)
+    sizes = multihost_utils.process_allgather(n)
+    cap = int(sizes.max())
+    padded = np.zeros(cap, np.uint8)
+    padded[:data.size] = data
+    gathered = multihost_utils.process_allgather(padded)
+    return [pickle.loads(gathered[i, :int(sizes[i])].tobytes())
+            for i in range(gathered.shape[0])]

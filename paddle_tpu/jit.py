@@ -48,6 +48,25 @@ def no_grad(fn: Callable = None):
     return wrapped
 
 
+def set_grad_enabled(mode: bool):
+    """Ref: ``paddle.set_grad_enabled`` context manager. Autodiff here is a
+    functional transform (``jax.grad`` traces on demand), so there is no
+    global tape to switch off — with mode=False this marks intent only; use
+    ``no_grad``/``stop_gradient`` to actually cut gradients at a value."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        yield
+    return ctx()
+
+
+def is_grad_enabled() -> bool:
+    """Ref: ``paddle.is_grad_enabled`` — gradients are always available to
+    a ``jax.grad`` trace; values opt out via stop_gradient."""
+    return True
+
+
 def grad(fn: Callable, argnums=0, has_aux: bool = False) -> Callable:
     """Ref: ``paddle.grad`` — functional gradient transform."""
     return jax.grad(fn, argnums=argnums, has_aux=has_aux)

@@ -1126,3 +1126,79 @@ def gather_tree(ids, parents):
                             ids.shape[1:])
     _, outs = lax.scan(step, init, (ids[::-1], parents[::-1]))
     return outs[::-1]
+
+
+# -- extra losses (ref functional/loss.py) -----------------------------------
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Ref loss.py:dice_loss — input is post-softmax probs [N, ..., C],
+    label int [N, ..., 1]."""
+    label = jnp.squeeze(label, axis=-1)
+    one_hot = jax.nn.one_hot(label, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * one_hot, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(one_hot, axis=reduce_dims)
+    dice = (2.0 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    """Ref loss.py:log_loss — binary cross entropy on probabilities."""
+    return (-label * jnp.log(input + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Ref loss.py:npair_loss — softmax cross entropy over the anchor x
+    positive similarity matrix plus an L2 pull on the embeddings."""
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                    + jnp.mean(jnp.sum(positive * positive, axis=1))) * 0.25
+    sim = anchor @ positive.T  # [B, B]
+    labels = labels.reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    targets = same / jnp.sum(same, axis=1, keepdims=True)
+    ce = -jnp.sum(targets * jax.nn.log_softmax(sim, axis=1), axis=1)
+    return jnp.mean(ce) + reg
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM temporal shift (ref phi temporal_shift kernel): within each clip
+    of ``seg_num`` frames, the first ``shift_ratio`` of channels shift one
+    frame back, the next block one frame forward. Pure slicing/padding —
+    XLA fuses it into the surrounding convs."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate([xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(xr[:, :1, c1:c2]), xr[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([back, fwd, xr[:, :, c2:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (ref loss.py:margin_cross_entropy):
+    cos(m1*theta + m2) - m3 on the target class, then scaled CE. For the
+    tensor-parallel sharded-classes variant use
+    ``paddle_tpu.distributed.tensor_parallel.parallel_cross_entropy``."""
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    one_hot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    target_cos = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(one_hot > 0, target_cos, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(one_hot * logp, axis=-1)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss

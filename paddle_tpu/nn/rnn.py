@@ -132,14 +132,18 @@ class _RNNBase(Module):
 
 
 def _cell_step(cell, xt, state):
-    """Uniform (h, new_state) protocol over our cell classes."""
-    if isinstance(cell, LSTMCell):
-        return cell(xt, state)
+    """Uniform (h, new_state) protocol: a cell may return either the new
+    state alone (SimpleRNN/GRU convention) or an (outputs, new_states)
+    pair (LSTMCell and the reference's RNNCellBase contract)."""
     out = cell(xt, state)
+    if isinstance(out, tuple) and len(out) == 2:
+        return out
     return out, out
 
 
 def _cell_zero_state(cell, batch, dtype):
+    if hasattr(cell, "get_initial_states"):
+        return cell.get_initial_states(batch, dtype)
     h = jnp.zeros((batch, cell.hidden_size), dtype)
     return (h, jnp.zeros_like(h)) if isinstance(cell, LSTMCell) else h
 

@@ -575,6 +575,249 @@ class Adafactor(Optimizer):
         return get(0), new_state
 
 
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (ref nadam.py). Tracks the running
+    product of the momentum-decay schedule mu_t in the state."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.momentum_decay = momentum_decay
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment1": _map_params(z, params),
+                "moment2": _map_params(z, params),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _update(self, params, grads, state, lr):
+        b1, b2, eps, psi = self.beta1, self.beta2, self.epsilon, self.momentum_decay
+        t = state["step"].astype(jnp.float32) + 1.0
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * psi))
+        mu_next = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1.0) * psi))
+        mu_prod = state["mu_product"] * mu_t
+        mu_prod_next = mu_prod * mu_next
+        bc2 = 1.0 - b2 ** t
+
+        def upd(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                g32 = g32 + self.weight_decay * p32
+            m_new = b1 * m + (1 - b1) * g32
+            v_new = b2 * v + (1 - b2) * g32 * g32
+            m_hat = (mu_next * m_new / (1.0 - mu_prod_next)
+                     + (1.0 - mu_t) * g32 / (1.0 - mu_prod))
+            p_new = p32 - lr * m_hat / (jnp.sqrt(v_new / bc2) + eps)
+            return p_new.astype(p.dtype), m_new, v_new
+
+        pairs = _map_params(upd, params, grads, state["moment1"], state["moment2"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "moment1": get(1), "moment2": get(2),
+                        "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (ref radam.py): falls back to un-adapted momentum
+    while the variance estimate is unreliable (rho_t <= 5); the branch is a
+    traced ``where``, so the whole schedule stays one compiled program."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {"moment1": _map_params(z, params),
+                "moment2": _map_params(z, params)}
+
+    def _update(self, params, grads, state, lr):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = state["step"].astype(jnp.float32) + 1.0
+        bc1 = 1.0 - b1 ** t
+        bc2 = 1.0 - b2 ** t
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / bc2
+        rect = jnp.sqrt(jnp.clip(
+            ((rho_t - 4.0) * (rho_t - 2.0) * rho_inf)
+            / jnp.maximum((rho_inf - 4.0) * (rho_inf - 2.0) * rho_t, eps),
+            0.0))
+        use_rect = rho_t > 5.0
+
+        def upd(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                g32 = g32 + self.weight_decay * p32
+            m_new = b1 * m + (1 - b1) * g32
+            v_new = b2 * v + (1 - b2) * g32 * g32
+            m_hat = m_new / bc1
+            adapted = rect * m_hat / (jnp.sqrt(v_new / bc2) + eps)
+            p_new = p32 - lr * jnp.where(use_rect, adapted, m_hat)
+            return p_new.astype(p.dtype), m_new, v_new
+
+        pairs = _map_params(upd, params, grads, state["moment1"], state["moment2"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "moment1": get(1), "moment2": get(2)}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (ref asgd.py): keeps the last
+    ``batch_num`` per-parameter gradients and steps with their mean. The
+    history lives in a stacked leading axis; the rotating write is a
+    ``dynamic_update_slice`` so it stays jit-compatible."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, **kw):
+        super().__init__(learning_rate, **kw)
+        self.batch_num = batch_num
+
+    def _init_slots(self, params):
+        n = self.batch_num
+        return {"d": _map_params(
+                    lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+                "ys": _map_params(
+                    lambda p: jnp.zeros((n,) + p.shape, jnp.float32), params)}
+
+    def _update(self, params, grads, state, lr):
+        n = self.batch_num
+        idx = state["step"] % n
+
+        def upd(p, g, d, ys):
+            g32 = g.astype(jnp.float32)
+            if self.weight_decay:
+                g32 = g32 + self.weight_decay * p.astype(jnp.float32)
+            y_old = jax.lax.dynamic_index_in_dim(ys, idx, 0, keepdims=False)
+            d_new = d - y_old + g32
+            ys_new = jax.lax.dynamic_update_index_in_dim(ys, g32, idx, 0)
+            p_new = p.astype(jnp.float32) - lr * d_new / n
+            return p_new.astype(p.dtype), d_new, ys_new
+
+        pairs = _map_params(upd, params, grads, state["d"], state["ys"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "d": get(1), "ys": get(2)}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (ref rprop.py): per-element step sizes grown by
+    ``eta+`` on sign agreement, shrunk by ``eta-`` on sign flip (update
+    suppressed on flips). Full-batch method — sign logic is elementwise
+    ``where``s, one fused XLA kernel per param."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 etas=(0.5, 1.2), **kw):
+        super().__init__(learning_rate, **kw)
+        self.lr_min, self.lr_max = learning_rate_range
+        self.eta_minus, self.eta_plus = etas
+
+    def _init_slots(self, params):
+        lr0 = self.learning_rate if not isinstance(self.learning_rate, LRScheduler) \
+            else self.learning_rate.get_lr()
+        return {"prev_grad": _map_params(
+                    lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+                "step_size": _map_params(
+                    lambda p: jnp.full_like(p, lr0, dtype=jnp.float32), params)}
+
+    def _update(self, params, grads, state, lr):
+        def upd(p, g, gp, sz):
+            g32 = g.astype(jnp.float32)
+            sign = jnp.sign(g32 * gp)
+            sz_new = jnp.clip(
+                jnp.where(sign > 0, sz * self.eta_plus,
+                          jnp.where(sign < 0, sz * self.eta_minus, sz)),
+                self.lr_min, self.lr_max)
+            g_eff = jnp.where(sign < 0, 0.0, g32)
+            p_new = p.astype(jnp.float32) - jnp.sign(g_eff) * sz_new
+            return p_new.astype(p.dtype), g_eff, sz_new
+
+        pairs = _map_params(upd, params, grads, state["prev_grad"], state["step_size"])
+        get = lambda i: _pluck(pairs, i)
+        return get(0), {**state, "prev_grad": get(1), "step_size": get(2)}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (ref lbfgs.py). Like the reference, an eager
+    full-batch optimizer driven by a closure: ``minimize(loss_fn, module,
+    *args)`` runs ``max_iter`` two-loop-recursion steps with Armijo
+    backtracking line search. Params are flattened to one vector
+    (``ravel_pytree``) so history is [m, n] — the value/grad evaluations
+    are jitted; the tiny history algebra runs on host."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, history_size=10,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 line_search_fn="armijo", **kw):
+        super().__init__(learning_rate, **kw)
+        self.max_iter = max_iter
+        self.history_size = history_size
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.line_search_fn = line_search_fn
+
+    def minimize(self, loss_fn, module, *args):
+        from jax.flatten_util import ravel_pytree
+        params, static = partition_trainable(module)
+        x, unravel = ravel_pytree(
+            _tree_map(lambda p: jnp.asarray(p, jnp.float32)
+                      if p is not None and hasattr(p, "dtype") else p, params))
+
+        def f(xv):
+            from paddle_tpu.core.module import combine
+            mod = combine(unravel(xv), static)
+            return loss_fn(mod, *args)
+
+        vg = jax.jit(jax.value_and_grad(f))
+        loss, g = vg(x)
+        s_hist, y_hist = [], []
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tolerance_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in reversed(list(zip(s_hist, y_hist))):
+                rho = 1.0 / jnp.vdot(y, s)
+                a = rho * jnp.vdot(s, q)
+                q = q - a * y
+                alphas.append((a, rho))
+            if s_hist:
+                s, y = s_hist[-1], y_hist[-1]
+                gamma = jnp.vdot(s, y) / jnp.vdot(y, y)
+                q = gamma * q
+            for (a, rho), (s, y) in zip(reversed(alphas), zip(s_hist, y_hist)):
+                b = rho * jnp.vdot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            # Armijo backtracking
+            t = float(self.learning_rate) if not isinstance(
+                self.learning_rate, LRScheduler) else self.learning_rate.get_lr()
+            gtd = float(jnp.vdot(g, d))
+            for _ls in range(20):
+                new_loss, new_g = vg(x + t * d)
+                if float(new_loss) <= float(loss) + 1e-4 * t * gtd:
+                    break
+                t *= 0.5
+            s_vec = t * d
+            y_vec = new_g - g
+            if float(jnp.max(jnp.abs(s_vec))) <= self.tolerance_change:
+                x, loss, g = x + s_vec, new_loss, new_g
+                break
+            if float(jnp.vdot(s_vec, y_vec)) > 1e-10:
+                s_hist.append(s_vec)
+                y_hist.append(y_vec)
+                if len(s_hist) > self.history_size:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+            x, loss, g = x + s_vec, new_loss, new_g
+        from paddle_tpu.core.module import combine
+        new_params = unravel(x)
+        cast = _tree_map(
+            lambda p0, p: p.astype(p0.dtype)
+            if p0 is not None and hasattr(p0, "dtype") else p0,
+            params, new_params)
+        return loss, combine(cast, static)
+
+
 # -- incubate extras (ref python/paddle/incubate/optimizer/) -----------------
 
 class LookAhead(Optimizer):

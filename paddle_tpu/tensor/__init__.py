@@ -262,6 +262,16 @@ def quantile(x, q, axis=None, keepdim=False):
     return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
 
 
+def iinfo(dtype):
+    """Ref: paddle.iinfo — integer dtype limits."""
+    return jnp.iinfo(dtype)
+
+
+def finfo(dtype):
+    """Ref: paddle.finfo — float dtype limits."""
+    return jnp.finfo(dtype)
+
+
 def nanmedian(x, axis=None, keepdim=False):
     return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
 

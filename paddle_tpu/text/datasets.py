@@ -232,16 +232,20 @@ class Conll05st(Dataset):
 
     @staticmethod
     def _bio(cols):
-        """Convert bracketed props column ((A0* ... *) style) to BIO tags."""
+        """Convert bracketed props column ((A0* ... *) style) to BIO tags.
+        Tokens may open several nested spans (e.g. ``(A1(V*)``) — all are
+        pushed; the innermost (last-opened) names the B- tag, and each
+        ``)`` pops one level."""
         tags, stack = [], []
         for c in cols:
             opens = re.findall(r"\(([^*()]+)", c)
-            tag = "O"
             if opens:
-                stack.append(opens[0])
-                tag = "B-" + opens[0]
+                stack.extend(opens)
+                tag = "B-" + opens[-1]
             elif stack:
                 tag = "I-" + stack[-1]
+            else:
+                tag = "O"
             for _ in range(c.count(")")):
                 if stack:
                     stack.pop()

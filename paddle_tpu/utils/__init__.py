@@ -6,3 +6,4 @@ from paddle_tpu.utils.profiler import (
     record_event,
 )
 from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip, check_finite
+from paddle_tpu.utils import dlpack

@@ -1,0 +1,23 @@
+"""DLPack interchange (ref: ``python/paddle/utils/dlpack.py``).
+
+Zero-copy tensor exchange with other frameworks on the same host. JAX
+arrays implement the DLPack protocol natively; these wrappers keep the
+reference's entry-point names.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def to_dlpack(x):
+    """Export a paddle_tpu (jax) array as a DLPack capsule."""
+    x = jnp.asarray(x)
+    return x.__dlpack__()
+
+
+def from_dlpack(capsule_or_tensor):
+    """Import from a DLPack capsule or any object with ``__dlpack__``
+    (torch tensor, numpy array, ...). Device placement follows the
+    producer; TPU-backed consumers should ``jax.device_put`` after."""
+    return jax.dlpack.from_dlpack(capsule_or_tensor)

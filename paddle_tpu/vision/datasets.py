@@ -30,6 +30,17 @@ def _pil_loader(path):
         return np.asarray(img.convert("RGB"))  # HWC uint8
 
 
+def _scan_files(root, valid):
+    """Sorted recursive scan of files under ``root`` passing ``valid``."""
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            p = os.path.join(dirpath, fname)
+            if valid(p):
+                out.append(p)
+    return out
+
+
 def _read_idx_images(path):
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rb") as f:
@@ -151,12 +162,8 @@ class DatasetFolder(Dataset):
             lambda p: p.lower().endswith(exts))
         self.samples = []
         for c in classes:
-            cdir = os.path.join(root, c)
-            for dirpath, _, files in sorted(os.walk(cdir)):
-                for fname in sorted(files):
-                    p = os.path.join(dirpath, fname)
-                    if valid(p):
-                        self.samples.append((p, self.class_to_idx[c]))
+            for p in _scan_files(os.path.join(root, c), valid):
+                self.samples.append((p, self.class_to_idx[c]))
         if not self.samples:
             raise RuntimeError(f"found no valid files under {root}")
 
@@ -181,12 +188,7 @@ class ImageFolder(Dataset):
         self.transform = transform
         exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
         valid = is_valid_file or (lambda p: p.lower().endswith(exts))
-        self.samples = []
-        for dirpath, _, files in sorted(os.walk(root)):
-            for fname in sorted(files):
-                p = os.path.join(dirpath, fname)
-                if valid(p):
-                    self.samples.append(p)
+        self.samples = _scan_files(root, valid)
         if not self.samples:
             raise RuntimeError(f"found no valid files under {root}")
 
@@ -255,8 +257,8 @@ class VOC2012(Dataset):
         self._tar_path = data_file
         self._tar = None
         with tarfile.open(data_file) as tf:
-            members = {m.name: m.name for m in tf.getmembers() if m.isfile()}
-            list_name = next(n for n in members
+            names = [m.name for m in tf.getmembers() if m.isfile()]
+            list_name = next(n for n in names
                              if n.endswith(self._list[mode]))
             names = tf.extractfile(list_name).read().decode().split()
             root = list_name.split("ImageSets/")[0]

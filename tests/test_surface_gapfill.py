@@ -307,3 +307,11 @@ def test_conll05st(tmp_path):
     assert len(ds) == 2
     wids, pred, lids = ds[0]
     assert wids.shape == (3,) and lids.shape == (3,)
+
+
+def test_conll05_bio_nested_brackets():
+    from paddle_tpu.text.datasets import Conll05st
+    # token opening two spans: B- names the innermost, ')' pops one level
+    assert Conll05st._bio(['(A1(V*)', '*', '*)']) == ['B-V', 'I-A1', 'I-A1']
+    assert Conll05st._bio(['(A0*)', '(V*)', '(A1*', '*)']) == \
+        ['B-A0', 'B-V', 'B-A1', 'I-A1']
