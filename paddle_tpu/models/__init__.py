@@ -17,3 +17,6 @@ from paddle_tpu.models.resnet import (
 )
 from paddle_tpu.models.conformer import (ConformerConfig, ConformerEncoder,
                                          ConformerForCTC)
+from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM, MistralModel
+from paddle_tpu.models.qwen import Qwen2Config, Qwen2ForCausalLM, Qwen2Model
+from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration

@@ -40,16 +40,20 @@ jax.tree_util.register_pytree_node(
     lambda aux, ch: KVCache(*ch))
 
 
-def _attend_with_cache(q, k_cache, v_cache, cur_len, new_k, new_v, pos):
-    """Write new_k/new_v at pos, attend q over cache[:pos+new]."""
+def _attend_with_cache(q, k_cache, v_cache, cur_len, new_k, new_v, pos,
+                       window=None):
+    """Write new_k/new_v at pos, attend q over cache[:pos+new]. ``window``
+    keeps decode consistent with sliding-window training (Mistral)."""
     k_cache = lax.dynamic_update_slice_in_dim(k_cache, new_k, pos, axis=1)
     v_cache = lax.dynamic_update_slice_in_dim(v_cache, new_v, pos, axis=1)
     sq = q.shape[1]
-    total = pos + sq
-    # mask: key index must be <= query absolute position
+    # mask: key index must be <= query absolute position (and in-window)
     key_idx = jnp.arange(k_cache.shape[1])[None, :]
     q_idx = pos + jnp.arange(sq)[:, None]
-    mask = (key_idx <= q_idx)[None, None]  # [1,1,Sq,Smax]
+    keep = key_idx <= q_idx
+    if window is not None:
+        keep &= (q_idx - key_idx) < window
+    mask = keep[None, None]  # [1,1,Sq,Smax]
     out = A.xla_attention(q, k_cache, v_cache, attn_mask=mask)
     return out, k_cache, v_cache
 
@@ -68,13 +72,17 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
         b, s, _ = h.shape
         att = lyr.self_attn
         qkv = h @ att.qkv_proj
+        if getattr(att, "qkv_bias", None) is not None:  # Qwen2
+            qkv = qkv + att.qkv_bias
         nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
         q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
         q = A.apply_rope(q.reshape(b, s, nh, hd), cos, sin)
         k = A.apply_rope(k.reshape(b, s, nkv, hd), cos, sin)
         v = v.reshape(b, s, nkv, hd)
         out, k_c, v_c = _attend_with_cache(q, cache.k[li], cache.v[li],
-                                           cache.length, k, v, pos)
+                                           cache.length, k, v, pos,
+                                           window=getattr(cfg, "sliding_window",
+                                                          None))
         new_k_list.append(k_c)
         new_v_list.append(v_c)
         x = x + out.reshape(b, s, nh * hd) @ att.o_proj

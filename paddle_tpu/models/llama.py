@@ -45,6 +45,8 @@ class LlamaConfig:
     remat: bool = True
     use_flash: bool = True
     scan_layers: bool = False  # stack layers + lax.scan: O(1) compile depth
+    sliding_window: int | None = None  # Mistral-style causal window
+    attention_bias: bool = False       # Qwen2: bias on fused qkv only
 
     @staticmethod
     def llama2_7b(**kw):
@@ -87,13 +89,21 @@ class LlamaAttention(Module):
         self.o_proj = init((nh * self.head_dim, h), cfg.dtype)
         self.set_pspec("qkv_proj", P(None, "tp"))
         self.set_pspec("o_proj", P("tp", None))
+        if cfg.attention_bias:  # Qwen2: q/k/v biased, o_proj not
+            self.qkv_bias = jnp.zeros(((nh + 2 * nkv) * self.head_dim,), cfg.dtype)
+            self.set_pspec("qkv_bias", P("tp"))
+        else:
+            self.qkv_bias = None
         self.num_heads, self.num_kv_heads = nh, nkv
         self.use_flash = cfg.use_flash
+        self.window = cfg.sliding_window
 
     def __call__(self, x, cos, sin, attn_mask=None):
         b, s, h = x.shape
         nh, nkv, d = self.num_heads, self.num_kv_heads, self.head_dim
         qkv = x @ self.qkv_proj
+        if self.qkv_bias is not None:
+            qkv = qkv + self.qkv_bias
         q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
         q = q.reshape(b, s, nh, d)
         k = k.reshape(b, s, nkv, d)
@@ -101,7 +111,8 @@ class LlamaAttention(Module):
         q = A.apply_rope(q, cos, sin)
         k = A.apply_rope(k, cos, sin)
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=True, training=self.training)
+                                             is_causal=True, training=self.training,
+                                             window=self.window)
         return out.reshape(b, s, nh * d) @ self.o_proj
 
 

@@ -1,0 +1,45 @@
+"""Mistral-7B family (ref capability: PaddleNLP
+``paddlenlp/transformers/mistral/modeling.py``).
+
+Architecturally LLaMA + causal sliding-window attention (window 4096,
+GQA with 8 KV heads, theta 1e6 for v0.2+). The decoder stack is shared
+with :mod:`paddle_tpu.models.llama`; the window is enforced inside the
+Pallas flash kernel (band tiles only — O(S·window) not O(S²)) with an
+identical-banding XLA fallback.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    num_flops_per_token,
+)
+
+
+class MistralConfig(LlamaConfig):
+    @staticmethod
+    def mistral_7b(**kw):
+        return MistralConfig(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=32768,
+            rope_theta=1e6, sliding_window=4096), **kw})
+
+    @staticmethod
+    def tiny(**kw):
+        return MistralConfig(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            sliding_window=16, dtype=jnp.float32, remat=False), **kw})
+
+
+class MistralModel(LlamaModel):
+    pass
+
+
+class MistralForCausalLM(LlamaForCausalLM):
+    pass

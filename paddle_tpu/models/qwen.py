@@ -1,0 +1,54 @@
+"""Qwen2 family (ref capability: PaddleNLP
+``paddlenlp/transformers/qwen2/modeling.py``).
+
+LLaMA architecture + biases on the (fused) q/k/v projections, GQA, rope
+theta 1e6, tied embeddings on the small variants. Shares the decoder stack
+with :mod:`paddle_tpu.models.llama` (`attention_bias=True` adds the fused
+qkv bias, tp-sharded with the projection).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    num_flops_per_token,
+)
+
+
+class Qwen2Config(LlamaConfig):
+    @staticmethod
+    def qwen2_7b(**kw):
+        return Qwen2Config(**{**dict(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28,
+            num_key_value_heads=4, max_position_embeddings=32768,
+            rope_theta=1e6, attention_bias=True), **kw})
+
+    @staticmethod
+    def qwen2_0_5b(**kw):
+        return Qwen2Config(**{**dict(
+            vocab_size=151936, hidden_size=896, intermediate_size=4864,
+            num_hidden_layers=24, num_attention_heads=14,
+            num_key_value_heads=2, max_position_embeddings=32768,
+            rope_theta=1e6, attention_bias=True,
+            tie_word_embeddings=True), **kw})
+
+    @staticmethod
+    def tiny(**kw):
+        return Qwen2Config(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            attention_bias=True, tie_word_embeddings=True,
+            dtype=jnp.float32, remat=False), **kw})
+
+
+class Qwen2Model(LlamaModel):
+    pass
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    pass

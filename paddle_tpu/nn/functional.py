@@ -749,16 +749,18 @@ def square_error_cost(input, label):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
-                                 *, rng=None, scale=None):
+                                 *, rng=None, scale=None, window=None):
     """[B, S, H, D] layout (reference flash_attention convention).
 
     Dispatches to the Pallas TPU flash kernel when available, else a fused
-    XLA path (softmax in fp32, MXU matmuls in input dtype).
+    XLA path (softmax in fp32, MXU matmuls in input dtype). ``window`` is a
+    Mistral-style causal sliding window.
     """
     from paddle_tpu.ops import attention as _attn
     return _attn.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
-        is_causal=is_causal, training=training, rng=rng, scale=scale)
+        is_causal=is_causal, training=training, rng=rng, scale=scale,
+        window=window)
 
 
 def softmax_mask_fuse_upper_triangle(x):

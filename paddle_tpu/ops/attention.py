@@ -26,8 +26,11 @@ def _use_pallas(q) -> bool:
 
 
 def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None,
-                  dropout_p=0.0, training=True, rng=None):
-    """Reference-semantics attention in pure XLA. [B,S,H,D]."""
+                  dropout_p=0.0, training=True, rng=None, window=None):
+    """Reference-semantics attention in pure XLA. [B,S,H,D]. ``window``:
+    causal sliding window (token i sees [i-window+1, i]), Mistral-style."""
+    if window is not None and not is_causal:
+        raise ValueError("window requires is_causal=True")
     b, sq, h, d = query.shape
     sk = key.shape[1]
     kv_heads = key.shape[2]
@@ -41,9 +44,15 @@ def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None
     v = jnp.swapaxes(value, 1, 2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
-    if is_causal:
-        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        scores = jnp.where(causal, scores, _NEG_INF)
+    if is_causal or window is not None:
+        # align query positions to the END of the key axis (KV-cache decode)
+        q_pos = jnp.arange(sq) + (sk - sq)
+        k_pos = jnp.arange(sk)
+        keep = (q_pos[:, None] >= k_pos[None, :]) if is_causal else \
+            jnp.ones((sq, sk), bool)
+        if window is not None:
+            keep &= (q_pos[:, None] - k_pos[None, :]) < window
+        scores = jnp.where(keep, scores, _NEG_INF)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
             scores = jnp.where(attn_mask, scores, _NEG_INF)
@@ -62,16 +71,23 @@ def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, rng=None, scale=None):
+                                 is_causal=False, training=True, rng=None, scale=None,
+                                 window=None):
+    h, kv = query.shape[2], key.shape[2]
     if (attn_mask is None and dropout_p == 0.0 and _use_pallas(query)
-            and query.shape[2] == key.shape[2]):
+            and h % kv == 0 and (window is None or is_causal)):
         try:
             from paddle_tpu.ops.pallas.flash_attention import flash_attention
-            return flash_attention(query, key, value, causal=is_causal, scale=scale)
+            if kv != h:  # GQA: repeat KV so the kernel sees equal heads
+                key = jnp.repeat(key, h // kv, axis=2)
+                value = jnp.repeat(value, h // kv, axis=2)
+            return flash_attention(query, key, value, causal=is_causal, scale=scale,
+                                   window=window)
         except Exception:
             pass
     return xla_attention(query, key, value, attn_mask=attn_mask, is_causal=is_causal,
-                         scale=scale, dropout_p=dropout_p, training=training, rng=rng)
+                         scale=scale, dropout_p=dropout_p, training=training, rng=rng,
+                         window=window)
 
 
 flash_attention = scaled_dot_product_attention

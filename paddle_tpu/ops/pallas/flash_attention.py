@@ -30,8 +30,29 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
+def _band_mask(s, i, j, block_q, block_k, causal, window, q_off):
+    """Apply causal and/or sliding-window banding to a score tile. ``q_off``
+    (= sk - sq) aligns query positions to the END of the key axis so a
+    short query block (KV-cache decode) sees the whole prefix."""
+    q_idx = q_off + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    keep = q_idx >= k_idx if causal else (q_idx == q_idx)
+    if window is not None:
+        keep &= (q_idx - k_idx) < window
+    return jnp.where(keep, s, _NEG_INF)
+
+
+def _block_live(i, j, block_q, block_k, causal, window, q_off):
+    """Predicate: tile (i, j) has any unmasked entry — causal upper bound
+    and, with a window, a lower band bound (skip tiles fully below it)."""
+    live = j * block_k <= q_off + i * block_q + block_q - 1
+    if window is not None:
+        live &= q_off + i * block_q - (j * block_k + block_k - 1) < window
+    return live
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
-                *, scale, causal, block_q, block_k, nk):
+                *, scale, causal, window, q_off, block_q, block_k, nk):
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -45,10 +66,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         k = k_ref[0]  # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+        if causal or window is not None:
+            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off)
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         corr = jnp.exp(m_prev - m_new)
@@ -62,7 +81,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
 
     if causal:
         # block (i, j) has any unmasked entry iff j*Bk <= i*Bq + Bq - 1
-        pl.when(j * block_k <= i * block_q + block_q - 1)(compute)
+        # (and, windowed, iff it is not entirely below the band)
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
     else:
         compute()
 
@@ -75,13 +95,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         lse_ref[0] = (m_sc[:] + jnp.log(l)).astype(lse_ref.dtype)
 
 
-def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, *, scale, causal, window, block_q, block_k, interpret):
     bh, s, d = q.shape
     sk = k.shape[1]
+    q_off = sk - s  # align queries to the end of the key axis (decode)
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
     grid = (bh, nq, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, nk=nk)
+                               window=window, q_off=q_off, block_q=block_q,
+                               block_k=block_k, nk=nk)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -109,7 +131,7 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-               *, scale, causal, block_q, block_k, nk):
+               *, scale, causal, window, q_off, block_q, block_k, nk):
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -123,10 +145,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+        if causal or window is not None:
+            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off)
         p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [Bq, 1]
         dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -135,7 +155,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
                                          preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(j * block_k <= i * block_q + block_q - 1)(compute)
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
     else:
         compute()
 
@@ -145,7 +165,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, scale, causal, block_q, block_k, nq):
+                dk_acc, dv_acc, *, scale, causal, window, q_off, block_q, block_k, nq):
     j, i = pl.program_id(1), pl.program_id(2)  # kv-major: q iterated fastest
 
     @pl.when(i == 0)
@@ -160,10 +180,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_idx = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+        if causal or window is not None:
+            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off)
         p = jnp.exp(s - lse_ref[0])  # [Bq, Bk]; lse_ref[0]: [Bq, 1]
         dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -174,7 +192,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                                          preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(j * block_k <= i * block_q + block_q - 1)(compute)
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
     else:
         compute()
 
@@ -184,17 +202,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, *, scale, causal, window, block_q, block_k, interpret):
     q, k, v, out, lse = res
     bh, s, d = q.shape
     sk = k.shape[1]
+    q_off = sk - s
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, S, 1] to match lse layout
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          window=window, q_off=q_off, block_q=block_q,
+                          block_k=block_k, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -212,7 +232,8 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq),
+                          window=window, q_off=q_off, block_q=block_q,
+                          block_k=block_k, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -239,21 +260,21 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, window, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, window=window,
                         block_q=block_q, block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
+def _flash_vjp_fwd(q, k, v, scale, causal, window, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal, window=window,
                           block_q=block_q, block_k=block_k, interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(res, g, scale=scale, causal=causal,
+def _flash_vjp_bwd(scale, causal, window, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, scale=scale, causal=causal, window=window,
                       block_q=block_q, block_k=block_k, interpret=interpret)
 
 
@@ -261,12 +282,17 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    window: int | None = None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool | None = None):
     """q,k,v: [B, S, H, D] (reference flash_attention layout). Same-heads only
-    (GQA callers repeat KV first)."""
+    (GQA callers repeat KV first). ``window``: causal sliding-window size
+    (Mistral-style; token i attends to [i-window+1, i]) — tiles entirely
+    outside the band are skipped, so long-sequence cost is O(S*window)."""
     b, s, h, d = q.shape
     sk = k.shape[1]
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else d ** -0.5
@@ -276,5 +302,6 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     def to_bh(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
 
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, bq, bk, interpret)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, window, bq, bk,
+                 interpret)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
