@@ -40,7 +40,7 @@ jax.tree_util.register_pytree_node(
     lambda aux, ch: KVCache(*ch))
 
 
-def _attend_with_cache(q, k_cache, v_cache, cur_len, new_k, new_v, pos,
+def _attend_with_cache(q, k_cache, v_cache, new_k, new_v, pos,
                        window=None):
     """Write new_k/new_v at pos, attend q over cache[:pos+new]. ``window``
     keeps decode consistent with sliding-window training (Mistral)."""
@@ -80,7 +80,7 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
         k = A.apply_rope(k.reshape(b, s, nkv, hd), cos, sin)
         v = v.reshape(b, s, nkv, hd)
         out, k_c, v_c = _attend_with_cache(q, cache.k[li], cache.v[li],
-                                           cache.length, k, v, pos,
+                                           k, v, pos,
                                            window=getattr(cfg, "sliding_window",
                                                           None))
         new_k_list.append(k_c)

@@ -78,9 +78,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             and h % kv == 0 and (window is None or is_causal)):
         try:
             from paddle_tpu.ops.pallas.flash_attention import flash_attention
-            if kv != h:  # GQA: repeat KV so the kernel sees equal heads
-                key = jnp.repeat(key, h // kv, axis=2)
-                value = jnp.repeat(value, h // kv, axis=2)
+            # GQA handled inside the kernel (kv row = q row // rep) — no
+            # materialised K/V repeat
             return flash_attention(query, key, value, causal=is_causal, scale=scale,
                                    window=window)
         except Exception:

@@ -11,6 +11,9 @@ TPU-first design (not a CUDA translation):
     (O, logsumexp), the standard flash-2 recomputation strategy.
   * causal blocks that are fully masked are skipped with @pl.when — the
     sweep does ~half the FLOPs for causal attention.
+  * sliding-window (Mistral) runs on a BANDED grid: each q block's k-axis
+    only spans its band (index_map offsets the block index), so both the
+    FLOPs and the K/V DMA traffic are O(S*window), not O(S^2).
 
 Layout: [B, S, H, D] at the API (reference flash_attention convention);
 kernels run on [B*H, S, D].
@@ -51,11 +54,25 @@ def _block_live(i, j, block_q, block_k, causal, window, q_off):
     return live
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
-                *, scale, causal, window, q_off, block_q, block_k, nk):
-    i, j = pl.program_id(1), pl.program_id(2)
+def _band_j_start(i, block_q, block_k, window, q_off):
+    """First k-block index in the band of q-block i (clamped to 0)."""
+    return jnp.maximum(0, (i * block_q + q_off - window + 1) // block_k)
 
-    @pl.when(j == 0)
+
+def _band_i_start(j, block_q, block_k, q_off):
+    """First q-block index whose band reaches k-block j (clamped to 0)."""
+    return jnp.maximum(0, (j * block_k - q_off) // block_q)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
+                *, scale, causal, window, q_off, block_q, block_k, nk,
+                banded, nsteps):
+    i, jl = pl.program_id(1), pl.program_id(2)
+    # banded grid: the j-axis is a window-relative offset from the first
+    # live k block of this q block; full grid: jl IS the k block index
+    j = _band_j_start(i, block_q, block_k, window, q_off) + jl if banded else jl
+
+    @pl.when(jl == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
         m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
@@ -79,14 +96,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
                                  preferred_element_type=jnp.float32)
         acc[:] = acc[:] * corr[:, None] + pv
 
-    if causal:
+    if banded:
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off)
+                & (j < nk))(compute)
+    elif causal:
         # block (i, j) has any unmasked entry iff j*Bk <= i*Bq + Bq - 1
         # (and, windowed, iff it is not entirely below the band)
         pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
     else:
         compute()
 
-    @pl.when(j == nk - 1)
+    @pl.when(jl == nsteps - 1)
     def _finalize():
         l = jnp.maximum(l_sc[:], 1e-30)  # [Bq, 1]
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
@@ -95,22 +115,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         lse_ref[0] = (m_sc[:] + jnp.log(l)).astype(lse_ref.dtype)
 
 
-def _flash_fwd(q, k, v, *, scale, causal, window, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, *, scale, causal, window, kv_rep, block_q, block_k,
+               interpret):
     bh, s, d = q.shape
     sk = k.shape[1]
     q_off = sk - s  # align queries to the end of the key axis (decode)
+    # GQA: k/v carry bh/kv_rep batch-head rows; q row b reads kv row
+    # b // kv_rep via the index map — no repeated K/V is ever materialised
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
-    grid = (bh, nq, nk)
+    # windowed-causal: visit only the k blocks inside each q block's band —
+    # the DMA pipeline then moves O(S*window) bytes, not O(S^2)
+    banded = window is not None and causal and window < sk
+    if banded:
+        nsteps = min(nk, pl.cdiv(window + block_q - 1, block_k) + 1)
+
+        def kv_index(b, i, jl):
+            j = _band_j_start(i, block_q, block_k, window, q_off) + jl
+            return (b // kv_rep, jnp.minimum(j, nk - 1), 0)
+    else:
+        nsteps = nk
+
+        def kv_index(b, i, jl):
+            return (b // kv_rep, jl, 0)
+    grid = (bh, nq, nsteps)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                window=window, q_off=q_off, block_q=block_q,
-                               block_k=block_k, nk=nk)
+                               block_k=block_k, nk=nk, banded=banded,
+                               nsteps=nsteps)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -131,10 +169,12 @@ def _flash_fwd(q, k, v, *, scale, causal, window, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-               *, scale, causal, window, q_off, block_q, block_k, nk):
-    i, j = pl.program_id(1), pl.program_id(2)
+               *, scale, causal, window, q_off, block_q, block_k, nk,
+               banded, nsteps):
+    i, jl = pl.program_id(1), pl.program_id(2)
+    j = _band_j_start(i, block_q, block_k, window, q_off) + jl if banded else jl
 
-    @pl.when(j == 0)
+    @pl.when(jl == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
@@ -154,21 +194,26 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         dq_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
-    if causal:
+    if banded:
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off)
+                & (j < nk))(compute)
+    elif causal:
         pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
     else:
         compute()
 
-    @pl.when(j == nk - 1)
+    @pl.when(jl == nsteps - 1)
     def _finalize():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, scale, causal, window, q_off, block_q, block_k, nq):
-    j, i = pl.program_id(1), pl.program_id(2)  # kv-major: q iterated fastest
+                dk_acc, dv_acc, *, scale, causal, window, q_off, block_q,
+                block_k, nq, banded, nsteps):
+    j, il = pl.program_id(1), pl.program_id(2)  # kv-major: q iterated fastest
+    i = _band_i_start(j, block_q, block_k, q_off) + il if banded else il
 
-    @pl.when(i == 0)
+    @pl.when(il == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -191,35 +236,62 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
-    if causal:
+    if banded:
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off)
+                & (i < nq))(compute)
+    elif causal:
         pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
     else:
         compute()
 
-    @pl.when(i == nq - 1)
+    @pl.when(il == nsteps - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, causal, window, block_q, block_k, interpret):
+def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
+               interpret):
     q, k, v, out, lse = res
     bh, s, d = q.shape
     sk = k.shape[1]
+    bh_kv = k.shape[0]
     q_off = sk - s
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, S, 1] to match lse layout
 
+    banded = window is not None and causal and window < sk
+    if banded:
+        nk_steps = min(nk, pl.cdiv(window + block_q - 1, block_k) + 1)
+        nq_steps = min(nq, pl.cdiv(window + block_k - 1, block_q) + 1)
+
+        def kv_index_dq(b, i, jl):
+            j = _band_j_start(i, block_q, block_k, window, q_off) + jl
+            return (b // kv_rep, jnp.minimum(j, nk - 1), 0)
+
+        def q_index_dkv(b, j, il):
+            i = _band_i_start(j, block_q, block_k, q_off) + il
+            return (b, jnp.minimum(i, nq - 1), 0)
+    else:
+        nk_steps, nq_steps = nk, nq
+
+        def kv_index_dq(b, i, jl):
+            return (b // kv_rep, jl, 0)
+
+        def q_index_dkv(b, j, il):
+            return (b, il, 0)
+
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           window=window, q_off=q_off, block_q=block_q,
-                          block_k=block_k, nk=nk),
-        grid=(bh, nq, nk),
+                          block_k=block_k, nk=nk, banded=banded,
+                          nsteps=nk_steps),
+        grid=(bh, nq, nk_steps),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index_dq),
+            pl.BlockSpec((1, block_k, d), kv_index_dq),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -233,15 +305,16 @@ def _flash_bwd(res, g, *, scale, causal, window, block_q, block_k, interpret):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           window=window, q_off=q_off, block_q=block_q,
-                          block_k=block_k, nq=nq),
-        grid=(bh, nk, nq),
+                          block_k=block_k, nq=nq, banded=banded,
+                          nsteps=nq_steps),
+        grid=(bh, nk, nq_steps),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_index_dkv),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b // kv_rep, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b // kv_rep, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_index_dkv),
+            pl.BlockSpec((1, block_q, 1), q_index_dkv),
+            pl.BlockSpec((1, block_q, 1), q_index_dkv),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -257,25 +330,34 @@ def _flash_bwd(res, g, *, scale, causal, window, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
+    if kv_rep > 1:
+        # per-q-head partials -> sum over each kv group (rows are contiguous)
+        dk = dk.reshape(bh_kv, kv_rep, sk, d).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(bh_kv, kv_rep, sk, d).sum(axis=1).astype(v.dtype)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, window, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, window, kv_rep, block_q, block_k, interpret):
     out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, window=window,
-                        block_q=block_q, block_k=block_k, interpret=interpret)
+                        kv_rep=kv_rep, block_q=block_q, block_k=block_k,
+                        interpret=interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, window, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, scale, causal, window, kv_rep, block_q, block_k,
+                   interpret):
     out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal, window=window,
-                          block_q=block_q, block_k=block_k, interpret=interpret)
+                          kv_rep=kv_rep, block_q=block_q, block_k=block_k,
+                          interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, window, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(scale, causal, window, kv_rep, block_q, block_k, interpret,
+                   res, g):
     return _flash_bwd(res, g, scale=scale, causal=causal, window=window,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
+                      kv_rep=kv_rep, block_q=block_q, block_k=block_k,
+                      interpret=interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -285,12 +367,18 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     window: int | None = None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool | None = None):
-    """q,k,v: [B, S, H, D] (reference flash_attention layout). Same-heads only
-    (GQA callers repeat KV first). ``window``: causal sliding-window size
-    (Mistral-style; token i attends to [i-window+1, i]) — tiles entirely
-    outside the band are skipped, so long-sequence cost is O(S*window)."""
+    """q,k,v: [B, S, H, D] (reference flash_attention layout). GQA supported
+    natively: K/V may carry fewer heads (H % H_kv == 0); the kernel reads kv
+    row b//rep through the index map, so no repeated K/V is materialised.
+    ``window``: causal sliding-window size (Mistral-style; token i attends
+    to [i-window+1, i]) — the banded grid skips out-of-band tiles AND their
+    DMAs, so long-sequence cost is O(S*window)."""
     b, s, h, d = q.shape
     sk = k.shape[1]
+    h_kv = k.shape[2]
+    if h % h_kv != 0:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    kv_rep = h // h_kv
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
     if interpret is None:
@@ -300,8 +388,8 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     bk = min(block_k, sk)
 
     def to_bh(x):
-        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+        return jnp.swapaxes(x, 1, 2).reshape(-1, x.shape[1], d)
 
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, window, bq, bk,
-                 interpret)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, window, kv_rep,
+                 bq, bk, interpret)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

@@ -1035,3 +1035,174 @@ def standard_normal(shape, dtype=None):
 def log_normal(mean=1.0, std=2.0, shape=(1,), dtype=None):
     return jnp.exp(jax.random.normal(_k(), shape,
                                      dtype=dtype or get_default_dtype()) * std + mean)
+
+
+# -- top-level alias/gap-fill (ref python/paddle/tensor/ misc) ---------------
+
+def add_n(inputs):
+    """Ref math.py:add_n — elementwise sum of a tensor list."""
+    if not isinstance(inputs, (list, tuple)):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+arccos = jnp.arccos
+arcsin = jnp.arcsin
+arctan = jnp.arctan
+arctan2 = jnp.arctan2
+neg = jnp.negative
+hstack = jnp.hstack
+vstack = jnp.vstack
+
+
+def floor_mod(x, y):
+    return jnp.mod(x, y)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+def crop(x, shape=None, offsets=None):
+    """Ref creation.py:crop — slice an offset window; -1 extends to the
+    end of that dim (after the offset)."""
+    offsets = list(offsets) if offsets is not None else [0] * x.ndim
+    shape = list(shape if shape is not None else x.shape)
+    shape = [x.shape[i] - offsets[i] if s in (-1, None) else s
+             for i, s in enumerate(shape)]
+    for i, (off, size) in enumerate(zip(offsets, shape)):
+        if isinstance(off, int) and isinstance(size, int) \
+                and off + size > x.shape[i]:
+            raise ValueError(
+                f"crop: offsets[{i}]+shape[{i}] = {off + size} exceeds dim "
+                f"{x.shape[i]} (dynamic_slice would silently clamp)")
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def is_tensor(x):
+    return isinstance(x, jax.Array)
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=dtype or get_default_dtype())
+
+
+def multiplex(inputs, index):
+    """Ref math.py:multiplex — row r of the output comes from
+    inputs[index[r]][r]."""
+    stacked = jnp.stack(list(inputs), axis=0)  # [K, B, ...]
+    idx = jnp.reshape(jnp.asarray(index), (-1,))
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)),
+        axis=0)[0]
+
+
+def percentile(x, q, axis=None, keepdim=False):
+    return jnp.percentile(x, q, axis=axis, keepdims=keepdim)
+
+
+def randint_like(x, low, high=None, dtype=None):
+    dtype = dtype or x.dtype
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        # reference allows float outputs: integer values cast to float
+        return randint(low, high, shape=x.shape, dtype="int64").astype(dtype)
+    return randint(low, high, shape=x.shape, dtype=dtype)
+
+
+def rank(x):
+    return jnp.asarray(jnp.asarray(x).ndim)
+
+
+def scatter_nd(index, updates, shape):
+    """Ref manipulation.py:scatter_nd — zeros of `shape` with `updates`
+    added at `index` (duplicate indices accumulate)."""
+    zeros = jnp.zeros(tuple(shape), jnp.asarray(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def sgn(x):
+    """Sign for real; unit-phase for complex (ref math.py:sgn)."""
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Ref manipulation.py:shard_index — recode global ids into a shard's
+    local range; ids outside this shard become ignore_value."""
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    inside = (input >= lo) & (input < hi)
+    return jnp.where(inside, input - lo, ignore_value)
+
+
+def tolist(x):
+    import numpy as _np
+    return _np.asarray(x).tolist()
+
+
+def tril_indices(row, col=None, offset=0):
+    col = col if col is not None else row
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+def triu_indices(row, col=None, offset=0):
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    shape = list(shape)
+    if -1 in shape:  # one entry may be inferred
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = x.shape[axis] // known
+    return jnp.reshape(x, x.shape[:axis] + tuple(shape) + x.shape[axis + 1:])
+
+
+def unfold(x, axis, size, step):
+    """Ref manipulation.py:unfold — sliding windows along `axis` (torch
+    Tensor.unfold semantics): windows of `size` every `step`, window dim
+    appended last."""
+    axis = axis % x.ndim
+    if size > x.shape[axis]:
+        raise ValueError(
+            f"unfold: window size {size} exceeds axis length {x.shape[axis]}")
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]  # [n, size]
+    out = jnp.take(x, idx, axis=axis)  # axis -> (n, size)
+    # move the window dim to the end
+    return jnp.moveaxis(out, axis + 1, -1)

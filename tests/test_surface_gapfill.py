@@ -315,3 +315,43 @@ def test_conll05_bio_nested_brackets():
     assert Conll05st._bio(['(A1(V*)', '*', '*)']) == ['B-V', 'I-A1', 'I-A1']
     assert Conll05st._bio(['(A0*)', '(V*)', '(A1*', '*)']) == \
         ['B-A0', 'B-V', 'B-A1', 'I-A1']
+
+
+# -- top-level alias gap-fill (round-1 audit) --------------------------------
+
+def test_toplevel_alias_ops():
+    import jax.numpy as jnp
+    import torch
+    # unfold matches torch Tensor.unfold
+    x = np.arange(20.0).reshape(4, 5).astype(np.float32)
+    got = np.asarray(pt.unfold(jnp.asarray(x), 1, 2, 2))
+    want = torch.tensor(x).unfold(1, 2, 2).numpy()
+    np.testing.assert_allclose(got, want)
+    # unflatten with inferred dim
+    assert pt.unflatten(jnp.arange(24.0).reshape(2, 12), 1, (3, -1)).shape == (2, 3, 4)
+    # crop / scatter_nd / shard_index
+    c = pt.crop(jnp.arange(25.0).reshape(5, 5), shape=[2, 2], offsets=[1, 2])
+    np.testing.assert_allclose(np.asarray(c), [[7.0, 8.0], [12.0, 13.0]])
+    s = pt.scatter_nd(jnp.asarray([[1], [1], [3]]), jnp.asarray([1.0, 2.0, 3.0]), [5])
+    np.testing.assert_allclose(np.asarray(s), [0, 3, 0, 3, 0])
+    si = pt.shard_index(jnp.asarray([0, 5, 9, 12]), 16, 2, 1)
+    assert np.asarray(si).tolist() == [-1, -1, 1, 4]
+    # multiplex picks rows by index
+    a = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    b = jnp.asarray([[5.0, 6.0], [7.0, 8.0]])
+    out = pt.multiplex([a, b], jnp.asarray([[1], [0]]))
+    np.testing.assert_allclose(np.asarray(out), [[5.0, 6.0], [3.0, 4.0]])
+    # sgn on complex = unit phase
+    z = pt.sgn(jnp.asarray([3 + 4j, 0j]))
+    np.testing.assert_allclose(np.asarray(z), [0.6 + 0.8j, 0], atol=1e-7)
+    # misc predicates / aliases
+    assert pt.is_tensor(jnp.zeros(2)) and not pt.is_tensor([1])
+    assert pt.is_floating_point(jnp.zeros(2))
+    assert pt.is_integer(jnp.zeros(2, jnp.int32))
+    assert pt.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    assert pt.tolist(jnp.asarray([1, 2])) == [1, 2]
+    assert int(pt.rank(jnp.zeros((2, 3)))) == 2
+    np.testing.assert_allclose(np.asarray(pt.logspace(0, 2, 3)), [1, 10, 100])
+    np.testing.assert_allclose(
+        np.asarray(pt.add_n([jnp.ones(2), jnp.ones(2), jnp.ones(2)])), [3.0, 3.0])
+    assert pt.tril_indices(3).shape[0] == 2 and pt.triu_indices(3).shape[0] == 2
