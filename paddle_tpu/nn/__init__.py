@@ -3,6 +3,8 @@ from paddle_tpu.core.module import Module
 from paddle_tpu.nn import functional, initializer
 from paddle_tpu.nn.layers import *  # noqa: F401,F403
 from paddle_tpu.nn.loss import (
+    HSigmoidLoss,
+    TripletMarginWithDistanceLoss,
     BCELoss,
     BCEWithLogitsLoss,
     CosineEmbeddingLoss,
@@ -26,6 +28,7 @@ from paddle_tpu.nn.rnn import (
     GRU,
     RNN,
     BiRNN,
+    _RNNCellBase as RNNCellBase,
     GRUCell,
     LSTM,
     LSTMCell,

@@ -311,33 +311,54 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
     return out
 
 
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     dilation=1, groups=1):
-    """weight: [in_c, out_c/groups, kh, kw] (reference transpose-conv layout)."""
-    nd = 2
+def _conv_transpose(x, weight, nd, bias=None, stride=1, padding=0,
+                    output_padding=0, dilation=1, groups=1):
+    """Generic N-D transpose conv: lhs-dilated conv with the flipped kernel.
+    weight: [in_c, out_c/groups, *k] (reference transpose-conv layout)."""
     stride = _norm_tuple(stride, nd)
     p = _norm_tuple(padding, nd)
     op = _norm_tuple(output_padding, nd)
     dilation = _norm_tuple(dilation, nd)
-    kh, kw = weight.shape[-2], weight.shape[-1]
-    # transpose conv = lhs-dilated conv with flipped kernel
-    w = jnp.flip(weight, axis=(-2, -1))
-    w = jnp.swapaxes(w, 0, 1)  # -> [out_c/g, in_c, kh, kw]; groups need reshape
+    kdims = weight.shape[2:]
     if groups > 1:
         ic = x.shape[1]
         oc_g = weight.shape[1]
-        w = weight.reshape(groups, ic // groups, oc_g, kh, kw)
-        w = jnp.flip(w, axis=(-2, -1))
-        w = jnp.swapaxes(w, 1, 2).reshape(groups * oc_g, ic // groups, kh, kw)
+        w = weight.reshape((groups, ic // groups, oc_g) + kdims)
+        w = jnp.flip(w, axis=tuple(range(3, 3 + nd)))
+        w = jnp.swapaxes(w, 1, 2).reshape((groups * oc_g, ic // groups) + kdims)
+    else:
+        w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+        w = jnp.swapaxes(w, 0, 1)  # -> [out_c, in_c, *k]
     pad = [(dilation[i] * (k - 1) - p[i], dilation[i] * (k - 1) - p[i] + op[i])
-           for i, k in enumerate((kh, kw))]
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
-    out = lax.conv_general_dilated(x, w, window_strides=(1, 1), padding=pad,
+           for i, k in enumerate(kdims)]
+    sp = "HWD"[:nd] if nd < 3 else "DHW"
+    fmt = ("NC" + sp, "OI" + sp, "NC" + sp)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, fmt)
+    out = lax.conv_general_dilated(x, w, window_strides=(1,) * nd, padding=pad,
                                    lhs_dilation=stride, rhs_dilation=dilation,
                                    dimension_numbers=dn, feature_group_count=groups)
     if bias is not None:
-        out = out + bias.reshape((1, -1, 1, 1))
+        out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1):
+    """weight: [in_c, out_c/groups, kh, kw] (reference transpose-conv layout)."""
+    return _conv_transpose(x, weight, 2, bias, stride, padding, output_padding,
+                           dilation, groups)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1):
+    return _conv_transpose(x, weight, 1, bias, stride, padding, output_padding,
+                           dilation, groups)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1):
+    return _conv_transpose(x, weight, 3, bias, stride, padding, output_padding,
+                           dilation, groups)
 
 
 def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
@@ -1204,3 +1225,139 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
     if return_softmax:
         return loss, jnp.exp(logp)
     return loss
+
+
+# -- generic pad + remaining functional gap-fill -----------------------------
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """Ref functional/common.py:pad. ``pad`` pairs apply to the LAST dims
+    first ([l, r] -> last dim; [l, r, t, b] -> last two dims, ...); when
+    len(pad) == 2*ndim it is per-dim pairs in dim order like jnp.pad."""
+    pad = list(pad)
+    if len(pad) == 2 * x.ndim:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # short form applies to spatial dims from the innermost outwards;
+        # channel-last formats (NLC/NHWC/NDHWC) skip the trailing C axis
+        last = x.ndim - 2 if data_format.endswith("C") else x.ndim - 1
+        pairs = [(0, 0)] * x.ndim
+        for i in range(len(pad) // 2):
+            pairs[last - i] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    l, r, t, b = _norm_tuple(padding, 4)
+    if data_format == "NCHW":
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+    return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+
+def _adaptive_max_along(x, axis, out_size):
+    size = x.shape[axis]
+    if size % out_size == 0:
+        shape = list(x.shape)
+        shape[axis:axis + 1] = [out_size, size // out_size]
+        return x.reshape(shape).max(axis=axis + 1)
+    m = _adaptive_avg_matrix(size, out_size, jnp.float32) > 0  # [out, in]
+    xm = jnp.moveaxis(x, axis, -1)
+    big = jnp.where(m.reshape((1,) * (xm.ndim - 1) + m.shape),
+                    xm[..., None, :], -jnp.inf)
+    return jnp.moveaxis(big.max(axis=-1).astype(x.dtype), -1, axis)
+
+
+def adaptive_max_pool3d(x, output_size):
+    out = _norm_tuple(output_size, 3)
+    for axis, o in zip((2, 3, 4), out):
+        x = _adaptive_max_along(x, axis, o)
+    return x
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    """Legacy fused CE entry point (ref loss.py:softmax_with_cross_entropy);
+    label holds class ids [..., 1] unless soft_label."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        squeeze = lab.ndim == logits.ndim
+        if squeeze:
+            lab = jnp.squeeze(lab, axis=axis)
+        valid = lab != ignore_index
+        lab_safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lab_safe, axis), axis=axis)
+        loss = jnp.where(jnp.expand_dims(valid, axis), -picked, 0.0)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    """Ref loss.py:triplet_margin_with_distance_loss — triplet loss with a
+    caller-supplied distance (default L2)."""
+    dist = distance_function or (
+        lambda a, b: jnp.linalg.norm(a - b, axis=-1))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid loss (ref loss.py:hsigmoid_loss). Default tree:
+    complete binary heap over classes — leaf of class c sits at heap node
+    c + num_classes - 1; internal node n scores sigmoid(x . w_n + b_n) and
+    the BCE target is whether the path descends to the right child. Custom
+    trees come in via (path_table, path_code) like the reference.
+
+    The path walk is a static ceil(log2(C))-iteration loop of heap
+    arithmetic — jit-friendly, no host lookups.
+    """
+    x = input
+    b, dim = x.shape
+    if path_table is not None:
+        codes = path_code
+        nodes = path_table
+        valid = nodes >= 0
+        nodes = jnp.maximum(nodes, 0)
+    else:
+        depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))) + 1)
+        leaf = label + num_classes - 1  # heap id of the class leaf
+        node_list, code_list, valid_list = [], [], []
+        cur = leaf
+        for _ in range(depth):
+            parent = (cur - 1) // 2
+            is_right = (cur % 2) == 0  # right children are even heap ids
+            above_root = cur > 0
+            node_list.append(jnp.where(above_root, parent, 0))
+            code_list.append(jnp.where(above_root, is_right, False))
+            valid_list.append(above_root)
+            cur = jnp.where(above_root, parent, 0)
+        nodes = jnp.stack(node_list, axis=-1)    # [B, depth]
+        codes = jnp.stack(code_list, axis=-1)
+        valid = jnp.stack(valid_list, axis=-1)
+    w = jnp.take(weight, nodes, axis=0)          # [B, depth, dim]
+    logits = jnp.einsum("bd,btd->bt", x, w)
+    if bias is not None:
+        logits = logits + jnp.take(bias.reshape(-1), nodes, axis=0)
+    target = codes.astype(logits.dtype)
+    bce = jnp.maximum(logits, 0) - logits * target + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(jnp.where(valid, bce, 0.0), axis=-1, keepdims=True)

@@ -41,6 +41,9 @@ __all__ = [
     "AdaptiveMaxPool1D", "SpectralNorm",
     "MaxPool3D", "AvgPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
     "Softmax2D", "Dropout3D",
+    "Conv1DTranspose", "Conv3DTranspose", "AdaptiveMaxPool3D", "LogSigmoid",
+    "ParameterList", "SyncBatchNorm", "UpsamplingNearest2D",
+    "UpsamplingBilinear2D",
 ]
 
 
@@ -572,6 +575,7 @@ Tanhshrink = _act_layer("Tanhshrink", lambda x: F.tanhshrink(x))
 ThresholdedReLU = _act_layer("ThresholdedReLU", F.thresholded_relu, threshold=1.0)
 Maxout = _act_layer("Maxout", F.maxout, groups=2, axis=1)
 GLU = _act_layer("GLU", F.glu, axis=-1)
+LogSigmoid = _act_layer("LogSigmoid", lambda x: F.log_sigmoid(x))
 
 
 class PReLU(Module):
@@ -752,3 +756,117 @@ class SpectralNorm(Module):
             object.__setattr__(self, "weight_v", v)
         sigma = u @ mat @ v
         return (weight / sigma.astype(weight.dtype))
+
+
+class Conv1DTranspose(Module):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 bias_attr=True, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        k = (kernel_size,) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.weight = I.KaimingUniform()((in_channels, out_channels // groups) + k, dtype)
+        self.bias = I.Constant(0.0)((out_channels,), dtype) if bias_attr else None
+        self.stride, self.padding, self.output_padding = stride, padding, output_padding
+        self.dilation, self.groups = dilation, groups
+
+    def __call__(self, x):
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups)
+
+
+class Conv3DTranspose(Module):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 bias_attr=True, dtype=None):
+        super().__init__()
+        dtype = dtype or get_default_dtype()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.weight = I.KaimingUniform()((in_channels, out_channels // groups) + k, dtype)
+        self.bias = I.Constant(0.0)((out_channels,), dtype) if bias_attr else None
+        self.stride, self.padding, self.output_padding = stride, padding, output_padding
+        self.dilation, self.groups = dilation, groups
+
+    def __call__(self, x):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups)
+
+
+class AdaptiveMaxPool3D(Module):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class ParameterList(Module):
+    """Ref container.py:ParameterList — an indexable list of parameters."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self.params = list(parameters) if parameters is not None else []
+
+    def append(self, p):
+        self.params.append(p)
+        return self
+
+    def __getitem__(self, i):
+        return self.params[i]
+
+    def __len__(self):
+        return len(self.params)
+
+    def __iter__(self):
+        return iter(self.params)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Ref norm.py:SyncBatchNorm. Under GSPMD the batch axes of a sharded
+    activation are already reduced globally when this runs inside jit with
+    sharding annotations (XLA inserts the cross-replica psum for the mean/
+    var reductions), so the TPU implementation IS BatchNorm — kept as its
+    own class for API parity and for convert_sync_batchnorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Swap every _BatchNormBase in a module tree for SyncBatchNorm."""
+        def convert(value):
+            if isinstance(value, _BatchNormBase) and not isinstance(value, cls):
+                new = cls.__new__(cls)
+                new.__dict__.update(value.__dict__)
+                # fresh mutable containers — sharing them would let later
+                # register_buffer/set_pspec mutate the original layer too
+                new._buffers = set(value._buffers)
+                new._pspecs = dict(value._pspecs)
+                new._dyn_names = set(value._dyn_names)
+                return new
+            if isinstance(value, Module):
+                for name, sub in list(vars(value).items()):
+                    if name in ("_buffers", "_pspecs", "_dyn_names"):
+                        continue
+                    object.__setattr__(value, name, convert(sub))
+                return value
+            if isinstance(value, list):
+                return [convert(v) for v in value]
+            if isinstance(value, tuple):
+                return tuple(convert(v) for v in value)
+            if isinstance(value, dict):
+                return {k: convert(v) for k, v in value.items()}
+            return value
+
+        return convert(layer)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__(size=size, scale_factor=scale_factor, mode="nearest")
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__(size=size, scale_factor=scale_factor, mode="bilinear",
+                         align_corners=True)

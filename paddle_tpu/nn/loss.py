@@ -180,3 +180,39 @@ class CTCLoss(Module):
     def __call__(self, log_probs, labels, input_lengths, label_lengths):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Module):
+    """Ref loss.py:TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean"):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def __call__(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Module):
+    """Hierarchical sigmoid (ref loss.py:HSigmoidLoss): owns the internal-
+    node weight table [num_classes - 1, dim] (+bias)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=True, is_custom=False, dtype=None):
+        super().__init__()
+        from paddle_tpu.core.dtypes import get_default_dtype
+        from paddle_tpu.nn import initializer as I
+        dtype = dtype or get_default_dtype()
+        n_nodes = num_classes - 1
+        self.weight = I.XavierNormal()((n_nodes, feature_size), dtype)
+        self.bias = I.Constant(0.0)((n_nodes, 1), dtype) if bias_attr else None
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+
+    def __call__(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

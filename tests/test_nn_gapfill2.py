@@ -1,0 +1,155 @@
+"""Second nn gap-fill round: transpose convs 1/3-D, generic pad,
+hsigmoid, triplet-with-distance, SyncBatchNorm conversion, containers."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_conv1d_transpose_matches_torch():
+    import torch
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 10).astype(np.float32)
+    w = rs.randn(3, 4, 5).astype(np.float32)  # [in, out, k]
+    got = F.conv1d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2,
+                             padding=1, output_padding=1)
+    want = torch.nn.functional.conv_transpose1d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+        output_padding=1).numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_transpose_matches_torch():
+    import torch
+    rs = np.random.RandomState(1)
+    x = rs.randn(1, 2, 4, 5, 6).astype(np.float32)
+    w = rs.randn(2, 3, 3, 3, 3).astype(np.float32)
+    got = F.conv3d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2,
+                             padding=1)
+    want = torch.nn.functional.conv_transpose3d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_transpose_layers():
+    pt.seed(0)
+    y1 = nn.Conv1DTranspose(3, 6, 4, stride=2)(jnp.zeros((2, 3, 8)))
+    assert y1.shape == (2, 6, 18)
+    y3 = nn.Conv3DTranspose(2, 4, 3, stride=2)(jnp.zeros((1, 2, 4, 4, 4)))
+    assert y3.shape == (1, 4, 9, 9, 9)
+
+
+def test_generic_pad_matches_torch():
+    import torch
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 3, 4, 5).astype(np.float32)
+    for mode in ["constant", "reflect", "replicate", "circular"]:
+        got = F.pad(jnp.asarray(x), [1, 2, 2, 1], mode=mode, value=3.0)
+        want = torch.nn.functional.pad(
+            torch.tensor(x), [1, 2, 2, 1], mode=mode.replace("constant", "constant"),
+            value=3.0 if mode == "constant" else 0.0).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, err_msg=mode)
+    # full-length pad: per-dim pairs in dim order
+    got = F.pad(jnp.asarray(x), [0, 0, 0, 0, 1, 1, 2, 2])
+    assert got.shape == (2, 3, 6, 9)
+
+
+def test_zeropad2d_and_adaptive_max_pool3d():
+    x = jnp.ones((1, 2, 3, 3))
+    y = F.zeropad2d(x, [1, 2, 3, 4])
+    assert y.shape == (1, 2, 10, 6) and float(y[0, 0, 0, 0]) == 0.0
+    z = jnp.asarray(np.random.RandomState(0).randn(1, 2, 4, 6, 8), jnp.float32)
+    out = F.adaptive_max_pool3d(z, (2, 3, 4))
+    assert out.shape == (1, 2, 2, 3, 4)
+    np.testing.assert_allclose(
+        np.asarray(out[0, 0, 0, 0, 0]),
+        np.asarray(z[0, 0, :2, :2, :2]).max())
+    assert nn.AdaptiveMaxPool3D((2, 3, 4))(z).shape == (1, 2, 2, 3, 4)
+
+
+def test_softmax_with_cross_entropy():
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(4, 7).astype(np.float32))
+    label = jnp.asarray(rs.randint(0, 7, (4, 1)))
+    loss, sm = F.softmax_with_cross_entropy(logits, label, return_softmax=True)
+    assert loss.shape == (4, 1) and sm.shape == (4, 7)
+    want = -np.log(np.asarray(sm)[np.arange(4), np.asarray(label)[:, 0]])
+    np.testing.assert_allclose(np.asarray(loss)[:, 0], want, rtol=1e-5)
+
+
+def test_triplet_margin_with_distance_loss():
+    rs = np.random.RandomState(0)
+    a, p, n = (jnp.asarray(rs.randn(4, 8).astype(np.float32)) for _ in range(3))
+    default = float(F.triplet_margin_with_distance_loss(a, p, n))
+    l1 = float(F.triplet_margin_with_distance_loss(
+        a, p, n, distance_function=lambda u, v: jnp.sum(jnp.abs(u - v), -1)))
+    assert np.isfinite(default) and np.isfinite(l1) and default != l1
+    layer = nn.TripletMarginWithDistanceLoss(margin=0.5)
+    assert np.isfinite(float(layer(a, p, n)))
+
+
+def test_hsigmoid_loss_trains():
+    """HSigmoid must be a trainable classifier proxy: loss decreases and
+    beats chance on a separable toy problem."""
+    pt.seed(0)
+    rs = np.random.RandomState(0)
+    num_classes, dim, n = 8, 16, 64
+    labels = rs.randint(0, num_classes, n)
+    x = rs.randn(n, dim).astype(np.float32) * 0.1
+    x += np.eye(num_classes)[labels] @ rs.randn(num_classes, dim).astype(np.float32)
+    layer = nn.HSigmoidLoss(dim, num_classes)
+    xs, ys = jnp.asarray(x), jnp.asarray(labels)
+
+    def loss_fn(m):
+        return jnp.mean(m(xs, ys))
+
+    import paddle_tpu.optimizer as opt
+    o = opt.Adam(learning_rate=0.1)
+    state = o.init(layer)
+    l0 = float(loss_fn(layer))
+    for _ in range(30):
+        grads = jax.grad(loss_fn)(layer)
+        layer, state = o.step(layer, grads, state)
+    assert float(loss_fn(layer)) < l0 * 0.5, (l0, float(loss_fn(layer)))
+
+
+def test_sync_batchnorm_convert_and_forward():
+    m = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4), nn.ReLU())
+    m2 = nn.SyncBatchNorm.convert_sync_batchnorm(m)
+    assert isinstance(m2.layers[1], nn.SyncBatchNorm)
+    out = m2(jnp.zeros((2, 3, 8, 8)))
+    assert out.shape == (2, 4, 6, 6)
+
+
+def test_parameter_list():
+    pl = nn.ParameterList([jnp.ones((2,)), jnp.zeros((3,))])
+    pl.append(jnp.ones((4,)))
+    assert len(pl) == 3 and pl[2].shape == (4,)
+    # registered as pytree leaves
+    leaves = jax.tree_util.tree_leaves(pl)
+    assert sum(l.size for l in leaves) == 9
+
+
+def test_upsampling_layers():
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 4, 4), jnp.float32)
+    up_n = nn.UpsamplingNearest2D(scale_factor=2)(x)
+    up_b = nn.UpsamplingBilinear2D(scale_factor=2)(x)
+    assert up_n.shape == up_b.shape == (1, 2, 8, 8)
+    import torch
+    want = torch.nn.UpsamplingBilinear2d(scale_factor=2)(
+        torch.tensor(np.asarray(x))).numpy()
+    np.testing.assert_allclose(np.asarray(up_b), want, rtol=1e-4, atol=1e-5)
+
+
+def test_log_sigmoid_layer():
+    x = jnp.asarray([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(np.asarray(nn.LogSigmoid()(x)),
+                               np.asarray(F.log_sigmoid(x)))
+
+
+def test_rnn_cell_base_exported():
+    assert issubclass(nn.LSTMCell, nn.RNNCellBase)
