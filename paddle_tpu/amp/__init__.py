@@ -141,3 +141,14 @@ class GradScaler:
             if old is not None and hasattr(old, "dtype") else old,
             params, new_params, is_leaf=lambda x: x is None)
 from paddle_tpu.amp import debugging
+
+
+def is_bfloat16_supported(device=None):
+    """Ref amp helpers: TPUs are bf16-native; CPU XLA also executes bf16."""
+    return True
+
+
+def is_float16_supported(device=None):
+    import jax
+    # fp16 matmuls execute everywhere but TPUs upcast — keep parity: True
+    return jax.default_backend() in ("tpu", "gpu", "cpu")

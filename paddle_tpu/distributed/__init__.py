@@ -62,3 +62,191 @@ def recompute(fn, *args, **kwargs):
     preserve = kwargs.pop("preserve_rng_state", None)  # reference kwarg; rng
     # is explicit in this framework so nothing to preserve
     return jax.checkpoint(lambda *a: fn(*a, **kwargs))(*args)
+
+
+# -- reference communication-API parity (ref python/paddle/distributed/) -----
+
+from paddle_tpu.distributed import fleet, launch  # noqa: E402
+from paddle_tpu.distributed.collective import (  # noqa: E402
+    all_gather_object,
+    gather,
+    recv,
+    reduce,
+    scatter,
+    send,
+)
+
+# reference spells all_to_all "alltoall"
+alltoall = all_to_all
+
+
+def alltoall_single(x, *, axis_name: str):
+    """Ref alltoall_single: equal splits of the leading dim exchanged over
+    the group (split axis == concat axis == 0)."""
+    return all_to_all(x, axis_name=axis_name, split_axis=0, concat_axis=0)
+
+
+# isend/irecv: XLA collectives are compiler-scheduled; there is no async
+# handle to wait on — the names map to the same static-edge ppermute.
+isend = send
+irecv = recv
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Ref communication/wait: stream sync. XLA orders collectives in the
+    compiled program; host-side sync is block_until_ready."""
+    try:
+        tensor.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor
+
+
+class Group:
+    """Process-group handle (ref collective.Group). On TPU a group IS a
+    mesh axis: ``axis_name`` binds the collectives that take this group."""
+
+    def __init__(self, ranks, axis_name=None, id=0):
+        self.ranks = list(ranks)
+        self.axis_name = axis_name
+        self.id = id
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    def __repr__(self):
+        return f"Group(ranks={self.ranks}, axis_name={self.axis_name!r})"
+
+
+_groups: dict = {}
+_next_group_id = [0]
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    """Ref new_group. GSPMD note: collectives are compiled against mesh
+    axes, so a 'group' here names an axis of the active HybridMesh (default:
+    the data-parallel axis) rather than wiring a communicator."""
+    import jax
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    g = Group(ranks, axis_name=axis_name or "dp", id=_next_group_id[0])
+    _next_group_id[0] += 1
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def is_initialized():
+    return True
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(getattr(group, "id", group), None)
+
+
+class ParallelEnv:
+    """Ref parallel.ParallelEnv — rank/world-size view of the runtime."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        # consistent with module-level get_world_size (device count under
+        # SPMD — one program per chip, unlike the reference's per-process
+        # trainers)
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        import jax
+        return jax.devices()[0].id
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+
+class DataParallel:
+    """Ref paddle.DataParallel wrapper. Under GSPMD data parallelism is a
+    sharding property, not a wrapper: batch inputs sharded over the ``dp``
+    axis replicate params and all-reduce grads inside the compiled step.
+    This class keeps the reference entry point — it forwards to the module
+    and exposes the same attrs; pair it with HybridMesh(dp=N)."""
+
+    def __init__(self, layers, **kwargs):
+        self._layers = layers
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name == "_layers":  # not yet set (unpickling/copy) — no recursion
+            raise AttributeError(name)
+        return getattr(self._layers, name)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+
+_split_layers: dict = {}
+
+
+def split(x, size, operation="linear", axis=0, gather_out=True, weight_attr=None,
+          bias_attr=None, name=None):
+    """Ref paddle.distributed.split — build a tensor-parallel linear/
+    embedding and apply it. The created layer is RETAINED (keyed by
+    ``name`` or by (operation, size, axis)) and reused on later calls, so
+    its parameters are stable; fetch it with ``get_split_layer`` for
+    training/state_dict. Prefer constructing ColumnParallelLinear /
+    RowParallelLinear / VocabParallelEmbedding directly in new code."""
+    key = name or (operation, tuple(size), axis)
+    layer = _split_layers.get(key)
+    if layer is None:
+        if operation == "linear":
+            cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
+            layer = cls(size[0], size[1])
+        elif operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1])
+        else:
+            raise ValueError(f"unsupported split operation {operation!r}")
+        _split_layers[key] = layer
+    return layer(x)
+
+
+def get_split_layer(name_or_key):
+    """Layer created by ``split`` (see its docstring)."""
+    return _split_layers.get(name_or_key)
+
+
+def spawn(func, args=(), nprocs=1, **kwargs):
+    """Ref paddle.distributed.spawn. On TPU pods process bring-up is done by
+    the launcher (paddle_tpu.distributed.launch / jax.distributed); spawn
+    runs ``func`` once per local process via multiprocessing for CPU tests."""
+    if nprocs == 1:
+        return func(*args)
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=func, args=args) for _ in range(nprocs)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            raise RuntimeError(f"spawned process failed with {p.exitcode}")

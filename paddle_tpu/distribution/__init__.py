@@ -714,3 +714,157 @@ def _kl_laplace(p, q):
     t = jnp.abs(p.loc - q.loc)
     return (jnp.log(q.scale) - jnp.log(p.scale)
             + (p.scale * jnp.exp(-t / p.scale) + t) / q.scale - 1)
+
+
+# -- round-1 audit additions -------------------------------------------------
+
+class ExponentialFamily(Distribution):
+    """Base marker for natural-parameter families (ref exponential_family.py).
+    Subclasses may implement ``_natural_parameters``/``_log_normalizer`` for
+    the Bregman-divergence entropy path; families here implement entropy
+    directly so this is an API-parity base class."""
+
+
+class Binomial(Distribution):
+    """Ref binomial.py: counts of successes in ``total_count`` trials."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = jnp.asarray(total_count, jnp.int32)
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        return jax.random.binomial(_key(rng), self.total_count, self.probs,
+                                   shape=shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        n = self.total_count.astype(jnp.float32)
+        k = value
+        log_comb = gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1)
+        eps = 1e-12
+        return (log_comb + k * jnp.log(self.probs + eps)
+                + (n - k) * jnp.log1p(-self.probs + eps))
+
+
+class Chi2(Gamma):
+    """Ref chi2.py — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df):
+        self.df = jnp.asarray(df, jnp.float32)
+        super().__init__(self.df / 2.0, jnp.asarray(0.5))
+
+
+class ContinuousBernoulli(Distribution):
+    """Ref continuous_bernoulli.py — [0, 1]-supported exponential family
+    with pdf C(lam) lam^x (1-lam)^(1-x)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        self.lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        return (self.probs < self.lims[0]) | (self.probs > self.lims[1])
+
+    def _log_norm(self):
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.25)
+        out = jnp.log((jnp.log1p(-safe) - jnp.log(safe))
+                      / (1 - 2 * safe))
+        # Taylor around lam=1/2: log 2 + 4/3 (lam - 1/2)^2 + ...
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * (lam - 0.5) ** 2
+        return jnp.where(self._outside(), out, taylor)
+
+    def log_prob(self, value):
+        lam = self.probs
+        eps = 1e-12
+        return (value * jnp.log(lam + eps)
+                + (1 - value) * jnp.log1p(-lam + eps) + self._log_norm())
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(rng), shape, minval=1e-6, maxval=1 - 1e-6)
+        lam = jnp.broadcast_to(self.probs, shape)
+        safe = jnp.where(self._outside(), lam, 0.25)
+        icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return jnp.where(self._outside(), icdf, u)
+
+    @property
+    def mean(self):
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.25)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return jnp.where(self._outside(), m, 0.5 + (lam - 0.5) / 3.0)
+
+
+class MultivariateNormal(Distribution):
+    """Ref multivariate_normal.py — full-covariance Gaussian; sampling and
+    log_prob ride a single cholesky + triangular solve (MXU-friendly)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        if scale_tril is not None:
+            self.scale_tril = jnp.asarray(scale_tril, jnp.float32)
+        else:
+            self.scale_tril = jnp.linalg.cholesky(
+                jnp.asarray(covariance_matrix, jnp.float32))
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return self.scale_tril @ jnp.swapaxes(self.scale_tril, -1, -2)
+
+    @property
+    def variance(self):
+        return jnp.sum(self.scale_tril ** 2, axis=-1)
+
+    def rsample(self, shape=(), rng=None):
+        shape = _shape(shape) + self.batch_shape + self.event_shape
+        z = jax.random.normal(_key(rng), shape, jnp.float32)
+        return self.loc + jnp.einsum("...ij,...j->...i", self.scale_tril, z)
+
+    def log_prob(self, value):
+        d = self.event_shape[0]
+        diff = value - self.loc
+        y = jax.scipy.linalg.solve_triangular(
+            self.scale_tril, diff[..., None], lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        return (-0.5 * jnp.sum(y ** 2, axis=-1) - half_logdet
+                - 0.5 * d * jnp.log(2 * jnp.pi))
+
+    def entropy(self):
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        return 0.5 * d * (1 + jnp.log(2 * jnp.pi)) + half_logdet
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = p.event_shape[0]
+    lp, lq = p.scale_tril, q.scale_tril
+    m = jax.scipy.linalg.solve_triangular(lq, lp, lower=True)
+    tr = jnp.sum(m ** 2, axis=(-2, -1))
+    diff = q.loc - p.loc
+    y = jax.scipy.linalg.solve_triangular(lq, diff[..., None], lower=True)[..., 0]
+    maha = jnp.sum(y ** 2, axis=-1)
+    logdet = (jnp.sum(jnp.log(jnp.diagonal(lq, axis1=-2, axis2=-1)), -1)
+              - jnp.sum(jnp.log(jnp.diagonal(lp, axis1=-2, axis2=-1)), -1))
+    return 0.5 * (tr + maha - d) + logdet

@@ -9,7 +9,32 @@ from paddle_tpu.ops import (fused_dropout_add, fused_layer_norm, fused_linear,
 from paddle_tpu.ops.attention import (flash_attention,
                                       fused_rotary_position_embedding)
 
+def masked_multihead_attention(x, cache_k, cache_v, pos, num_heads,
+                               window=None):
+    """Single-step decode attention with an in-place-style KV cache update
+    (ref incubate.nn.functional.masked_multihead_attention — the fused
+    decode kernel behind fused_multi_transformer).
+
+    TPU shape convention: ``x`` is the fused qkv for ONE step,
+    [B, (3*H)*D]; ``cache_k/v`` are [B, max_len, H, D]; ``pos`` is the
+    write position (traced int). Returns (out [B, H*D], new_k, new_v).
+    The causal mask over the cache is implicit (keys <= pos)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.decoding import _attend_with_cache
+
+    b = x.shape[0]
+    h = num_heads
+    d = cache_k.shape[-1]
+    q, k, v = jnp.split(x.reshape(b, 3 * h, d), 3, axis=1)
+    out, new_k, new_v = _attend_with_cache(
+        q[:, None, :, :].reshape(b, 1, h, d), cache_k, cache_v,
+        k.reshape(b, 1, h, d), v.reshape(b, 1, h, d), pos, window=window)
+    return out.reshape(b, h * d), new_k, new_v
+
+
 functional = SimpleNamespace(
+    masked_multihead_attention=masked_multihead_attention,
     fused_rms_norm=fused_rms_norm,
     fused_layer_norm=fused_layer_norm,
     fused_linear=fused_linear,

@@ -1,4 +1,7 @@
 from paddle_tpu.io.dataset import (
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
     Dataset,
     IterableDataset,
     Subset,
@@ -11,6 +14,8 @@ from paddle_tpu.io.sampler import (
     RandomSampler,
     Sampler,
     SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
 )
 from paddle_tpu.io.dataloader import (DataLoader, WorkerInfo,
                                       default_collate_fn, get_worker_info)

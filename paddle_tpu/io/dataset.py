@@ -77,3 +77,29 @@ class ChainDataset(IterableDataset):
     def __iter__(self):
         for d in self.datasets:
             yield from d
+
+
+class ConcatDataset(Dataset):
+    """Ref dataset.py:ConcatDataset — end-to-end concatenation."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1] if self.cumulative_sizes else 0
+
+    def __getitem__(self, idx):
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(f"index {idx} out of range for length {n}")
+        import bisect
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[di - 1] if di else 0
+        return self.datasets[di][idx - prev]

@@ -111,3 +111,46 @@ class DistributedBatchSampler(BatchSampler):
         if self.drop_last:
             return local // self.batch_size
         return (local + self.batch_size - 1) // self.batch_size
+
+
+class WeightedRandomSampler(Sampler):
+    """Ref sampler.py:WeightedRandomSampler — sample indices with given
+    per-index weights."""
+
+    def __init__(self, weights, num_samples, replacement=True, seed=None):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+        self.seed = seed
+        self.epoch = 0
+
+    def __iter__(self):
+        rng = np.random.RandomState(
+            None if self.seed is None else self.seed + self.epoch)
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(self.weights), self.num_samples,
+                         replace=self.replacement, p=p)
+        self.epoch += 1
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Ref sampler.py:SubsetRandomSampler — permutation over given indices."""
+
+    def __init__(self, indices, seed=None):
+        self.indices = list(indices)
+        self.seed = seed
+        self.epoch = 0
+
+    def __iter__(self):
+        rng = np.random.RandomState(
+            None if self.seed is None else self.seed + self.epoch)
+        self.epoch += 1
+        for i in rng.permutation(len(self.indices)):
+            yield self.indices[i]
+
+    def __len__(self):
+        return len(self.indices)

@@ -262,3 +262,55 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
 
 def corrcoef(x, rowvar=True):
     return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Ref linalg.ormqr: multiply ``y`` by the implicit Q of the
+    householder factors ``(x, tau)`` (geqrf layout). Reflectors are applied
+    directly — k rank-1 updates, no m x m Q materialisation."""
+    m, k = x.shape[-2], x.shape[-1]
+    rows = jnp.arange(m)
+
+    def reflector(i):
+        v = jnp.where(rows < i, 0.0,
+                      jnp.where(rows == i, 1.0, x[..., :, i]))
+        return v, tau[..., i]
+
+    # Q = H_0 H_1 ... H_{k-1}; batch dims broadcast through the einsums
+    if (left and transpose) or (not left and not transpose):
+        order = range(k)
+    else:
+        order = range(k - 1, -1, -1)
+    out = y
+    for i in order:
+        v, t = reflector(i)
+        t = t[..., None, None]
+        if left:
+            proj = jnp.einsum("...m,...mn->...n", v, out)
+            out = out - t * v[..., :, None] * proj[..., None, :]
+        else:
+            proj = jnp.einsum("...nm,...m->...n", out, v)
+            out = out - t * proj[..., :, None] * v[..., None, :]
+    return out
+
+
+def svd_lowrank(x, q=6, niter=2, M=None):
+    """Ref linalg.svd_lowrank — randomized low-rank SVD (Halko et al.):
+    subspace iteration with QR re-orthonormalisation; all matmul/QR, so it
+    maps straight onto the MXU. Deterministic under the global seed."""
+    from paddle_tpu.core.random import next_key
+    if M is not None:
+        x = x - M
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(q, m, n)
+    g = jax.random.normal(next_key(), x.shape[:-2] + (n, k), jnp.float32)
+    y = x @ g
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z = jnp.swapaxes(x, -1, -2) @ qmat
+        z, _ = jnp.linalg.qr(z)
+        y = x @ z
+        qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2) @ x
+    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u_b, s, jnp.swapaxes(vh, -1, -2)

@@ -1233,21 +1233,9 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     """Ref functional/common.py:pad. ``pad`` pairs apply to the LAST dims
     first ([l, r] -> last dim; [l, r, t, b] -> last two dims, ...); when
     len(pad) == 2*ndim it is per-dim pairs in dim order like jnp.pad."""
-    pad = list(pad)
-    if len(pad) == 2 * x.ndim:
-        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
-    else:
-        # short form applies to spatial dims from the innermost outwards;
-        # channel-last formats (NLC/NHWC/NDHWC) skip the trailing C axis
-        last = x.ndim - 2 if data_format.endswith("C") else x.ndim - 1
-        pairs = [(0, 0)] * x.ndim
-        for i in range(len(pad) // 2):
-            pairs[last - i] = (pad[2 * i], pad[2 * i + 1])
-    if mode == "constant":
-        return jnp.pad(x, pairs, constant_values=value)
-    jmode = {"reflect": "reflect", "replicate": "edge",
-             "circular": "wrap"}[mode]
-    return jnp.pad(x, pairs, mode=jmode)
+    from paddle_tpu.tensor import pad as _tensor_pad
+    return _tensor_pad(x, list(pad), mode=mode, value=value,
+                       data_format=data_format)
 
 
 def zeropad2d(x, padding, data_format="NCHW"):
@@ -1332,6 +1320,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     """
     x = input
     b, dim = x.shape
+    label = jnp.reshape(label, (-1,))  # accept [N] or the documented [N, 1]
     if path_table is not None:
         codes = path_code
         nodes = path_table

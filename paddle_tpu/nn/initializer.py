@@ -137,3 +137,45 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4
     return 1.0
+
+
+class Dirac(Initializer):
+    """Dirac delta for conv kernels (ref initializer/dirac.py): preserves
+    channel identity through the conv — weight[i, i % in_c, center...] = 1,
+    with ``groups`` replicating the identity per group."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None, key=None):
+        dtype = dtype or get_default_dtype()
+        assert len(shape) >= 3, "Dirac needs a conv kernel [out, in, *k]"
+        out_c, in_c = shape[0], shape[1]
+        w = jnp.zeros(shape, jnp.float32)
+        centers = tuple(s // 2 for s in shape[2:])
+        og = out_c // self.groups
+        # per group, only the first min(og, in_c) out channels carry the
+        # identity; surplus out channels stay ZERO (reference dirac_)
+        per = min(og, in_c)
+        idx_out = jnp.concatenate([
+            jnp.arange(per) + g * og for g in range(self.groups)])
+        idx_in = jnp.tile(jnp.arange(per), self.groups)
+        w = w.at[(idx_out, idx_in) + tuple(
+            jnp.full((per * self.groups,), c) for c in centers)].set(1.0)
+        return w.astype(dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Ref initializer/set_global_initializer: default initializers used by
+    layers when none is passed. Layers consult ``get_global_initializer``."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def get_global_initializer():
+    return _global_weight_init, _global_bias_init

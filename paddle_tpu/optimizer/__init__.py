@@ -152,6 +152,15 @@ class Optimizer:
             return lr.value_at(state["step"])
         return jnp.asarray(lr, jnp.float32)
 
+    def set_lr(self, value):
+        """Ref Optimizer.set_lr — override the current learning rate (only
+        valid with a float lr, matching the reference's restriction)."""
+        if isinstance(self.learning_rate, LRScheduler):
+            raise RuntimeError(
+                "set_lr is not allowed when the lr is an LRScheduler "
+                "(reference behavior); mutate the scheduler instead")
+        self.learning_rate = float(value)
+
     def get_lr(self, state=None):
         if isinstance(self.learning_rate, LRScheduler):
             if state is not None:

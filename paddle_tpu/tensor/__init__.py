@@ -628,13 +628,19 @@ def cast(x, dtype):
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
-    """Paddle pad: `pad` is per-axis (low, high) pairs from the LAST axis
-    backwards when len(pad) < 2*ndim (torch convention adopted by paddle)."""
-    n = len(pad) // 2
-    # pairs apply from the LAST axis backwards: pad[0:2]→axis -1, pad[2:4]→axis -2, ...
-    cfg = [(0, 0)] * x.ndim
-    for i in range(n):
-        cfg[x.ndim - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+    """Paddle pad. Short form: (low, high) pairs apply from the LAST spatial
+    axis backwards (torch convention adopted by paddle); channel-last
+    formats (NLC/NHWC/NDHWC) skip the trailing C axis. Full form
+    (len == 2*ndim): per-dim pairs in dim order."""
+    pad = list(pad)
+    if len(pad) == 2 * x.ndim:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        last = x.ndim - 2 if (data_format and data_format.endswith("C")
+                              and x.ndim >= 3) else x.ndim - 1
+        cfg = [(0, 0)] * x.ndim
+        for i in range(len(pad) // 2):
+            cfg[last - i] = (pad[2 * i], pad[2 * i + 1])
     if mode == "constant":
         return jnp.pad(x, cfg, constant_values=value)
     jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]

@@ -1,0 +1,164 @@
+"""io datasets/samplers, distribution families, amp helpers, linalg
+ormqr/svd_lowrank — round-1 audit additions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_concat_dataset():
+    from paddle_tpu.io import ConcatDataset, TensorDataset
+    a = TensorDataset(jnp.arange(3.0))
+    b = TensorDataset(jnp.arange(5.0) + 10)
+    cd = ConcatDataset([a, b])
+    assert len(cd) == 8
+    assert float(cd[2][0]) == 2.0
+    assert float(cd[3][0]) == 10.0
+    assert float(cd[-1][0]) == 14.0
+
+
+def test_weighted_random_sampler():
+    from paddle_tpu.io import WeightedRandomSampler
+    s = WeightedRandomSampler([0.0, 0.0, 1.0, 1.0], 100, seed=0)
+    idx = list(s)
+    assert len(idx) == 100 and set(idx) <= {2, 3}
+
+
+def test_subset_random_sampler():
+    from paddle_tpu.io import SubsetRandomSampler
+    s = SubsetRandomSampler([5, 7, 9], seed=0)
+    assert sorted(list(s)) == [5, 7, 9]
+
+
+def test_binomial():
+    from paddle_tpu.distribution import Binomial
+    import scipy.stats as st
+    d = Binomial(10, 0.3)
+    np.testing.assert_allclose(float(d.mean), 3.0, rtol=1e-6)
+    lp = float(d.log_prob(jnp.asarray(4.0)))
+    np.testing.assert_allclose(lp, st.binom.logpmf(4, 10, 0.3), rtol=1e-5)
+    s = d.sample((1000,), rng=jax.random.PRNGKey(0))
+    assert 2.0 < float(s.mean()) < 4.0
+
+
+def test_chi2():
+    from paddle_tpu.distribution import Chi2
+    import scipy.stats as st
+    d = Chi2(5.0)
+    np.testing.assert_allclose(float(d.mean), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(float(d.log_prob(jnp.asarray(3.0))),
+                               st.chi2.logpdf(3.0, 5), rtol=1e-5)
+
+
+def test_continuous_bernoulli():
+    from paddle_tpu.distribution import ContinuousBernoulli
+    d = ContinuousBernoulli(0.3)
+    # pdf integrates to ~1
+    xs = np.linspace(1e-4, 1 - 1e-4, 2001)
+    pdf = np.exp(np.asarray(d.log_prob(jnp.asarray(xs, jnp.float32))))
+    np.testing.assert_allclose(np.trapezoid(pdf, xs), 1.0, atol=1e-3)
+    s = d.rsample((2000,), rng=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(s.mean()), float(d.mean), atol=0.03)
+    # near-1/2 limit is stable
+    d2 = ContinuousBernoulli(0.5)
+    assert np.isfinite(float(d2.log_prob(jnp.asarray(0.3))))
+
+
+def test_multivariate_normal():
+    from paddle_tpu.distribution import MultivariateNormal, kl_divergence
+    import scipy.stats as st
+    mu = np.array([1.0, -1.0], np.float32)
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    d = MultivariateNormal(mu, covariance_matrix=cov)
+    x = np.array([0.5, 0.2], np.float32)
+    np.testing.assert_allclose(float(d.log_prob(jnp.asarray(x))),
+                               st.multivariate_normal.logpdf(x, mu, cov),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               st.multivariate_normal(mu, cov).entropy(),
+                               rtol=1e-5)
+    s = d.rsample((4000,), rng=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.cov(np.asarray(s).T), cov, atol=0.15)
+    # KL(p, p) == 0
+    assert abs(float(kl_divergence(d, d))) < 1e-5
+
+
+def test_amp_supported_helpers():
+    import paddle_tpu.amp as amp
+    assert amp.is_bfloat16_supported() is True
+    assert isinstance(amp.is_float16_supported(), bool)
+
+
+def test_ormqr():
+    import paddle_tpu.linalg as L
+    import torch
+    rs = np.random.RandomState(0)
+    a = rs.randn(5, 3).astype(np.float32)
+    c = rs.randn(5, 2).astype(np.float32)
+    h, tau = torch.geqrf(torch.tensor(a))
+    want = torch.ormqr(h, tau, torch.tensor(c)).numpy()
+    got = L.ormqr(jnp.asarray(h.numpy()), jnp.asarray(tau.numpy()),
+                  jnp.asarray(c))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_svd_lowrank_recovers_low_rank():
+    import paddle_tpu.linalg as L
+    pt.seed(0)
+    rs = np.random.RandomState(0)
+    base = rs.randn(20, 3).astype(np.float32) @ rs.randn(3, 15).astype(np.float32)
+    u, s, v = L.svd_lowrank(jnp.asarray(base), q=5)
+    approx = np.asarray(u) * np.asarray(s) @ np.asarray(v).T
+    np.testing.assert_allclose(approx, base, atol=1e-3)
+
+
+def test_concat_dataset_out_of_range():
+    from paddle_tpu.io import ConcatDataset, TensorDataset
+    cd = ConcatDataset([TensorDataset(jnp.arange(3.0))])
+    with pytest.raises(IndexError):
+        cd[3]
+    with pytest.raises(IndexError):
+        cd[-4]
+
+
+def test_ormqr_batched():
+    import paddle_tpu.linalg as L
+    import torch
+    rs = np.random.RandomState(1)
+    a = rs.randn(3, 5, 4).astype(np.float32)
+    c = rs.randn(3, 5, 2).astype(np.float32)
+    h, tau = torch.geqrf(torch.tensor(a))
+    want = torch.ormqr(h, tau, torch.tensor(c)).numpy()
+    got = L.ormqr(jnp.asarray(h.numpy()), jnp.asarray(tau.numpy()),
+                  jnp.asarray(c))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_global_bias_initializer_applies_to_conv():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.initializer as I
+    I.set_global_initializer(I.Constant(2.0), I.Constant(1.0))
+    try:
+        conv = nn.Conv2D(2, 3, 3)
+        assert float(conv.bias.min()) == 1.0
+        assert float(conv.weight.min()) == 2.0
+    finally:
+        I.set_global_initializer(None, None)
+
+
+def test_parallel_env_consistent_with_get_world_size():
+    import paddle_tpu.distributed as D
+    assert D.ParallelEnv().world_size == D.get_world_size()
+
+
+def test_data_parallel_pickle_roundtrip():
+    import pickle
+    import paddle_tpu.distributed as D
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    dp = D.DataParallel(nn.Linear(2, 2))
+    dp2 = pickle.loads(pickle.dumps(dp))
+    x = jnp.ones((1, 2))
+    np.testing.assert_allclose(np.asarray(dp2(x)), np.asarray(dp(x)))

@@ -96,7 +96,9 @@ def test_random_reproducible():
 def test_pad_and_where():
     x = jnp.ones((2, 3))
     assert pt.pad(x, [1, 1], value=0.0).shape == (2, 5)
-    assert pt.pad(x, [1, 1, 2, 2], value=0.0).shape == (6, 5)
+    # full-form (len == 2*ndim): per-dim pairs in DIM order (reference
+    # convention: "padding starts from the first dimension")
+    assert pt.pad(x, [1, 1, 2, 2], value=0.0).shape == (4, 7)
     out = pt.where(x > 0, x, -x)
     np.testing.assert_allclose(np.asarray(out), np.ones((2, 3)))
     np.testing.assert_allclose(np.asarray(pt.masked_fill(x, x > 0, 5.0)), np.full((2, 3), 5.0))
