@@ -211,13 +211,12 @@ _split_layers: dict = {}
 def split(x, size, operation="linear", axis=0, gather_out=True, weight_attr=None,
           bias_attr=None, name=None):
     """Ref paddle.distributed.split — build a tensor-parallel linear/
-    embedding and apply it. The created layer is RETAINED (keyed by
-    ``name`` or by (operation, size, axis)) and reused on later calls, so
-    its parameters are stable; fetch it with ``get_split_layer`` for
-    training/state_dict. Prefer constructing ColumnParallelLinear /
-    RowParallelLinear / VocabParallelEmbedding directly in new code."""
-    key = name or (operation, tuple(size), axis)
-    layer = _split_layers.get(key)
+    embedding and apply it. Like the reference, every unnamed call creates
+    FRESH parameters; pass ``name=`` to retain the layer across calls and
+    fetch it with ``get_split_layer`` for training/state_dict. Prefer
+    constructing ColumnParallelLinear / RowParallelLinear /
+    VocabParallelEmbedding directly in new code."""
+    layer = _split_layers.get(name) if name is not None else None
     if layer is None:
         if operation == "linear":
             cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
@@ -226,7 +225,8 @@ def split(x, size, operation="linear", axis=0, gather_out=True, weight_attr=None
             layer = VocabParallelEmbedding(size[0], size[1])
         else:
             raise ValueError(f"unsupported split operation {operation!r}")
-        _split_layers[key] = layer
+        if name is not None:  # unnamed calls get fresh params (reference)
+            _split_layers[name] = layer
     return layer(x)
 
 

@@ -268,30 +268,27 @@ def ormqr(x, tau, y, left=True, transpose=False):
     """Ref linalg.ormqr: multiply ``y`` by the implicit Q of the
     householder factors ``(x, tau)`` (geqrf layout). Reflectors are applied
     directly — k rank-1 updates, no m x m Q materialisation."""
+    from jax import lax as _lax
+
     m, k = x.shape[-2], x.shape[-1]
     rows = jnp.arange(m)
+    forward = (left and transpose) or (not left and not transpose)
 
-    def reflector(i):
-        v = jnp.where(rows < i, 0.0,
-                      jnp.where(rows == i, 1.0, x[..., :, i]))
-        return v, tau[..., i]
-
-    # Q = H_0 H_1 ... H_{k-1}; batch dims broadcast through the einsums
-    if (left and transpose) or (not left and not transpose):
-        order = range(k)
-    else:
-        order = range(k - 1, -1, -1)
-    out = y
-    for i in order:
-        v, t = reflector(i)
-        t = t[..., None, None]
+    def body(step, out):
+        # Q = H_0 H_1 ... H_{k-1}; iterate in the order Q (or Q^T) applies
+        i = step if forward else k - 1 - step
+        col = _lax.dynamic_index_in_dim(x, i, axis=-1, keepdims=False)
+        v = jnp.where(rows < i, 0.0, jnp.where(rows == i, 1.0, col))
+        t = _lax.dynamic_index_in_dim(tau, i, axis=-1,
+                                      keepdims=False)[..., None, None]
         if left:
             proj = jnp.einsum("...m,...mn->...n", v, out)
-            out = out - t * v[..., :, None] * proj[..., None, :]
-        else:
-            proj = jnp.einsum("...nm,...m->...n", out, v)
-            out = out - t * proj[..., :, None] * v[..., None, :]
-    return out
+            return out - t * v[..., :, None] * proj[..., None, :]
+        proj = jnp.einsum("...nm,...m->...n", out, v)
+        return out - t * proj[..., :, None] * v[..., None, :]
+
+    # one traced body, k sequential steps — trace size O(1) in k
+    return _lax.fori_loop(0, k, body, y)
 
 
 def svd_lowrank(x, q=6, niter=2, M=None):

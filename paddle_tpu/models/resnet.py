@@ -154,6 +154,11 @@ def resnext152_32x4d(num_classes=1000, **kw):
                   groups=32, width_per_group=4, **kw)
 
 
+def resnext152_64x4d(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes,
+                  groups=64, width_per_group=4, **kw)
+
+
 def wide_resnet50_2(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
                   width_per_group=128, **kw)

@@ -179,3 +179,16 @@ def set_global_initializer(weight_init, bias_init=None):
 
 def get_global_initializer():
     return _global_weight_init, _global_bias_init
+
+
+def default_weight_init(explicit, fallback):
+    """Resolution order for a layer weight: explicit arg > global > layer
+    default (the reference's create_parameter behavior). Layers whose
+    reference counterpart passes an EXPLICIT initializer (BatchNorm/
+    LayerNorm ones, PReLU 0.25, ...) keep it and are unaffected by the
+    global default, matching the reference."""
+    return explicit or _global_weight_init or fallback
+
+
+def default_bias_init(fallback):
+    return _global_bias_init or fallback

@@ -66,11 +66,10 @@ class Linear(Module):
                  weight_init: Optional[I.Initializer] = None, dtype=None):
         super().__init__()
         dtype = dtype or get_default_dtype()
-        gw, gb = I.get_global_initializer()
-        init = weight_init or gw or I.XavierNormal()
+        init = I.default_weight_init(weight_init, I.XavierNormal())
         self.weight = init((in_features, out_features), dtype)
-        bias_init = gb or I.Constant(0.0)
-        self.bias = bias_init((out_features,), dtype) if bias_attr else None
+        self.bias = (I.default_bias_init(I.Constant(0.0))((out_features,), dtype)
+                     if bias_attr else None)
         self.in_features, self.out_features = in_features, out_features
 
     def __call__(self, x):
@@ -102,8 +101,7 @@ class Embedding(Module):
                  weight_init: Optional[I.Initializer] = None, dtype=None):
         super().__init__()
         dtype = dtype or get_default_dtype()
-        gw, _ = I.get_global_initializer()
-        init = weight_init or gw or I.Normal(0.0, 1.0)
+        init = I.default_weight_init(weight_init, I.Normal(0.0, 1.0))
         self.weight = init((num_embeddings, embedding_dim), dtype)
         self.padding_idx = padding_idx
         self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
@@ -282,10 +280,9 @@ class _ConvNd(Module):
         dtype = dtype or get_default_dtype()
         k = (kernel_size,) * nd if isinstance(kernel_size, int) else tuple(kernel_size)
         shape = (out_channels, in_channels // groups) + k
-        gw, gb = I.get_global_initializer()
-        init = weight_init or gw or I.KaimingUniform()
+        init = I.default_weight_init(weight_init, I.KaimingUniform())
         self.weight = init(shape, dtype)
-        self.bias = ((gb or I.Constant(0.0))((out_channels,), dtype)
+        self.bias = (I.default_bias_init(I.Constant(0.0))((out_channels,), dtype)
                      if bias_attr else None)
         self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
         self.in_channels, self.out_channels = in_channels, out_channels
@@ -324,10 +321,9 @@ class Conv2DTranspose(Module):
         super().__init__()
         dtype = dtype or get_default_dtype()
         k = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(kernel_size)
-        gw, gb = I.get_global_initializer()
-        self.weight = (gw or I.KaimingUniform())(
+        self.weight = I.default_weight_init(None, I.KaimingUniform())(
             (in_channels, out_channels // groups) + k, dtype)
-        self.bias = ((gb or I.Constant(0.0))((out_channels,), dtype)
+        self.bias = (I.default_bias_init(I.Constant(0.0))((out_channels,), dtype)
                      if bias_attr else None)
         self.stride, self.padding, self.output_padding = stride, padding, output_padding
         self.dilation, self.groups = dilation, groups
@@ -773,10 +769,9 @@ class Conv1DTranspose(Module):
         super().__init__()
         dtype = dtype or get_default_dtype()
         k = (kernel_size,) if isinstance(kernel_size, int) else tuple(kernel_size)
-        gw, gb = I.get_global_initializer()
-        self.weight = (gw or I.KaimingUniform())(
+        self.weight = I.default_weight_init(None, I.KaimingUniform())(
             (in_channels, out_channels // groups) + k, dtype)
-        self.bias = ((gb or I.Constant(0.0))((out_channels,), dtype)
+        self.bias = (I.default_bias_init(I.Constant(0.0))((out_channels,), dtype)
                      if bias_attr else None)
         self.stride, self.padding, self.output_padding = stride, padding, output_padding
         self.dilation, self.groups = dilation, groups
@@ -794,10 +789,9 @@ class Conv3DTranspose(Module):
         super().__init__()
         dtype = dtype or get_default_dtype()
         k = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
-        gw, gb = I.get_global_initializer()
-        self.weight = (gw or I.KaimingUniform())(
+        self.weight = I.default_weight_init(None, I.KaimingUniform())(
             (in_channels, out_channels // groups) + k, dtype)
-        self.bias = ((gb or I.Constant(0.0))((out_channels,), dtype)
+        self.bias = (I.default_bias_init(I.Constant(0.0))((out_channels,), dtype)
                      if bias_attr else None)
         self.stride, self.padding, self.output_padding = stride, padding, output_padding
         self.dilation, self.groups = dilation, groups

@@ -7,3 +7,4 @@ from paddle_tpu.utils.profiler import (
 )
 from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip, check_finite
 from paddle_tpu.utils import dlpack
+from paddle_tpu.utils import cpp_extension

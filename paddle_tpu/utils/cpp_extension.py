@@ -1,0 +1,166 @@
+"""Custom C++ operators (ref capability: ``python/paddle/utils/cpp_extension/``
+— CppExtension / load, the reference's compile-your-own-op story).
+
+TPU-native split of responsibilities:
+  * DEVICE compute belongs in Pallas (see ``paddle_tpu/ops/pallas``) — a
+    C++ kernel cannot run on a TPU core.
+  * HOST-side custom ops (the reference's CPU custom-op path: lookups,
+    tokenization, custom samplers, legacy C++ math) compile here with
+    ``g++`` and enter jitted programs through ``jax.pure_callback``, so a
+    compiled step can call into native code at trace-defined points.
+
+C ABI convention (documented to extension authors):
+    extern "C" void <name>(const float** ins, const long long* sizes,
+                           int n_ins, float* out, long long out_size);
+Inputs arrive as contiguous fp32 buffers with their element counts; the
+output buffer is pre-allocated by the caller from ``out_shape``. A
+gradient op named ``<name>_grad`` with the same ABI (inputs = primal
+inputs + upstream cotangent, output = input cotangent) is wired into a
+``jax.custom_vjp`` automatically when present.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from types import SimpleNamespace
+
+import numpy as np
+
+
+def _compile(sources, name, extra_cflags=None, build_directory=None,
+             verbose=False):
+    build = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build, exist_ok=True)
+    srcs = []
+    for s in sources:
+        if os.path.exists(s):
+            srcs.append(os.path.abspath(s))
+        else:  # inline source string
+            digest = hashlib.sha1(s.encode()).hexdigest()[:12]
+            path = os.path.join(build, f"{name}_{digest}.cpp")
+            with open(path, "w") as f:
+                f.write(s)
+            srcs.append(path)
+    tag = hashlib.sha1((name + "|" + "|".join(extra_cflags or []) + "|"
+                        + "".join(open(s).read() for s in srcs))
+                       .encode()).hexdigest()[:12]
+    lib_path = os.path.join(build, f"lib{name}_{tag}.so")
+    if not os.path.exists(lib_path):
+        cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+               + (extra_cflags or []) + srcs + ["-o", lib_path])
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return lib_path
+
+
+_ABI = None  # lazy ctypes signature
+
+
+def _bind(lib, fname):
+    fn = getattr(lib, fname)
+    fn.restype = None
+    fn.argtypes = [ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                   ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+                   ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+    return fn
+
+
+def _call(cfn, arrays, out_shape):
+    arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+    ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+        *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for a in arrays])
+    sizes = (ctypes.c_longlong * len(arrays))(*[a.size for a in arrays])
+    out = np.empty(out_shape, np.float32)
+    cfn(ptrs, sizes, len(arrays),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size)
+    return out
+
+
+def load(name, sources, functions, extra_cflags=None, build_directory=None,
+         verbose=False):
+    """Compile ``sources`` (paths or inline strings) and expose ``functions``.
+
+    ``functions``: dict op_name -> out_shape_fn(*input_shapes) (or None for
+    same-shape-as-first-input). Returns a namespace of jit-compatible
+    callables; ops with an exported ``<name>_grad`` sibling get a VJP.
+
+    Differentiation contract: the ``_grad`` ABI produces the cotangent of
+    the FIRST input only — remaining inputs are treated as constants
+    (zero cotangent, like ``stop_gradient``); a warning records this at
+    load time so a silently-unused gradient is traceable.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    lib_path = _compile(sources, name, extra_cflags, build_directory, verbose)
+    lib = ctypes.CDLL(lib_path)
+    ops = {}
+    for fname, out_shape_fn in functions.items():
+        cfn = _bind(lib, fname)
+        shape_of = out_shape_fn or (lambda *shapes: shapes[0])
+
+        def make(cfn=cfn, shape_of=shape_of, fname=fname):
+            def host(*arrays):
+                return _call(cfn, arrays,
+                             shape_of(*[a.shape for a in arrays]))
+
+            def op(*args):
+                out_shape = shape_of(*[jnp.shape(a) for a in args])
+                return jax.pure_callback(
+                    host, jax.ShapeDtypeStruct(tuple(out_shape), jnp.float32),
+                    *args, vmap_method="sequential")
+
+            grad_name = fname + "_grad"
+            if hasattr(lib, grad_name):
+                import warnings
+                warnings.warn(
+                    f"custom op {fname!r}: {grad_name} provides the "
+                    "cotangent of the FIRST input only; other inputs are "
+                    "treated as constants (zero gradient)", stacklevel=2)
+                gfn = _bind(lib, grad_name)
+
+                @jax.custom_vjp
+                def op_vjp(*args):
+                    return op(*args)
+
+                def fwd(*args):
+                    return op(*args), args
+
+                def bwd(res, g):
+                    def ghost(*arrays):
+                        return _call(gfn, arrays, arrays[0].shape)
+                    gx = jax.pure_callback(
+                        ghost,
+                        jax.ShapeDtypeStruct(jnp.shape(res[0]), jnp.float32),
+                        *res, g, vmap_method="sequential")
+                    # cotangent for the first input; others get zeros
+                    return (gx,) + tuple(
+                        jnp.zeros(jnp.shape(r), jnp.float32) for r in res[1:])
+
+                op_vjp.defvjp(fwd, bwd)
+                return op_vjp
+            return op
+
+        ops[fname] = make()
+    return SimpleNamespace(_lib_path=lib_path, **ops)
+
+
+class CppExtension:
+    """Ref cpp_extension.CppExtension — a (name, sources) build spec for
+    ``setup``/``load``. Kept as a thin record; ``load`` does the work."""
+
+    def __init__(self, sources, name=None, extra_compile_args=None, **kw):
+        self.sources = sources
+        self.name = name
+        self.extra_compile_args = extra_compile_args or []
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is CUDA-only; on TPU write device kernels in Pallas "
+        "(paddle_tpu/ops/pallas) and host ops via CppExtension/load")

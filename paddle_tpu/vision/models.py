@@ -16,6 +16,7 @@ from paddle_tpu.models.resnet import (  # noqa: F401
     resnext101_32x4d,
     resnext101_64x4d,
     resnext152_32x4d,
+    resnext152_64x4d,
     wide_resnet50_2,
     wide_resnet101_2,
 )
@@ -72,6 +73,14 @@ class VGG(Module):
 def _relu():
     from paddle_tpu.nn.layers import ReLU
     return ReLU()
+
+
+def vgg11(num_classes=1000, **kw):
+    return VGG(11, num_classes, **kw)
+
+
+def vgg13(num_classes=1000, **kw):
+    return VGG(13, num_classes, **kw)
 
 
 def vgg16(num_classes=1000, **kw):

@@ -29,7 +29,8 @@ __all__ = [
     "alexnet", "squeezenet1_0", "squeezenet1_1", "densenet121", "densenet161",
     "densenet169", "densenet201", "densenet264", "googlenet", "inception_v3",
     "shufflenet_v2_x0_25", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
-    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "mobilenet_v1",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+    "mobilenet_v1",
     "mobilenet_v3_small", "mobilenet_v3_large",
 ]
 
@@ -50,6 +51,8 @@ class _ConvBN(Module):
             return F.relu6(x)
         if self.act == "hardswish":
             return F.hardswish(x)
+        if self.act == "swish":
+            return F.silu(x)
         return x
 
 
@@ -363,21 +366,21 @@ class InceptionV3(Module):
 
 
 class _ShuffleUnit(Module):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_c = out_c // 2
         if stride == 2:
             self.b1_dw = _ConvBN(in_c, in_c, 3, stride=2, padding=1,
                                  groups=in_c, act=None)
-            self.b1_pw = _ConvBN(in_c, branch_c, 1)
+            self.b1_pw = _ConvBN(in_c, branch_c, 1, act=act)
             in_main = in_c
         else:
             in_main = in_c // 2
-        self.b2_pw1 = _ConvBN(in_main, branch_c, 1)
+        self.b2_pw1 = _ConvBN(in_main, branch_c, 1, act=act)
         self.b2_dw = _ConvBN(branch_c, branch_c, 3, stride=stride, padding=1,
                              groups=branch_c, act=None)
-        self.b2_pw2 = _ConvBN(branch_c, branch_c, 1)
+        self.b2_pw2 = _ConvBN(branch_c, branch_c, 1, act=act)
 
     def __call__(self, x):
         if self.stride == 2:
@@ -400,19 +403,19 @@ _SHUFFLE_CFGS = {
 class ShuffleNetV2(Module):
     """Ref: python/paddle/vision/models/shufflenetv2.py."""
 
-    def __init__(self, scale=1.0, num_classes=1000):
+    def __init__(self, scale=1.0, num_classes=1000, act="relu"):
         super().__init__()
         c0, c1, c2, c3, c_last = _SHUFFLE_CFGS[scale]
-        self.stem = _ConvBN(3, c0, 3, stride=2, padding=1)
+        self.stem = _ConvBN(3, c0, 3, stride=2, padding=1, act=act)
         blocks = []
         in_c = c0
         for c, n in ((c1, 4), (c2, 8), (c3, 4)):
-            blocks.append(_ShuffleUnit(in_c, c, 2))
+            blocks.append(_ShuffleUnit(in_c, c, 2, act=act))
             for _ in range(n - 1):
-                blocks.append(_ShuffleUnit(c, c, 1))
+                blocks.append(_ShuffleUnit(c, c, 1, act=act))
             in_c = c
         self.blocks = blocks
-        self.head = _ConvBN(in_c, c_last, 1)
+        self.head = _ConvBN(in_c, c_last, 1, act=act)
         self.pool = AdaptiveAvgPool2D(1)
         self.fc = Linear(c_last, num_classes)
 
@@ -606,6 +609,11 @@ def shufflenet_v2_x1_5(num_classes=1000):
 
 def shufflenet_v2_x2_0(num_classes=1000):
     return ShuffleNetV2(2.0, num_classes)
+
+
+def shufflenet_v2_swish(num_classes=1000):
+    """Ref shufflenetv2.py:shufflenet_v2_swish — x1.0 with swish acts."""
+    return ShuffleNetV2(1.0, num_classes, act="swish")
 
 
 def mobilenet_v1(scale=1.0, num_classes=1000):

@@ -1,0 +1,77 @@
+"""Custom C++ op loading: compile with g++, call through pure_callback,
+grads via the <name>_grad sibling (ref paddle.utils.cpp_extension)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+SRC = r"""
+#include <cmath>
+extern "C" void scaled_square(const float** ins, const long long* sizes,
+                              int n_ins, float* out, long long out_size) {
+    const float* x = ins[0];
+    const float s = ins[1][0];
+    for (long long i = 0; i < out_size; ++i) out[i] = s * x[i] * x[i];
+}
+extern "C" void scaled_square_grad(const float** ins, const long long* sizes,
+                                   int n_ins, float* out, long long out_size) {
+    // inputs: x, s, upstream g -> dx = 2 s x g
+    const float* x = ins[0];
+    const float s = ins[1][0];
+    const float* g = ins[2];
+    for (long long i = 0; i < out_size; ++i) out[i] = 2.0f * s * x[i] * g[i];
+}
+extern "C" void row_sums(const float** ins, const long long* sizes,
+                         int n_ins, float* out, long long out_size) {
+    // x flattened [rows, cols]; out [rows]
+    long long cols = sizes[0] / out_size;
+    for (long long r = 0; r < out_size; ++r) {
+        float acc = 0.f;
+        for (long long c = 0; c < cols; ++c) acc += ins[0][r * cols + c];
+        out[r] = acc;
+    }
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    from paddle_tpu.utils.cpp_extension import load
+    return load("testops", [SRC],
+                functions={"scaled_square": None,
+                           "row_sums": lambda s: (s[0],)},
+                build_directory=str(tmp_path_factory.mktemp("ext")))
+
+
+def test_custom_op_forward(ext):
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    s = jnp.asarray([2.0])
+    out = ext.scaled_square(x, s)
+    np.testing.assert_allclose(np.asarray(out), [2.0, 8.0, 18.0])
+
+
+def test_custom_op_under_jit(ext):
+    x = jnp.asarray([1.0, 2.0])
+    s = jnp.asarray([3.0])
+    out = jax.jit(lambda a, b: ext.scaled_square(a, b) + 1.0)(x, s)
+    np.testing.assert_allclose(np.asarray(out), [4.0, 13.0])
+
+
+def test_custom_op_grad(ext):
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    s = jnp.asarray([2.0])
+    g = jax.grad(lambda a: jnp.sum(ext.scaled_square(a, s)))(x)
+    np.testing.assert_allclose(np.asarray(g), [4.0, 8.0, 12.0])
+
+
+def test_custom_op_shape_fn(ext):
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    out = ext.row_sums(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(12.0).reshape(3, 4).sum(1))
+
+
+def test_cuda_extension_raises():
+    from paddle_tpu.utils.cpp_extension import CUDAExtension
+    with pytest.raises(RuntimeError):
+        CUDAExtension()
