@@ -23,8 +23,20 @@ _NEG_INF = -1e30
 
 def _block_attend(q, k, v, scale, mask):
     """Scores for one (q_block, kv_block) pair in fp32.
-    q: [B,Sq,H,D] k,v: [B,Sk,H,D]; mask: [Sq,Sk] bool or None."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    q: [B,Sq,H,D] k,v: [B,Sk,Hkv,D]; mask: [Sq,Sk] bool or None. GQA
+    (Hkv < H) runs as a grouped einsum — repeated K/V is never
+    materialised, so the ring rotates 1/rep the bytes."""
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if hq != hk:
+        rep = hq // hk
+        qg = q.reshape(b, sq, hk, rep, d)
+        s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(b, hq, sq, sk)  # head h = g*rep + r (q head order)
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # [B,H,Sq]
@@ -34,7 +46,13 @@ def _block_attend(q, k, v, scale, mask):
         # masked entries explicitly so dead rows contribute l = 0, not Sk
         p = jnp.where(mask[None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)  # [B,H,Sq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    if hq != hk:
+        pg = p.reshape(b, hk, rep, sq, sk).astype(v.dtype)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", pg, v).reshape(b, sq, hq, d)
+        o = o.astype(jnp.float32)
+    else:
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v
+                       ).astype(jnp.float32)
     return o, m, l
 
 
@@ -89,13 +107,15 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh, causal=True):
+def make_ring_attention(mesh, causal=True, head_spec=None):
     """shard_map-wrapped ring attention: global [B, S, H, D] with S sharded
-    over sp; drop-in replacement for full attention."""
+    over sp; drop-in replacement for full attention. ``head_spec="tp"``
+    composes with tensor parallelism (heads stay tp-sharded through the
+    ring — each tp member rings its own head slice over sp)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    spec = P(("dp", "fsdp"), "sp", None, None)
+    spec = P(("dp", "fsdp"), "sp", head_spec, None)
 
     @functools.partial(shard_map, mesh=mesh.mesh,
                        in_specs=(spec, spec, spec), out_specs=spec)

@@ -47,6 +47,7 @@ class LlamaConfig:
     scan_layers: bool = False  # stack layers + lax.scan: O(1) compile depth
     sliding_window: int | None = None  # Mistral-style causal window
     attention_bias: bool = False       # Qwen2: bias on fused qkv only
+    sequence_parallel: str | None = None  # "ring": ring attention over sp
 
     @staticmethod
     def llama2_7b(**kw):
@@ -97,6 +98,31 @@ class LlamaAttention(Module):
         self.num_heads, self.num_kv_heads = nh, nkv
         self.use_flash = cfg.use_flash
         self.window = cfg.sliding_window
+        self.sequence_parallel = cfg.sequence_parallel
+
+    def _attend(self, q, k, v, attn_mask):
+        # sequence parallelism: ring attention over the sp axis — the
+        # sharded sequence never gathers; KV blocks rotate on ICI while the
+        # MXU works on the current block. Trace-time dispatch: falls back to
+        # flash/XLA attention when no sp mesh is active.
+        if self.sequence_parallel == "ring":
+            from paddle_tpu.distributed.mesh import current_mesh
+            mesh = current_mesh()
+            if mesh is not None and mesh.size("sp") > 1:
+                if attn_mask is not None or self.window is not None:
+                    raise NotImplementedError(
+                        "ring attention does not support attn_mask or "
+                        "sliding_window yet; use sequence_parallel=None "
+                        "(GSPMD sp sharding) for masked/windowed configs")
+                from paddle_tpu.distributed.ring_attention import (
+                    make_ring_attention)
+                head_spec = "tp" if mesh.size("tp") > 1 else None
+                attend = make_ring_attention(mesh, causal=True,
+                                             head_spec=head_spec)
+                return attend(q, k, v)
+        return F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=True,
+            training=self.training, window=self.window)
 
     def __call__(self, x, cos, sin, attn_mask=None):
         b, s, h = x.shape
@@ -110,9 +136,7 @@ class LlamaAttention(Module):
         v = v.reshape(b, s, nkv, d)
         q = A.apply_rope(q, cos, sin)
         k = A.apply_rope(k, cos, sin)
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=True, training=self.training,
-                                             window=self.window)
+        out = self._attend(q, k, v, attn_mask)
         return out.reshape(b, s, nh * d) @ self.o_proj
 
 

@@ -50,15 +50,22 @@ def _compile(sources, name, extra_cflags=None, build_directory=None,
                        .encode()).hexdigest()[:12]
     lib_path = os.path.join(build, f"lib{name}_{tag}.so")
     if not os.path.exists(lib_path):
+        # build to a process-unique temp path, then atomically rename:
+        # concurrent loads (test workers, multi-host launch) never dlopen a
+        # half-written .so
+        tmp_path = f"{lib_path}.{os.getpid()}.tmp"
         cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
-               + (extra_cflags or []) + srcs + ["-o", lib_path])
+               + (extra_cflags or []) + srcs + ["-o", tmp_path])
         if verbose:
             print(" ".join(cmd))
-        subprocess.run(cmd, check=True, capture_output=not verbose)
+        try:
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+        except subprocess.CalledProcessError as e:
+            err = (e.stderr or b"").decode(errors="replace")
+            raise RuntimeError(
+                f"g++ failed for extension {name!r}:\n{err}") from None
+        os.replace(tmp_path, lib_path)
     return lib_path
-
-
-_ABI = None  # lazy ctypes signature
 
 
 def _bind(lib, fname):
