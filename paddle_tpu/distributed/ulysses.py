@@ -32,6 +32,18 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
         raise ValueError(
             f"ulysses_attention: num_heads={q.shape[2]} must be divisible by "
             f"axis '{axis_name}' size {sp}")
+    if k.shape[2] % sp != 0:
+        # GQA with fewer KV heads than sp: replicate KV groups up to sp so
+        # the head-scatter has something to split (standard Ulysses-GQA)
+        if sp % k.shape[2] == 0:
+            rep = sp // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        else:
+            raise ValueError(
+                f"ulysses_attention: num_key_value_heads={k.shape[2]} must "
+                f"divide by (or into) axis '{axis_name}' size {sp}; use "
+                "sequence_parallel='ring' for this head configuration")
     # seq-sharded -> head-sharded: gather sequence, scatter heads
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -43,12 +55,16 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
                           tiled=True)
 
 
-def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp"):
+def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp",
+                           head_spec=None, batch_axes=("dp", "fsdp")):
     """Bind ulysses_attention onto a HybridMesh via shard_map: takes/returns
-    [B, S, H, D] arrays sequence-sharded over ``axis_name``."""
+    [B, S, H, D] arrays sequence-sharded over ``axis_name``; batch sharded
+    over ``batch_axes``; ``head_spec="tp"`` composes with tensor
+    parallelism (each tp member re-shards its own head slice over sp, so
+    local heads must divide by sp * tp)."""
     from jax import shard_map
 
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axes, axis_name, head_spec, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal)
     return shard_map(fn, mesh=mesh.mesh, in_specs=(spec, spec, spec),

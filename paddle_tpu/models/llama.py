@@ -47,7 +47,7 @@ class LlamaConfig:
     scan_layers: bool = False  # stack layers + lax.scan: O(1) compile depth
     sliding_window: int | None = None  # Mistral-style causal window
     attention_bias: bool = False       # Qwen2: bias on fused qkv only
-    sequence_parallel: str | None = None  # "ring": ring attention over sp
+    sequence_parallel: str | None = None  # "ring" | "ulysses" over sp
 
     @staticmethod
     def llama2_7b(**kw):
@@ -101,24 +101,34 @@ class LlamaAttention(Module):
         self.sequence_parallel = cfg.sequence_parallel
 
     def _attend(self, q, k, v, attn_mask):
-        # sequence parallelism: ring attention over the sp axis — the
-        # sharded sequence never gathers; KV blocks rotate on ICI while the
-        # MXU works on the current block. Trace-time dispatch: falls back to
-        # flash/XLA attention when no sp mesh is active.
-        if self.sequence_parallel == "ring":
+        # sequence parallelism over the sp axis — trace-time dispatch,
+        # falling back to flash/XLA attention when no sp mesh is active:
+        #   "ring":    KV blocks rotate on ICI (ppermute) while the MXU
+        #              works on the current block; best when S/chip is big.
+        #   "ulysses": two all_to_alls re-shard seq<->heads and full
+        #              attention (incl. the flash kernel) runs on a head
+        #              slice; best when num_heads >= sp and S/chip is small.
+        if self.sequence_parallel in ("ring", "ulysses"):
             from paddle_tpu.distributed.mesh import current_mesh
             mesh = current_mesh()
             if mesh is not None and mesh.size("sp") > 1:
                 if attn_mask is not None or self.window is not None:
                     raise NotImplementedError(
-                        "ring attention does not support attn_mask or "
-                        "sliding_window yet; use sequence_parallel=None "
-                        "(GSPMD sp sharding) for masked/windowed configs")
-                from paddle_tpu.distributed.ring_attention import (
-                    make_ring_attention)
+                        f"{self.sequence_parallel} attention does not "
+                        "support attn_mask or sliding_window yet; use "
+                        "sequence_parallel=None (GSPMD sp sharding) for "
+                        "masked/windowed configs")
                 head_spec = "tp" if mesh.size("tp") > 1 else None
-                attend = make_ring_attention(mesh, causal=True,
-                                             head_spec=head_spec)
+                if self.sequence_parallel == "ring":
+                    from paddle_tpu.distributed.ring_attention import (
+                        make_ring_attention)
+                    attend = make_ring_attention(mesh, causal=True,
+                                                 head_spec=head_spec)
+                else:
+                    from paddle_tpu.distributed.ulysses import (
+                        make_ulysses_attention)
+                    attend = make_ulysses_attention(mesh, causal=True,
+                                                    head_spec=head_spec)
                 return attend(q, k, v)
         return F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=True,

@@ -62,6 +62,10 @@ def _compile(sources, name, extra_cflags=None, build_directory=None,
             subprocess.run(cmd, check=True, capture_output=not verbose)
         except subprocess.CalledProcessError as e:
             err = (e.stderr or b"").decode(errors="replace")
+            try:
+                os.unlink(tmp_path)  # don't leak half-written artifacts
+            except FileNotFoundError:
+                pass
             raise RuntimeError(
                 f"g++ failed for extension {name!r}:\n{err}") from None
         os.replace(tmp_path, lib_path)

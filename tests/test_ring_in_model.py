@@ -26,9 +26,8 @@ def test_ring_model_matches_single_device():
     ref_loss = float(model.loss(ids, labels))
     ref_grads = jax.grad(lambda m: m.loss(ids, labels))(model)
 
-    cfg_sp = LlamaConfig.tiny(num_hidden_layers=2, sequence_parallel="ring")
     model_sp = model
-    # same weights, ring-attention config
+    # same weights, ring-attention config (flip per-layer)
     for lyr in model_sp.model.layers:
         lyr.self_attn.sequence_parallel = "ring"
     mesh = HybridMesh(sp=4, devices=jax.devices()[:4])
@@ -141,3 +140,59 @@ def test_ring_with_window_raises():
     with mesh:
         with pytest.raises(NotImplementedError):
             m(ids)
+
+
+def test_ulysses_model_matches_single_device():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=4)
+    model = LlamaForCausalLM(cfg)
+    ids, labels = _data(cfg)
+    ref_loss = float(model.loss(ids, labels))
+    for lyr in model.model.layers:
+        lyr.self_attn.sequence_parallel = "ulysses"
+    mesh = HybridMesh(sp=4, devices=jax.devices()[:4])
+    with mesh:
+        loss = float(jax.jit(lambda m, i, l: m.loss(i, l))(model, ids, labels))
+    assert abs(loss - ref_loss) < 2e-4, (loss, ref_loss)
+
+
+def test_ulysses_model_trains():
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.train import make_train_step
+    from paddle_tpu.train.step import init_state
+
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=4,
+                           sequence_parallel="ulysses")
+    mesh = HybridMesh(dp=2, sp=4, devices=jax.devices()[:8])
+    with mesh:
+        model = LlamaForCausalLM(cfg)
+        optimizer = opt.AdamW(learning_rate=1e-3)
+        state = init_state(model, optimizer, mesh)
+        ids, labels = _data(cfg, batch=4)
+        ids = jax.device_put(ids, mesh.batch_sharding())
+        labels = jax.device_put(labels, mesh.batch_sharding())
+        step = make_train_step(lambda m, i, l: m.loss(i, l), optimizer, mesh)
+        losses = []
+        for _ in range(6):
+            state, loss = step(state, ids, labels)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ulysses_gqa_kv_replication():
+    """nkv < sp: KV groups replicate up to sp (Ulysses-GQA)."""
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1, num_attention_heads=4,
+                           num_key_value_heads=2)
+    model = LlamaForCausalLM(cfg)
+    ids, labels = _data(cfg)
+    ref_loss = float(model.loss(ids, labels))
+    for lyr in model.model.layers:
+        lyr.self_attn.sequence_parallel = "ulysses"
+    mesh = HybridMesh(sp=4, devices=jax.devices()[:4])
+    with mesh:
+        loss = float(jax.jit(lambda m, i, l: m.loss(i, l))(model, ids, labels))
+    assert abs(loss - ref_loss) < 2e-4, (loss, ref_loss)
