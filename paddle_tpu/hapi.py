@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.module import Module, value_and_grad
+from paddle_tpu.summary_utils import flops, summary  # noqa: F401 (ref hapi exports)
 from paddle_tpu.train.checkpoint import load_state_dict, save_state_dict
 from paddle_tpu.train.step import TrainState, init_state
 
@@ -100,7 +101,9 @@ class Model:
                 if self.loss is not None:
                     losses.append(float(self.loss(out, jnp.asarray(y))))
                 for m in self.metrics:
-                    m.update(np.asarray(out), np.asarray(y))
+                    # reference contract: compute() pre-processes, then update
+                    m.update(*[np.asarray(t) for t in
+                               m.compute(out, jnp.asarray(y))])
         finally:
             for sub, was in zip(model.sublayers(include_self=True), modes):
                 object.__setattr__(sub, "training", was)
@@ -132,3 +135,47 @@ class Model:
         if self.optimizer is not None:
             self._state = init_state(self.network, self.optimizer)
         return self
+
+    # -- reference batch-level API (ref hapi/model.py) ----------------------
+
+    def train_batch(self, inputs, labels):
+        """One optimizer step on a single batch; returns [loss] like the
+        reference."""
+        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple)) else inputs)
+        y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple)) else labels)
+        self._state, lv = self._step_fn(self._state, x, y)
+        self.network = self._state.model
+        return [float(lv)]
+
+    _fwd_jit = None
+
+    def _eval_forward(self, x):
+        """Eval-mode forward through ONE cached jit (training flags restored
+        afterwards so the train step does not retrace)."""
+        model = self._state.model if self._state is not None else self.network
+        if Model._fwd_jit is None:
+            Model._fwd_jit = jax.jit(lambda m, v: m(v))
+        modes = [m.training for m in model.sublayers(include_self=True)]
+        model.eval()
+        try:
+            return Model._fwd_jit(model, x)
+        finally:
+            for sub, was in zip(model.sublayers(include_self=True), modes):
+                object.__setattr__(sub, "training", was)
+
+    def eval_batch(self, inputs, labels):
+        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple)) else inputs)
+        y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple)) else labels)
+        out = self._eval_forward(x)
+        return [float(self.loss(out, y))] if self.loss is not None else [out]
+
+    def predict_batch(self, inputs):
+        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple)) else inputs)
+        return [np.asarray(self._eval_forward(x))]
+
+    def parameters(self):
+        net = self._state.model if self._state is not None else self.network
+        return list(net.parameters())
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)

@@ -18,6 +18,11 @@ class Metric:
     def name(self):
         return type(self).__name__.lower()
 
+    def compute(self, pred, label, *args):
+        """Ref Metric.compute — pre-processing hook run inside the graph;
+        default passthrough, outputs feed ``update``."""
+        return pred, label
+
 
 class Accuracy(Metric):
     def __init__(self, topk=(1,)):

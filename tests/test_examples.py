@@ -39,3 +39,15 @@ def test_train_multichip_example():
     losses = [float(l.split("loss ")[1].split(" ")[0])
               for l in out.splitlines() if l.startswith("step")]
     assert np.isfinite(losses).all()
+
+
+def test_generate_example():
+    out = _run("generate.py", "--model", "mistral", "--strategy", "greedy",
+               "--max-new-tokens", "4")
+    assert "mistral/greedy" in out
+
+
+def test_long_context_example():
+    out = _run("long_context.py", "--mode", "ring", "--steps", "2",
+               "--seq", "64")
+    assert "step 1" in out

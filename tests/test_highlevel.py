@@ -114,3 +114,93 @@ def test_hub_local(tmp_path):
     assert "tiny_model" in pt.hub.list(str(tmp_path))
     assert "tiny" in pt.hub.help(str(tmp_path), "tiny_model")
     assert pt.hub.load(str(tmp_path), "tiny_model", scale=3) == {"scale": 3}
+
+
+def test_model_batch_level_api():
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi import Model
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m = Model(net)
+    m.prepare(optimizer=opt.Adam(learning_rate=0.01),
+              loss=lambda out, y: nn.functional.cross_entropy(out, y))
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 4).astype(np.float32)
+    y = rs.randint(0, 2, (8,))
+    l0 = m.train_batch(x, y)[0]
+    for _ in range(10):
+        l1 = m.train_batch(x, y)[0]
+    assert l1 < l0
+    ev = m.eval_batch(x, y)
+    assert np.isfinite(ev[0])
+    pred = m.predict_batch(x)
+    assert pred[0].shape == (8, 2)
+    assert len(m.parameters()) == 4  # 2 weights + 2 biases
+
+
+def test_eval_batch_runs_in_eval_mode():
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi import Model
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Dropout(0.5), nn.Linear(8, 2))
+    m = Model(net)
+    m.prepare(optimizer=opt.SGD(learning_rate=0.0),
+              loss=lambda out, y: nn.functional.cross_entropy(out, y))
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 4).astype(np.float32)
+    y = rs.randint(0, 2, (4,))
+    # dropout off in eval: repeated eval losses identical
+    l1 = m.eval_batch(x, y)[0]
+    l2 = m.eval_batch(x, y)[0]
+    assert l1 == l2
+    p1 = m.predict_batch(x)[0]
+    p2 = m.predict_batch(x)[0]
+    np.testing.assert_array_equal(p1, p2)
+    # training flags restored
+    assert net.layers[1].training
+
+
+def test_metric_compute_hook_used():
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Metric
+
+    class ArgmaxAcc(Metric):
+        def __init__(self):
+            self.reset()
+
+        def reset(self):
+            self.hits, self.total = 0, 0
+
+        def compute(self, pred, label, *a):
+            return jnp.argmax(pred, -1), label
+
+        def update(self, pred_ids, label):
+            self.hits += int((pred_ids == label).sum())
+            self.total += len(label)
+
+        def accumulate(self):
+            return self.hits / max(self.total, 1)
+
+    pt.seed(0)
+    net = nn.Linear(4, 2)
+    m = Model(net)
+    m.prepare(optimizer=opt.SGD(learning_rate=0.0),
+              loss=lambda out, y: nn.functional.cross_entropy(out, y),
+              metrics=[ArgmaxAcc()])
+    rs = np.random.RandomState(0)
+    data = [(rs.randn(8, 4).astype(np.float32), rs.randint(0, 2, (8,)))]
+    res = m.evaluate(data, verbose=0)
+    assert 0.0 <= res["eval_argmaxacc"] <= 1.0
