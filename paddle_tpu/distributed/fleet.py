@@ -82,3 +82,23 @@ def worker_index() -> int:
 
 def worker_num() -> int:
     return jax.process_count()
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Ref fleet.distributed_optimizer. Under GSPMD the optimizer needs no
+    wrapping — its state pytree mirrors the (sharded) param pytree, so
+    ZeRO-style partitioning falls out of init_state(model, optimizer, mesh).
+    Returned unchanged for API parity."""
+    return optimizer
+
+
+class _FleetUtils:
+    """Ref fleet.utils namespace (recompute + helpers)."""
+
+    @staticmethod
+    def recompute(fn, *args, **kwargs):
+        from paddle_tpu.distributed import recompute as _rc
+        return _rc(fn, *args, **kwargs)
+
+
+utils = _FleetUtils()

@@ -37,7 +37,8 @@ class Model:
 
             def step(state, x, y):
                 def loss_fn(m, x, y):
-                    return self.loss(m(x), y)
+                    out = m(*x) if isinstance(x, tuple) else m(x)
+                    return self.loss(out, y)
                 lv, grads = value_and_grad(loss_fn)(state.model, x, y)
                 model, opt_state = optimizer.step(state.model, grads, state.opt_state)
                 return TrainState(model, opt_state, state.rng), lv
@@ -63,7 +64,8 @@ class Model:
             for i, batch in enumerate(train_data):
                 x, y = batch[0], batch[1]
                 cbs.on_train_batch_begin(i)
-                self._state, lv = self._step_fn(self._state, jnp.asarray(x), jnp.asarray(y))
+                self._state, lv = self._step_fn(
+                    self._state, self._as_args(x), jnp.asarray(y))
                 if i % log_freq == 0:
                     history.append({"epoch": epoch, "step": i, "loss": float(lv)})
                 # callbacks get the device scalar and sync only if they read
@@ -86,27 +88,19 @@ class Model:
     def evaluate(self, eval_data, verbose=1):
         for m in self.metrics:
             m.reset()
-        model = self._state.model if self._state is not None else self.network
-        # eval() mutates in place AND `training` is static pytree aux — flip
-        # it without restoring and the next train step silently retraces
-        # with dropout off. Snapshot per-layer modes and restore at the end.
-        modes = [m.training for m in model.sublayers(include_self=True)]
-        model.eval()
-        try:
-            fwd = jax.jit(lambda m, x: m(x))
-            losses = []
-            for batch in eval_data:
-                x, y = batch[0], batch[1]
-                out = fwd(model, jnp.asarray(x))
-                if self.loss is not None:
-                    losses.append(float(self.loss(out, jnp.asarray(y))))
-                for m in self.metrics:
-                    # reference contract: compute() pre-processes, then update
-                    m.update(*[np.asarray(t) for t in
-                               m.compute(out, jnp.asarray(y))])
-        finally:
-            for sub, was in zip(model.sublayers(include_self=True), modes):
-                object.__setattr__(sub, "training", was)
+        losses = []
+        for batch in eval_data:
+            x, y = batch[0], batch[1]
+            out = self._eval_forward(*self._as_args(x))
+            if self.loss is not None:
+                losses.append(float(self.loss(out, jnp.asarray(y))))
+            for m in self.metrics:
+                # reference contract: compute() pre-processes, then update;
+                # single-tensor returns go to update as one argument
+                res_c = m.compute(out, jnp.asarray(y))
+                if not isinstance(res_c, (tuple, list)):
+                    res_c = (res_c,)
+                m.update(*[np.asarray(t) for t in res_c])
         res = {"eval_loss": float(np.mean(losses)) if losses else None}
         for m in self.metrics:
             res[f"eval_{m.name()}"] = m.accumulate()
@@ -115,16 +109,9 @@ class Model:
         return res
 
     def predict(self, test_data):
-        model = self._state.model if self._state is not None else self.network
-        modes = [m.training for m in model.sublayers(include_self=True)]
-        model.eval()
-        try:
-            fwd = jax.jit(lambda m, x: m(x))
-            return [np.asarray(fwd(model, jnp.asarray(b[0] if isinstance(b, (tuple, list)) else b)))
-                    for b in test_data]
-        finally:
-            for sub, was in zip(model.sublayers(include_self=True), modes):
-                object.__setattr__(sub, "training", was)
+        return [np.asarray(self._eval_forward(
+            *self._as_args(b[0] if isinstance(b, (tuple, list)) else b)))
+            for b in test_data]
 
     def save(self, path):
         net = self._state.model if self._state is not None else self.network
@@ -140,38 +127,45 @@ class Model:
 
     def train_batch(self, inputs, labels):
         """One optimizer step on a single batch; returns [loss] like the
-        reference."""
-        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple)) else inputs)
+        reference. Multi-input networks receive every element of a
+        list/tuple ``inputs``."""
+        xs = self._as_args(inputs)
         y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple)) else labels)
-        self._state, lv = self._step_fn(self._state, x, y)
+        self._state, lv = self._step_fn(self._state, xs, y)
         self.network = self._state.model
         return [float(lv)]
 
     _fwd_jit = None
 
-    def _eval_forward(self, x):
+    @staticmethod
+    def _as_args(inputs):
+        """Normalise the reference's input convention: a list/tuple is a
+        multi-input network's full argument list, else one array."""
+        if isinstance(inputs, (list, tuple)):
+            return tuple(jnp.asarray(i) for i in inputs)
+        return (jnp.asarray(inputs),)
+
+    def _eval_forward(self, *xs):
         """Eval-mode forward through ONE cached jit (training flags restored
         afterwards so the train step does not retrace)."""
         model = self._state.model if self._state is not None else self.network
         if Model._fwd_jit is None:
-            Model._fwd_jit = jax.jit(lambda m, v: m(v))
+            Model._fwd_jit = jax.jit(lambda m, *v: m(*v))
         modes = [m.training for m in model.sublayers(include_self=True)]
         model.eval()
         try:
-            return Model._fwd_jit(model, x)
+            return Model._fwd_jit(model, *xs)
         finally:
             for sub, was in zip(model.sublayers(include_self=True), modes):
                 object.__setattr__(sub, "training", was)
 
     def eval_batch(self, inputs, labels):
-        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple)) else inputs)
         y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple)) else labels)
-        out = self._eval_forward(x)
+        out = self._eval_forward(*self._as_args(inputs))
         return [float(self.loss(out, y))] if self.loss is not None else [out]
 
     def predict_batch(self, inputs):
-        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple)) else inputs)
-        return [np.asarray(self._eval_forward(x))]
+        return [np.asarray(self._eval_forward(*self._as_args(inputs)))]
 
     def parameters(self):
         net = self._state.model if self._state is not None else self.network

@@ -7,7 +7,69 @@ from paddle_tpu.nn.transformer import (MultiHeadAttention as FusedMultiHeadAtten
 from paddle_tpu.ops import (fused_dropout_add, fused_layer_norm, fused_linear,
                             fused_linear_activation, fused_rms_norm)
 from paddle_tpu.ops.attention import (flash_attention,
+                                      fused_bias_dropout_residual_layer_norm,
                                       fused_rotary_position_embedding)
+from paddle_tpu.nn.functional import swiglu
+
+
+def fused_multi_head_attention(x, qkv_weight, qkv_bias, out_weight, out_bias,
+                               num_heads, attn_mask=None, causal=False,
+                               dropout_p=0.0, training=True,
+                               pre_layer_norm=False, ln_scale=None,
+                               ln_bias=None, add_residual=True,
+                               epsilon=1e-5, rng=None):
+    """Ref incubate.nn.functional.fused_multi_head_attention: the full
+    fused block — (pre-)LN, fused qkv projection, SDPA (flash on TPU),
+    out projection, residual add, (post-)LN. qkv_weight: [h, 3*h]."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import functional as _F
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+    b, s, h = x.shape
+    d = h // num_heads
+    residual = x
+    if pre_layer_norm and ln_scale is not None:
+        x = _F.layer_norm(x, h, ln_scale, ln_bias, epsilon)
+    qkv = x @ qkv_weight
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    out = scaled_dot_product_attention(
+        q.reshape(b, s, num_heads, d), k.reshape(b, s, num_heads, d),
+        v.reshape(b, s, num_heads, d), attn_mask=attn_mask, is_causal=causal,
+        dropout_p=dropout_p, training=training, rng=rng)
+    out = out.reshape(b, s, h) @ out_weight
+    if out_bias is not None:
+        out = out + out_bias
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm and ln_scale is not None:
+        out = _F.layer_norm(out, h, ln_scale, ln_bias, epsilon)
+    return out
+
+
+def fused_feedforward(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
+                      training=True, rng=None, pre_layer_norm=False,
+                      ln_scale=None, ln_bias=None, add_residual=True,
+                      epsilon=1e-5):
+    """Ref incubate.nn.functional.fused_feedforward: the full fused block —
+    residual + dropout(linear2(dropout(act(linear1((pre-)LN(x)))))), with
+    post-LN when pre_layer_norm=False. XLA fuses the chain (the reference
+    fuses it by hand in CUDA)."""
+    from paddle_tpu.nn import functional as _F
+    residual = x
+    if pre_layer_norm and ln_scale is not None:
+        x = _F.layer_norm(x, x.shape[-1], ln_scale, ln_bias, epsilon)
+    act = {"gelu": _F.gelu, "relu": _F.relu, "silu": _F.silu}[activation]
+    h = act(x @ w1 + (b1 if b1 is not None else 0))
+    h = _F.dropout(h, dropout_p, training, rng=rng) if dropout_p else h
+    h = h @ w2 + (b2 if b2 is not None else 0)
+    h = _F.dropout(h, dropout_p, training, rng=rng) if dropout_p else h
+    if add_residual:
+        h = h + residual
+    if not pre_layer_norm and ln_scale is not None:
+        h = _F.layer_norm(h, h.shape[-1], ln_scale, ln_bias, epsilon)
+    return h
 
 def masked_multihead_attention(x, cache_k, cache_v, pos, num_heads,
                                window=None):
@@ -35,6 +97,10 @@ def masked_multihead_attention(x, cache_k, cache_v, pos, num_heads,
 
 functional = SimpleNamespace(
     masked_multihead_attention=masked_multihead_attention,
+    swiglu=swiglu,
+    fused_bias_dropout_residual_layer_norm=fused_bias_dropout_residual_layer_norm,
+    fused_multi_head_attention=fused_multi_head_attention,
+    fused_feedforward=fused_feedforward,
     fused_rms_norm=fused_rms_norm,
     fused_layer_norm=fused_layer_norm,
     fused_linear=fused_linear,

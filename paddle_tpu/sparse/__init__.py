@@ -173,3 +173,27 @@ def sum(x, axis=None, keepdim=False):
         shape = tuple(1 if i in axes else s for i, s in enumerate(x.shape))
         out = jsparse.bcoo_reshape(out, new_sizes=shape)
     return out
+
+
+def is_same_shape(x, y):
+    """Ref sparse/unary.py:is_same_shape."""
+    return tuple(x.shape) == tuple(y.shape)
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class _SparseReLU6:
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        return jsparse.BCOO((jnp.clip(x.data, 0, 6), x.indices),
+                            shape=x.shape)
+
+
+from types import SimpleNamespace as _SNS  # noqa: E402
+
+# ref paddle.sparse.nn — activations over sparse values
+nn = _SNS(ReLU=_SparseReLU, ReLU6=_SparseReLU6)

@@ -355,3 +355,44 @@ def test_toplevel_alias_ops():
     np.testing.assert_allclose(
         np.asarray(pt.add_n([jnp.ones(2), jnp.ones(2), jnp.ones(2)])), [3.0, 3.0])
     assert pt.tril_indices(3).shape[0] == 2 and pt.triu_indices(3).shape[0] == 2
+
+
+def test_incubate_fused_ops_and_fleet_sparse_parity():
+    import paddle_tpu.incubate.nn as inn
+    import paddle_tpu.sparse as sp
+    from paddle_tpu.distributed import fleet
+    for n in ["swiglu", "fused_bias_dropout_residual_layer_norm",
+              "fused_multi_head_attention", "fused_feedforward",
+              "masked_multihead_attention"]:
+        assert hasattr(inn.functional, n), n
+    assert fleet.distributed_optimizer("opt") == "opt"  # parity passthrough
+    assert hasattr(fleet.utils, "recompute")
+    x = sp.sparse_coo_tensor(jnp.asarray([[0, 1], [1, 0]]),
+                             jnp.asarray([-1.0, 2.0]), (2, 2))
+    assert sp.is_same_shape(x, x)
+    y = sp.nn.ReLU()(x)
+    np.testing.assert_allclose(np.asarray(y.todense()),
+                               [[0.0, 0.0], [2.0, 0.0]])
+
+
+def test_fused_mha_matches_unfused():
+    import paddle_tpu.incubate.nn as inn
+    from paddle_tpu.ops.attention import xla_attention
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 4, 8).astype(np.float32))
+    w_qkv = jnp.asarray(rs.randn(8, 24).astype(np.float32)) * 0.1
+    w_out = jnp.asarray(rs.randn(8, 8).astype(np.float32)) * 0.1
+    got = inn.functional.fused_multi_head_attention(
+        x, w_qkv, None, w_out, None, num_heads=2, causal=True)
+    qkv = x @ w_qkv
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    ref = xla_attention(q.reshape(2, 4, 2, 4), k.reshape(2, 4, 2, 4),
+                        v.reshape(2, 4, 2, 4), is_causal=True)
+    ref = ref.reshape(2, 4, 8) @ w_out + x  # reference adds the residual
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+    # add_residual=False drops it
+    got2 = inn.functional.fused_multi_head_attention(
+        x, w_qkv, None, w_out, None, num_heads=2, causal=True,
+        add_residual=False)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(ref - x),
+                               rtol=1e-5, atol=1e-6)
