@@ -96,7 +96,8 @@ def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
 
 
 def _window(name, n):
-    if name in (None, "rect", "rectangular", "boxcar", "ones"):
+    if n <= 1 or name in (None, "rect", "rectangular", "boxcar", "ones"):
+        # scipy convention: windows of length <= 1 are [1.0]
         return jnp.ones((n,), jnp.float32)
     t = 2 * math.pi * jnp.arange(n) / n
     if name == "hann":
@@ -107,6 +108,20 @@ def _window(name, n):
         return 0.42 - 0.5 * jnp.cos(t) + 0.08 * jnp.cos(2 * t)
     raise ValueError(f"unsupported window {name!r}; use hann/hamming/"
                      "blackman/rect")
+
+
+def get_window(window, win_length, fftbins=True):
+    """Ref paddle.audio.functional.get_window — named window of a given
+    length (periodic when fftbins, matching the reference/scipy default)."""
+    name = window[0] if isinstance(window, (tuple, list)) else window
+    if fftbins:
+        return _window(name, win_length)
+    if win_length <= 1:
+        return jnp.ones((win_length,), jnp.float32)
+    # symmetric N == periodic over N-1 evaluated at k=0..N-1; the endpoint
+    # repeats the k=0 sample (cos period)
+    w = _window(name, win_length - 1)
+    return jnp.concatenate([w, w[:1]])
 
 
 class Spectrogram:

@@ -16,7 +16,8 @@ import numpy as np
 
 __all__ = [
     "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-    "EarlyStopping", "LRSchedulerCallback", "ReduceLROnPlateau",
+    "EarlyStopping", "LRSchedulerCallback", "LRScheduler", "ReduceLROnPlateau",
+    "VisualDL",
 ]
 
 
@@ -206,3 +207,52 @@ class ReduceLROnPlateau(Callback):
         sched = _scheduler_of(self.model)
         if cur is not None and sched is not None:
             sched.step(float(np.asarray(cur).reshape(-1)[0]))
+
+
+
+# reference name: paddle.callbacks.LRScheduler
+LRScheduler = LRSchedulerCallback
+
+
+class VisualDL(Callback):
+    """Ref callbacks.VisualDL. The visualdl package is not in this
+    environment, so scalars stream to JSONL under ``log_dir`` — readable by
+    any dashboard and by `jq`."""
+
+    def __init__(self, log_dir="vdl_log", log_freq=20):
+        self.log_dir = log_dir
+        self.log_freq = max(1, log_freq)  # syncing every batch would stall
+        self._fh = None                   # the async dispatch pipeline
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import json as _json
+        import os as _os
+        if self._fh is None:
+            _os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(_os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        self._fh.write(_json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % self.log_freq:
+            return  # don't force a device sync on every batch
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"train/{k}", float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"eval/{k}", float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

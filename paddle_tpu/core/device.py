@@ -41,3 +41,39 @@ def process_count() -> int:
 
 def is_tpu() -> bool:
     return jax.default_backend() == "tpu"
+
+
+
+def is_compiled_with_cuda() -> bool:
+    """Ref paddle.device.is_compiled_with_cuda — this build targets TPU."""
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
+    """TPU is the custom device this framework is built for."""
+    return device_type in ("tpu", "axon")
+
+
+def get_all_device_type():
+    import jax
+    try:
+        return sorted({d.platform for d in jax.devices()})
+    except Exception:
+        return ["cpu"]
+
+
+def synchronize(device=None):
+    """Ref paddle.device.synchronize — block until pending work completes.
+    XLA has no global stream; syncing is per-array (block_until_ready), so
+    this is a host-side fence: it runs a trivial computation and waits."""
+    import jax
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()

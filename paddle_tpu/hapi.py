@@ -130,7 +130,7 @@ class Model:
         reference. Multi-input networks receive every element of a
         list/tuple ``inputs``."""
         xs = self._as_args(inputs)
-        y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple)) else labels)
+        y = self._as_labels(labels)
         self._state, lv = self._step_fn(self._state, xs, y)
         self.network = self._state.model
         return [float(lv)]
@@ -144,6 +144,16 @@ class Model:
         if isinstance(inputs, (list, tuple)):
             return tuple(jnp.asarray(i) for i in inputs)
         return (jnp.asarray(inputs),)
+
+    @staticmethod
+    def _as_labels(labels):
+        """Single label array, or the tuple of label arrays for multi-label
+        losses (symmetric with _as_args)."""
+        if isinstance(labels, (list, tuple)):
+            if len(labels) == 1:
+                return jnp.asarray(labels[0])
+            return tuple(jnp.asarray(l) for l in labels)
+        return jnp.asarray(labels)
 
     def _eval_forward(self, *xs):
         """Eval-mode forward through ONE cached jit (training flags restored
@@ -160,7 +170,7 @@ class Model:
                 object.__setattr__(sub, "training", was)
 
     def eval_batch(self, inputs, labels):
-        y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple)) else labels)
+        y = self._as_labels(labels)
         out = self._eval_forward(*self._as_args(inputs))
         return [float(self.loss(out, y))] if self.loss is not None else [out]
 

@@ -28,7 +28,7 @@ def fused_multi_head_attention(x, qkv_weight, qkv_bias, out_weight, out_bias,
     b, s, h = x.shape
     d = h // num_heads
     residual = x
-    if pre_layer_norm and ln_scale is not None:
+    if pre_layer_norm:  # layer_norm defaults affine to ones/zeros when None
         x = _F.layer_norm(x, h, ln_scale, ln_bias, epsilon)
     qkv = x @ qkv_weight
     if qkv_bias is not None:
@@ -43,7 +43,7 @@ def fused_multi_head_attention(x, qkv_weight, qkv_bias, out_weight, out_bias,
         out = out + out_bias
     if add_residual:
         out = out + residual
-    if not pre_layer_norm and ln_scale is not None:
+    if not pre_layer_norm:
         out = _F.layer_norm(out, h, ln_scale, ln_bias, epsilon)
     return out
 
@@ -58,7 +58,7 @@ def fused_feedforward(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
     fuses it by hand in CUDA)."""
     from paddle_tpu.nn import functional as _F
     residual = x
-    if pre_layer_norm and ln_scale is not None:
+    if pre_layer_norm:
         x = _F.layer_norm(x, x.shape[-1], ln_scale, ln_bias, epsilon)
     act = {"gelu": _F.gelu, "relu": _F.relu, "silu": _F.silu}[activation]
     h = act(x @ w1 + (b1 if b1 is not None else 0))
@@ -67,7 +67,7 @@ def fused_feedforward(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
     h = _F.dropout(h, dropout_p, training, rng=rng) if dropout_p else h
     if add_residual:
         h = h + residual
-    if not pre_layer_norm and ln_scale is not None:
+    if not pre_layer_norm:
         h = _F.layer_norm(h, h.shape[-1], ln_scale, ln_bias, epsilon)
     return h
 

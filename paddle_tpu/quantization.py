@@ -221,3 +221,10 @@ class PTQ:
     def quantize(self, model):
         return _replace_linears(
             model, lambda lin: quant_linear(lin, self.config.bits))
+
+
+
+class quanter:
+    """Ref paddle.quantization.quanter namespace: fake-quant factories."""
+
+    FakeQuanterWithAbsMax = FakeQuantLayer

@@ -102,3 +102,74 @@ def compiled_memory_analysis(fn, *args) -> dict:
                 "generated_code_size": m.generated_code_size_in_bytes}
     except Exception:
         return {}
+
+
+
+class ProfilerTarget:
+    """Ref profiler.ProfilerTarget — device classes to trace. On this
+    stack traces always cover host + the XLA device."""
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "custom_device"
+    TPU = "tpu"
+
+
+class RecordEvent:
+    """Ref profiler.RecordEvent: context manager/decorator annotating the
+    trace (maps onto jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cm = None
+
+    def begin(self):
+        self._cm = jax.profiler.TraceAnnotation(self.name)
+        self._cm.__enter__()
+
+    def end(self):
+        if self._cm is not None:
+            self._cm.__exit__(None, None, None)
+            self._cm = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Ref profiler.make_scheduler — step-state schedule. Returns a
+    callable step -> one of "closed"/"ready"/"record" mirroring the
+    reference's ProfilerState for Profiler(scheduler=...)."""
+    if record <= 0:
+        raise ValueError("make_scheduler: record must be > 0")
+    if closed < 0 or ready < 0:
+        raise ValueError("make_scheduler: closed/ready must be >= 0")
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> str:
+        if step < skip_first:
+            return "closed"
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return "closed"
+        pos = s % cycle
+        if pos < closed:
+            return "closed"
+        if pos < closed + ready:
+            return "ready"
+        return "record"
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """Ref profiler.export_chrome_tracing — on this stack the jax trace is
+    already a TensorBoard/perfetto artifact under the Profiler log_dir;
+    returns a callback that records the intended export directory."""
+    def on_export(prof):
+        prof.log_dir = dir_name
+        return dir_name
+    return on_export

@@ -128,3 +128,46 @@ def test_fake_data_deterministic():
     img2, lab2 = ds[3]
     assert np.array_equal(img1, img2) and lab1 == lab2
     assert img1.shape == (3, 16, 16) and 0 <= lab1 < 4
+
+
+def test_get_window_matches_scipy():
+    import scipy.signal
+    import paddle_tpu.audio as A
+    for n in [7, 8, 16]:
+        for name in ["hann", "hamming", "blackman"]:
+            np.testing.assert_allclose(
+                np.asarray(A.get_window(name, n)),
+                scipy.signal.get_window(name, n), atol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(A.get_window(name, n, fftbins=False)),
+                scipy.signal.get_window(name, n, fftbins=False), atol=1e-6)
+
+
+def test_profiler_namespace():
+    import paddle_tpu.utils.profiler as P
+    sched = P.make_scheduler(closed=1, ready=1, record=2, skip_first=1)
+    assert [sched(i) for i in range(6)] == \
+        ["closed", "closed", "ready", "record", "record", "closed"]
+    with P.RecordEvent("x"):
+        pass
+    assert P.ProfilerTarget.TPU == "tpu"
+
+
+def test_callbacks_visualdl(tmp_path):
+    import json
+    import paddle_tpu.callbacks as C
+    assert C.LRScheduler is C.LRSchedulerCallback
+    v = C.VisualDL(log_dir=str(tmp_path), log_freq=1)
+    v.on_train_batch_end(0, logs={"loss": 1.5})
+    v.on_eval_end(logs={"acc": 0.9})
+    v.on_train_end()
+    lines = [json.loads(l) for l in
+             (tmp_path / "scalars.jsonl").read_text().splitlines()]
+    assert lines[0]["tag"] == "train/loss" and lines[1]["tag"] == "eval/acc"
+
+
+def test_device_helpers():
+    from paddle_tpu.core import device as D
+    assert D.is_compiled_with_cuda() is False
+    assert "cpu" in D.get_all_device_type()
+    D.synchronize()

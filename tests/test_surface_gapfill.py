@@ -388,11 +388,15 @@ def test_fused_mha_matches_unfused():
     q, k, v = jnp.split(qkv, 3, axis=-1)
     ref = xla_attention(q.reshape(2, 4, 2, 4), k.reshape(2, 4, 2, 4),
                         v.reshape(2, 4, 2, 4), is_causal=True)
-    ref = ref.reshape(2, 4, 8) @ w_out + x  # reference adds the residual
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
-    # add_residual=False drops it
+    # reference block: residual add then post-LN (default affine)
+    core = ref.reshape(2, 4, 8) @ w_out
+    ref_out = F.layer_norm(core + x, 8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-6)
+    # add_residual=False drops the residual (LN still applies)
     got2 = inn.functional.fused_multi_head_attention(
         x, w_qkv, None, w_out, None, num_heads=2, causal=True,
         add_residual=False)
-    np.testing.assert_allclose(np.asarray(got2), np.asarray(ref - x),
+    np.testing.assert_allclose(np.asarray(got2),
+                               np.asarray(F.layer_norm(core, 8)),
                                rtol=1e-5, atol=1e-6)
