@@ -118,10 +118,17 @@ def get_window(window, win_length, fftbins=True):
         return _window(name, win_length)
     if win_length <= 1:
         return jnp.ones((win_length,), jnp.float32)
-    # symmetric N == periodic over N-1 evaluated at k=0..N-1; the endpoint
-    # repeats the k=0 sample (cos period)
-    w = _window(name, win_length - 1)
-    return jnp.concatenate([w, w[:1]])
+    # symmetric: same cosine series with denominator N-1, k = 0..N-1
+    if name in (None, "rect", "rectangular", "boxcar", "ones"):
+        return jnp.ones((win_length,), jnp.float32)
+    t = 2 * math.pi * jnp.arange(win_length) / (win_length - 1)
+    if name == "hann":
+        return 0.5 - 0.5 * jnp.cos(t)
+    if name == "hamming":
+        return 0.54 - 0.46 * jnp.cos(t)
+    if name == "blackman":
+        return 0.42 - 0.5 * jnp.cos(t) + 0.08 * jnp.cos(2 * t)
+    raise ValueError(f"unsupported window {name!r}")
 
 
 class Spectrogram:

@@ -220,6 +220,7 @@ class VisualDL(Callback):
     any dashboard and by `jq`."""
 
     def __init__(self, log_dir="vdl_log", log_freq=20):
+        super().__init__()
         self.log_dir = log_dir
         self.log_freq = max(1, log_freq)  # syncing every batch would stall
         self._fh = None                   # the async dispatch pipeline

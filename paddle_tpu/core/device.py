@@ -63,7 +63,6 @@ def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
 
 
 def get_all_device_type():
-    import jax
     try:
         return sorted({d.platform for d in jax.devices()})
     except Exception:
@@ -72,8 +71,11 @@ def get_all_device_type():
 
 def synchronize(device=None):
     """Ref paddle.device.synchronize — block until pending work completes.
-    XLA has no global stream; syncing is per-array (block_until_ready), so
-    this is a host-side fence: it runs a trivial computation and waits."""
-    import jax
+    XLA has no global stream, and ``block_until_ready`` is a no-op over the
+    axon TPU tunnel, so the reliable fence is an actual host transfer of a
+    freshly computed scalar (it cannot complete before prior dispatched
+    work on that device)."""
     import jax.numpy as jnp
-    jnp.zeros(()).block_until_ready()
+    devices = [device] if device is not None else jax.local_devices()
+    for d in devices:
+        float(jax.device_get(jax.device_put(jnp.zeros(()), d) + 0))

@@ -65,7 +65,7 @@ class Model:
                 x, y = batch[0], batch[1]
                 cbs.on_train_batch_begin(i)
                 self._state, lv = self._step_fn(
-                    self._state, self._as_args(x), jnp.asarray(y))
+                    self._state, self._as_args(x), self._as_labels(y))
                 if i % log_freq == 0:
                     history.append({"epoch": epoch, "step": i, "loss": float(lv)})
                 # callbacks get the device scalar and sync only if they read
@@ -92,12 +92,13 @@ class Model:
         for batch in eval_data:
             x, y = batch[0], batch[1]
             out = self._eval_forward(*self._as_args(x))
+            y = self._as_labels(y)
             if self.loss is not None:
-                losses.append(float(self.loss(out, jnp.asarray(y))))
+                losses.append(float(self.loss(out, y)))
             for m in self.metrics:
                 # reference contract: compute() pre-processes, then update;
                 # single-tensor returns go to update as one argument
-                res_c = m.compute(out, jnp.asarray(y))
+                res_c = m.compute(out, y)
                 if not isinstance(res_c, (tuple, list)):
                     res_c = (res_c,)
                 m.update(*[np.asarray(t) for t in res_c])

@@ -20,3 +20,4 @@ from paddle_tpu.models.conformer import (ConformerConfig, ConformerEncoder,
 from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM, MistralModel
 from paddle_tpu.models.qwen import Qwen2Config, Qwen2ForCausalLM, Qwen2Model
 from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+from paddle_tpu.models import convert

@@ -17,13 +17,23 @@ import jax
 
 
 class Profiler:
-    """Reference-shaped API: Profiler(targets=...) ... start/stop/export."""
+    """Reference-shaped API: Profiler(targets=..., scheduler=...,
+    on_trace_ready=...) ... start/stop. ``targets`` is accepted for parity
+    (XLA traces always cover host + device); ``on_trace_ready`` runs
+    BEFORE the trace starts so export_chrome_tracing can direct the
+    output directory."""
 
-    def __init__(self, log_dir: str = "profile_out"):
+    def __init__(self, log_dir: str = "profile_out", targets=None,
+                 scheduler=None, on_trace_ready=None):
         self.log_dir = log_dir
+        self.targets = targets
+        self.scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
         self._active = False
 
     def start(self):
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)  # may redirect self.log_dir
         jax.profiler.start_trace(self.log_dir)
         self._active = True
         return self
@@ -166,9 +176,9 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name: str, worker_name: str = None):
-    """Ref profiler.export_chrome_tracing — on this stack the jax trace is
-    already a TensorBoard/perfetto artifact under the Profiler log_dir;
-    returns a callback that records the intended export directory."""
+    """Ref profiler.export_chrome_tracing — the jax trace is already a
+    TensorBoard/perfetto artifact; this callback (run by Profiler.start
+    before tracing begins) directs it to ``dir_name``."""
     def on_export(prof):
         prof.log_dir = dir_name
         return dir_name

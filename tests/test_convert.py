@@ -1,0 +1,158 @@
+"""HF-checkpoint conversion parity: logits from converted weights match
+the torch ``transformers`` reference implementation to fp32 tolerance.
+This is the strongest switch-from-the-reference proof — real pretrained
+checkpoints load and reproduce the reference's numerics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+transformers = pytest.importorskip("transformers")
+
+
+def _hf_llama(nkv=2, vocab=96, h=32, layers=2, heads=4, inter=64):
+    import torch
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFModel
+    torch.manual_seed(0)
+    cfg = HFConfig(vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+                   num_hidden_layers=layers, num_attention_heads=heads,
+                   num_key_value_heads=nkv, max_position_embeddings=64,
+                   attn_implementation="eager")
+    return HFModel(cfg).eval()
+
+
+@pytest.mark.parametrize("nkv", [4, 2])
+def test_llama_logits_match_transformers(nkv):
+    import torch
+    hf = _hf_llama(nkv=nkv)
+    from paddle_tpu.models.convert import load_llama_state_dict
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=nkv, max_position_embeddings=64,
+                      rms_norm_eps=hf.config.rms_norm_eps,
+                      dtype=jnp.float32, remat=False)
+    ours = load_llama_state_dict(LlamaForCausalLM(cfg).eval(),
+                                 hf.state_dict())
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_logits_match_transformers():
+    import torch
+    from transformers import Qwen2Config as HFConfig
+    from transformers import Qwen2ForCausalLM as HFModel
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=64,
+                          rope_theta=1e6, tie_word_embeddings=False,
+                          attn_implementation="eager")).eval()
+    from paddle_tpu.models.convert import load_llama_state_dict
+    from paddle_tpu.models.qwen import Qwen2Config, Qwen2ForCausalLM
+
+    pt.seed(0)
+    cfg = Qwen2Config(vocab_size=96, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      rope_theta=1e6, attention_bias=True,
+                      rms_norm_eps=hf.config.rms_norm_eps,
+                      dtype=jnp.float32, remat=False)
+    ours = load_llama_state_dict(Qwen2ForCausalLM(cfg).eval(), hf.state_dict())
+
+    rs = np.random.RandomState(1)
+    ids = rs.randint(0, 96, (1, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_mistral_logits_match_transformers():
+    import torch
+    from transformers import MistralConfig as HFConfig
+    from transformers import MistralForCausalLM as HFModel
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=64,
+                          sliding_window=None,
+                          attn_implementation="eager")).eval()
+    from paddle_tpu.models.convert import load_llama_state_dict
+    from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM
+
+    pt.seed(0)
+    cfg = MistralConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=64,
+                        sliding_window=None,
+                        rms_norm_eps=hf.config.rms_norm_eps,
+                        dtype=jnp.float32, remat=False)
+    ours = load_llama_state_dict(MistralForCausalLM(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(2)
+    ids = rs.randint(0, 96, (1, 14))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_hidden_states_match_transformers():
+    import torch
+    from transformers import BertConfig as HFConfig
+    from transformers import BertModel as HFModel
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=64, max_position_embeddings=64,
+                          type_vocab_size=2,
+                          attn_implementation="eager")).eval()
+    from paddle_tpu.models.bert import BertConfig, BertModel
+    from paddle_tpu.models.convert import load_bert_state_dict
+
+    pt.seed(0)
+    cfg = BertConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, type_vocab_size=2,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                     dtype=jnp.float32)
+    ours = load_bert_state_dict(BertModel(cfg).eval(), hf.state_dict())
+
+    rs = np.random.RandomState(3)
+    ids = rs.randint(0, 96, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).last_hidden_state.numpy()
+    got = ours(jnp.asarray(ids))
+    seq = got[0] if isinstance(got, tuple) else got
+    np.testing.assert_allclose(np.asarray(seq, np.float32), ref,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_safetensors_roundtrip(tmp_path):
+    """Minimal-parser path: write via struct, read back."""
+    import json
+    import struct
+    from paddle_tpu.models.convert import load_safetensors
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    raw = arr.tobytes()
+    header = {"w": {"dtype": "F32", "shape": [2, 3],
+                    "data_offsets": [0, len(raw)]}}
+    hb = json.dumps(header).encode()
+    path = tmp_path / "x.safetensors"
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hb)))
+        f.write(hb)
+        f.write(raw)
+    out = load_safetensors(str(path))
+    np.testing.assert_array_equal(out["w"], arr)
