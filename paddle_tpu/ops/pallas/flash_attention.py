@@ -135,8 +135,12 @@ def _flash_fwd(q, k, v, *, scale, causal, window, kv_rep, block_q, block_k,
     else:
         nsteps = nk
 
-        def kv_index(b, i, jl):
-            return (b // kv_rep, jl, 0)
+        if kv_rep == 1:
+            def kv_index(b, i, jl):
+                return (b, jl, 0)
+        else:
+            def kv_index(b, i, jl):
+                return (b // kv_rep, jl, 0)
     grid = (bh, nq, nsteps)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                window=window, q_off=q_off, block_q=block_q,
@@ -276,8 +280,12 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     else:
         nk_steps, nq_steps = nk, nq
 
-        def kv_index_dq(b, i, jl):
-            return (b // kv_rep, jl, 0)
+        if kv_rep == 1:
+            def kv_index_dq(b, i, jl):
+                return (b, jl, 0)
+        else:
+            def kv_index_dq(b, i, jl):
+                return (b // kv_rep, jl, 0)
 
         def q_index_dkv(b, j, il):
             return (b, il, 0)
@@ -310,8 +318,12 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
         grid=(bh, nk, nq_steps),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index_dkv),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b // kv_rep, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b // kv_rep, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         (lambda b, j, i: (b, j, 0)) if kv_rep == 1 else
+                         (lambda b, j, i: (b // kv_rep, j, 0))),
+            pl.BlockSpec((1, block_k, d),
+                         (lambda b, j, i: (b, j, 0)) if kv_rep == 1 else
+                         (lambda b, j, i: (b // kv_rep, j, 0))),
             pl.BlockSpec((1, block_q, d), q_index_dkv),
             pl.BlockSpec((1, block_q, 1), q_index_dkv),
             pl.BlockSpec((1, block_q, 1), q_index_dkv),
