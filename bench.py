@@ -75,15 +75,19 @@ def main():
     # warmup / compile. If the Pallas kernel fails to lower on this chip
     # generation, fall back to the XLA attention path rather than produce
     # no number at all.
+    used_flash = on_tpu
     try:
         state, loss = step(state, ids, labels)
         sync(loss)
     except Exception as e:  # pragma: no cover - TPU-compile specific
+        if not on_tpu:
+            raise  # flash never dispatches off-TPU; surface the real error
         import os
         import sys
         print(f"flash path failed ({type(e).__name__}); retrying with XLA "
               "attention", file=sys.stderr)
         os.environ["PADDLE_TPU_DISABLE_FLASH"] = "1"
+        used_flash = False
         pt.seed(0)
         model = LlamaForCausalLM(cfg)
         state = init_state(model, optimizer)
@@ -111,6 +115,7 @@ def main():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu / 0.50, 3) if peak else 0.0,
         "extra": {
+            "flash": used_flash,
             "mfu": round(mfu, 4),
             "step_ms": round(dt * 1e3, 2),
             "params": model.num_parameters(),

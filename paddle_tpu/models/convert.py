@@ -161,3 +161,37 @@ def load_safetensors(path):
                 arr = buf.view(dt[meta["dtype"]])
             out[name] = arr.reshape(meta["shape"])
     return out
+
+
+def load_gpt2_state_dict(model, state_dict, dtype=None):
+    """Populate a ``GPTForCausalLM`` from an HF GPT-2 ``state_dict``.
+    HF GPT-2 uses Conv1D layers that already store [in, out], so the fused
+    qkv/fc weights map without transposition."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    dtype = dtype or model.cfg.dtype
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def get(name):
+        return sd[name] if name in sd else sd["transformer." + name]
+
+    model.wte = j(get("wte.weight"))
+    model.wpe = j(get("wpe.weight"))
+    model.ln_f.weight = j(get("ln_f.weight"))
+    model.ln_f.bias = j(get("ln_f.bias"))
+    for i, blk in enumerate(model.blocks):
+        p = f"h.{i}."
+        blk.ln1.weight = j(get(p + "ln_1.weight"))
+        blk.ln1.bias = j(get(p + "ln_1.bias"))
+        blk.qkv = j(get(p + "attn.c_attn.weight"))
+        blk.qkv_bias = j(get(p + "attn.c_attn.bias"))
+        blk.proj = j(get(p + "attn.c_proj.weight"))
+        blk.proj_bias = j(get(p + "attn.c_proj.bias"))
+        blk.ln2.weight = j(get(p + "ln_2.weight"))
+        blk.ln2.bias = j(get(p + "ln_2.bias"))
+        blk.fc1 = j(get(p + "mlp.c_fc.weight"))
+        blk.fc1_bias = j(get(p + "mlp.c_fc.bias"))
+        blk.fc2 = j(get(p + "mlp.c_proj.weight"))
+        blk.fc2_bias = j(get(p + "mlp.c_proj.bias"))
+    return model

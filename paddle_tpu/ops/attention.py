@@ -19,7 +19,7 @@ _NEG_INF = -2.3819763e38  # most-negative bf16-representable; avoids nan from -i
 
 def _use_pallas(q) -> bool:
     import os
-    if os.environ.get("PADDLE_TPU_DISABLE_FLASH"):
+    if os.environ.get("PADDLE_TPU_DISABLE_FLASH", "").lower() in ("1", "true", "yes"):
         return False  # escape hatch: force the XLA attention path
     if jax.default_backend() != "tpu":
         return False

@@ -54,6 +54,15 @@ def _block_live(i, j, block_q, block_k, causal, window, q_off):
     return live
 
 
+def _kv_row_index(kv_rep):
+    """Index map factory for K/V block specs: q row b reads kv row
+    b // kv_rep (identity when there is no GQA — keeps the non-GQA path
+    free of the division)."""
+    if kv_rep == 1:
+        return lambda b, second, third: (b, third, 0)
+    return lambda b, second, third: (b // kv_rep, third, 0)
+
+
 def _band_j_start(i, block_q, block_k, window, q_off):
     """First k-block index in the band of q-block i (clamped to 0)."""
     return jnp.maximum(0, (i * block_q + q_off - window + 1) // block_k)
@@ -134,13 +143,7 @@ def _flash_fwd(q, k, v, *, scale, causal, window, kv_rep, block_q, block_k,
             return (b // kv_rep, jnp.minimum(j, nk - 1), 0)
     else:
         nsteps = nk
-
-        if kv_rep == 1:
-            def kv_index(b, i, jl):
-                return (b, jl, 0)
-        else:
-            def kv_index(b, i, jl):
-                return (b // kv_rep, jl, 0)
+        kv_index = _kv_row_index(kv_rep)
     grid = (bh, nq, nsteps)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                window=window, q_off=q_off, block_q=block_q,
@@ -280,12 +283,7 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     else:
         nk_steps, nq_steps = nk, nq
 
-        if kv_rep == 1:
-            def kv_index_dq(b, i, jl):
-                return (b, jl, 0)
-        else:
-            def kv_index_dq(b, i, jl):
-                return (b // kv_rep, jl, 0)
+        kv_index_dq = _kv_row_index(kv_rep)
 
         def q_index_dkv(b, j, il):
             return (b, il, 0)
@@ -318,12 +316,8 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
         grid=(bh, nk, nq_steps),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index_dkv),
-            pl.BlockSpec((1, block_k, d),
-                         (lambda b, j, i: (b, j, 0)) if kv_rep == 1 else
-                         (lambda b, j, i: (b // kv_rep, j, 0))),
-            pl.BlockSpec((1, block_k, d),
-                         (lambda b, j, i: (b, j, 0)) if kv_rep == 1 else
-                         (lambda b, j, i: (b // kv_rep, j, 0))),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: _kv_row_index(kv_rep)(b, i, j)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: _kv_row_index(kv_rep)(b, i, j)),
             pl.BlockSpec((1, block_q, d), q_index_dkv),
             pl.BlockSpec((1, block_q, 1), q_index_dkv),
             pl.BlockSpec((1, block_q, 1), q_index_dkv),

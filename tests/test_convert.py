@@ -156,3 +156,32 @@ def test_safetensors_roundtrip(tmp_path):
         f.write(raw)
     out = load_safetensors(str(path))
     np.testing.assert_array_equal(out["w"], arr)
+
+
+def test_gpt2_logits_match_transformers():
+    import torch
+    from transformers import GPT2Config as HFConfig
+    from transformers import GPT2LMHeadModel as HFModel
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, n_embd=32, n_layer=2, n_head=4,
+                          n_positions=64, n_inner=None,
+                          attn_implementation="eager",
+                          resid_pdrop=0.0, embd_pdrop=0.0,
+                          attn_pdrop=0.0)).eval()
+    from paddle_tpu.models.convert import load_gpt2_state_dict
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64, dropout=0.0,
+                    layer_norm_eps=hf.config.layer_norm_epsilon,
+                    dtype=jnp.float32, remat=False)
+    ours = load_gpt2_state_dict(GPTForCausalLM(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(4)
+    ids = rs.randint(0, 96, (2, 11))
+    import torch as _t
+    with _t.no_grad():
+        ref = hf(_t.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
