@@ -11,7 +11,8 @@ safetensors file) into the fused TPU layouts used here:
   * lm_head transposes to [h, vocab].
 
 Covers the LLaMA family (LLaMA / Mistral / Qwen2 — Qwen2 adds q/k/v
-biases) and BERT. Numerical parity with the torch reference is asserted
+biases), GPT-2 (Conv1D [in, out] layout), T5 (v1.0 relu, tied rescaled
+head) and BERT. Numerical parity with the torch reference is asserted
 in tests/test_convert.py (logits match to fp32 tolerance).
 """
 from __future__ import annotations
@@ -194,4 +195,50 @@ def load_gpt2_state_dict(model, state_dict, dtype=None):
         blk.fc1_bias = j(get(p + "mlp.c_fc.bias"))
         blk.fc2 = j(get(p + "mlp.c_proj.weight"))
         blk.fc2_bias = j(get(p + "mlp.c_proj.bias"))
+    return model
+
+
+def load_t5_state_dict(model, state_dict, dtype=None):
+    """Populate a ``T5ForConditionalGeneration`` from an HF T5 (v1.0 relu)
+    ``state_dict``. Linear weights transpose ([out, in] -> [in, out]);
+    relative-attention bias tables map directly ([buckets, heads])."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    dtype = dtype or model.cfg.dtype
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    # tied checkpoints surface lm_head.weight too (same tensor); only a
+    # genuinely different head makes the tied+rescaled model wrong
+    if "lm_head.weight" in sd and not np.array_equal(
+            sd["lm_head.weight"], sd["shared.weight"]):
+        raise ValueError(
+            "untied T5 checkpoint (distinct lm_head.weight): this model ties "
+            "the head to the shared embedding with the v1.0 rescale; untied "
+            "(v1.1/gated) checkpoints are not supported yet")
+    t5 = model.t5
+    t5.shared = j(sd["shared.weight"])
+
+    def load_attn(att, p):
+        att.q = j(sd[p + ".q.weight"].T)
+        att.k = j(sd[p + ".k.weight"].T)
+        att.v = j(sd[p + ".v.weight"].T)
+        att.o = j(sd[p + ".o.weight"].T)
+        rb = sd.get(p + ".relative_attention_bias.weight")
+        if rb is not None and att.rel_bias is not None:
+            att.rel_bias = jnp.asarray(rb, jnp.float32)
+
+    for stack, name in ((t5.encoder, "encoder"), (t5.decoder, "decoder")):
+        for i, blk in enumerate(stack.blocks):
+            p = f"{name}.block.{i}.layer."
+            load_attn(blk.attn, p + "0.SelfAttention")
+            blk.ln1.weight = j(sd[p + "0.layer_norm.weight"])
+            ff_idx = 2 if blk.is_decoder else 1
+            if blk.is_decoder:
+                load_attn(blk.cross_attn, p + "1.EncDecAttention")
+                blk.ln_cross.weight = j(sd[p + "1.layer_norm.weight"])
+            blk.ff.wi = j(sd[p + f"{ff_idx}.DenseReluDense.wi.weight"].T)
+            blk.ff.wo = j(sd[p + f"{ff_idx}.DenseReluDense.wo.weight"].T)
+            blk.ln2.weight = j(sd[p + f"{ff_idx}.layer_norm.weight"])
+        stack.final_norm.weight = j(sd[f"{name}.final_layer_norm.weight"])
     return model

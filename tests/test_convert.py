@@ -185,3 +185,33 @@ def test_gpt2_logits_match_transformers():
         ref = hf(_t.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_t5_logits_match_transformers():
+    import torch
+    from transformers import T5Config as HFConfig
+    from transformers import T5ForConditionalGeneration as HFModel
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, d_kv=8, d_ff=64,
+                          num_layers=2, num_decoder_layers=2, num_heads=4,
+                          feed_forward_proj="relu", dropout_rate=0.0,
+                          tie_word_embeddings=True)).eval()
+    from paddle_tpu.models.convert import load_t5_state_dict
+    from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    pt.seed(0)
+    cfg = T5Config(vocab_size=96, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+                   num_decoder_layers=2, num_heads=4,
+                   layer_norm_epsilon=hf.config.layer_norm_epsilon,
+                   dtype=jnp.float32)
+    ours = load_t5_state_dict(T5ForConditionalGeneration(cfg).eval(),
+                              hf.state_dict())
+    rs = np.random.RandomState(5)
+    enc_ids = rs.randint(0, 96, (2, 7))
+    dec_ids = rs.randint(0, 96, (2, 5))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(enc_ids),
+                 decoder_input_ids=torch.tensor(dec_ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(enc_ids), jnp.asarray(dec_ids)),
+                     np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
