@@ -1212,3 +1212,121 @@ def unfold(x, axis, size, step):
     out = jnp.take(x, idx, axis=axis)  # axis -> (n, size)
     # move the window dim to the end
     return jnp.moveaxis(out, axis + 1, -1)
+
+
+# -- final audit round (ref manipulation.py / creation.py) -------------------
+
+import builtins as _builtins  # noqa: E402
+builtins_max = _builtins.max
+builtins_min = _builtins.min
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    """Write ``y`` onto the given diagonal of ``x`` (ref
+    manipulation.py:diagonal_scatter)."""
+    xm = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    m, n = xm.shape[-2], xm.shape[-1]
+    k = builtins_min(m, n - offset) if offset >= 0 else builtins_min(m + offset, n)
+    r = jnp.arange(k) + builtins_max(-offset, 0)
+    c = jnp.arange(k) + builtins_max(offset, 0)
+    xm = xm.at[..., r, c].set(y)
+    return jnp.moveaxis(xm, (-2, -1), (axis1, axis2))
+
+
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """Functional fill_diagonal (returns a new array; no mutation under
+    XLA). Matches the reference: ndim > 2 fills the GRAND diagonal
+    x[i, i, ..., i] (all dims must be equal); 2-D supports ``offset`` and
+    numpy-style ``wrap`` for tall matrices."""
+    if x.ndim > 2:
+        if len(set(x.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal with ndim > 2 requires equal dims "
+                f"(got {x.shape})")
+        idx = (jnp.arange(x.shape[0]),) * x.ndim
+        return x.at[idx].set(value)
+    m, n = x.shape[-2], x.shape[-1]
+    k = builtins_min(m, n - offset) if offset >= 0 \
+        else builtins_min(m + offset, n)
+    r = jnp.arange(k) + builtins_max(-offset, 0)
+    c = jnp.arange(k) + builtins_max(offset, 0)
+    out = x.at[..., r, c].set(value)
+    if wrap and offset == 0 and m > n:  # numpy wrapped tall-matrix diagonal
+        for start in range(n + 1, m, n + 1):
+            kk = builtins_min(m - start, n)
+            rr = jnp.arange(kk) + start
+            cc = jnp.arange(kk)
+            out = out.at[..., rr, cc].set(value)
+    return out
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    return diagonal_scatter(x, y, offset, dim1, dim2)
+
+
+def index_put(x, indices, value, accumulate=False):
+    """Ref manipulation.py:index_put — advanced-index write (functional)."""
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+def take_along_dim(x, indices, dim):
+    return jnp.take_along_axis(x, indices, axis=dim)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    """Ref linalg.py:histogramdd — ``ranges`` is the reference's FLAT
+    [min0, max0, min1, max1, ...] list; converted to numpy's per-dim
+    pairs."""
+    if ranges is not None:
+        flat = list(ranges)
+        if len(flat) != 2 * x.shape[-1]:
+            raise ValueError(
+                f"ranges must hold 2 values per dimension "
+                f"({2 * x.shape[-1]}), got {len(flat)}")
+        ranges = [(flat[2 * i], flat[2 * i + 1])
+                  for i in range(len(flat) // 2)]
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                               weights=weights)
+    return h, list(edges)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0):
+    """Reference sentinel semantics (matching ``histogram`` above):
+    min == max == 0 means use the data range."""
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return jnp.histogram_bin_edges(x, bins=bins, range=rng)
+
+
+def block_diag(*inputs):
+    if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+        inputs = tuple(inputs[0])
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+def column_stack(xs):
+    return jnp.column_stack(tuple(xs))
+
+
+def row_stack(xs):
+    return jnp.vstack(tuple(xs))
+
+
+def dstack(xs):
+    return jnp.dstack(tuple(xs))
+
+
+def positive(x):
+    return +jnp.asarray(x)
+
+
+def view(x, shape_or_dtype):
+    """Ref manipulation.py:view — reshape or reinterpret-cast."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, shape_or_dtype)
+    return x.view(shape_or_dtype) if hasattr(x, "view") else \
+        jnp.asarray(x).view(shape_or_dtype)
+
+
+def view_as(x, other):
+    return jnp.reshape(x, jnp.shape(other))

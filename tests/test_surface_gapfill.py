@@ -400,3 +400,32 @@ def test_fused_mha_matches_unfused():
     np.testing.assert_allclose(np.asarray(got2),
                                np.asarray(F.layer_norm(core, 8)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_final_tensor_audit_ops():
+    import torch
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    y = np.full(4, 9.0, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(pt.diagonal_scatter(jnp.asarray(x), jnp.asarray(y))),
+        torch.diagonal_scatter(torch.tensor(x), torch.tensor(y)).numpy())
+    t = torch.tensor(x.copy()); t.fill_diagonal_(7.0)
+    np.testing.assert_allclose(
+        np.asarray(pt.fill_diagonal(jnp.asarray(x), 7.0)), t.numpy())
+    a = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        np.asarray(pt.block_diag(a, a)),
+        torch.block_diag(torch.tensor(np.asarray(a)),
+                         torch.tensor(np.asarray(a))).numpy())
+    ip = pt.index_put(jnp.zeros((3, 3)),
+                      (jnp.asarray([0, 1]), jnp.asarray([1, 2])), 5.0)
+    assert float(ip[0, 1]) == 5.0 and float(ip[1, 2]) == 5.0
+    assert pt.view(a, [3, 2]).shape == (3, 2)
+    assert pt.view_as(a, jnp.zeros((6,))).shape == (6,)
+    assert pt.column_stack([jnp.ones(3), jnp.zeros(3)]).shape == (3, 2)
+    assert pt.row_stack([jnp.ones(3), jnp.zeros(3)]).shape == (2, 3)
+    h, e = pt.histogramdd(jnp.asarray(np.random.rand(20, 2)), bins=4)
+    assert h.shape == (4, 4) and len(e) == 2
+    np.testing.assert_allclose(
+        np.asarray(pt.take_along_dim(a, jnp.asarray([[0], [2]]), 1)),
+        np.take_along_axis(np.asarray(a), np.array([[0], [2]]), 1))
