@@ -57,13 +57,17 @@ def _block_attend(q, k, v, scale, mask):
 
 
 def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
-                   scale: float | None = None):
+                   scale: float | None = None, window: int | None = None):
     """Blockwise ring attention with online-softmax accumulation.
 
     Equals full attention over the gathered sequence (see
     tests/test_ring_attention.py). Gradient flows through ppermute, so the
     backward pass is itself a ring pass — no full-sequence gather ever.
+    ``window``: Mistral-style causal sliding window over GLOBAL positions
+    (query position i sees [i-window+1, i] across shard boundaries).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -74,16 +78,32 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     l = jnp.zeros((b, h, s_loc), jnp.float32)
 
     causal_in_block = jnp.tril(jnp.ones((s_loc, s_loc), bool)) if causal else None
+    a_ix = jnp.arange(s_loc)[:, None]
+    b_ix = jnp.arange(s_loc)[None, :]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    # a window bounds how far back any query looks: ring step s covers
+    # global distance >= s*s_loc - (s_loc-1) on every rank, so steps past
+    # ceil((window + s_loc - 1) / s_loc) are dead EVERYWHERE — prune them
+    # at trace time (no compute, no ppermute): windowed ring costs
+    # O(S * window), not O(S^2)
+    live_steps = n
+    if causal and window is not None:
+        live_steps = min(n, -(-(window + s_loc - 1) // s_loc))
+
     k_blk, v_blk = k, v
-    for step in range(n):
+    for step in range(live_steps):
         src = (my - step) % n  # which sequence block k_blk/v_blk holds
         if causal:
             # src > my: future block — fully masked; src == my: in-block causal
             block_mask = jnp.where(src == my, causal_in_block,
                                    jnp.full((s_loc, s_loc), True))
             allowed = (src <= my)
+            if window is not None:
+                # global-position band: qg - kg < window
+                dist = (my - src) * s_loc + a_ix - b_ix
+                block_mask = block_mask & (dist < window)
+                allowed = allowed & ((my - src) * s_loc - (s_loc - 1) < window)
         else:
             block_mask = None
             allowed = True
@@ -99,7 +119,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
         o = o * jnp.moveaxis(c1, 1, 2)[..., None] + o_b * jnp.moveaxis(c2, 1, 2)[..., None]
         l = l * c1 + l_b * c2
         m = m_new
-        if step != n - 1:
+        if step != live_steps - 1:
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
 
@@ -107,11 +127,12 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh, causal=True, head_spec=None):
+def make_ring_attention(mesh, causal=True, head_spec=None, window=None):
     """shard_map-wrapped ring attention: global [B, S, H, D] with S sharded
     over sp; drop-in replacement for full attention. ``head_spec="tp"``
     composes with tensor parallelism (heads stay tp-sharded through the
-    ring — each tp member rings its own head slice over sp)."""
+    ring — each tp member rings its own head slice over sp); ``window``
+    applies a global causal sliding window (Mistral)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -120,7 +141,8 @@ def make_ring_attention(mesh, causal=True, head_spec=None):
     @functools.partial(shard_map, mesh=mesh.mesh,
                        in_specs=(spec, spec, spec), out_specs=spec)
     def attend(q, k, v):
-        return ring_attention(q, k, v, axis_name="sp", causal=causal)
+        return ring_attention(q, k, v, axis_name="sp", causal=causal,
+                              window=window)
 
     return attend
 

@@ -112,18 +112,21 @@ class LlamaAttention(Module):
             from paddle_tpu.distributed.mesh import current_mesh
             mesh = current_mesh()
             if mesh is not None and mesh.size("sp") > 1:
-                if attn_mask is not None or self.window is not None:
+                if attn_mask is not None or (
+                        self.window is not None
+                        and self.sequence_parallel != "ring"):
                     raise NotImplementedError(
                         f"{self.sequence_parallel} attention does not "
-                        "support attn_mask or sliding_window yet; use "
-                        "sequence_parallel=None (GSPMD sp sharding) for "
-                        "masked/windowed configs")
+                        "support attn_mask (or, for ulysses, "
+                        "sliding_window); use sequence_parallel=None "
+                        "(GSPMD sp sharding) or ring for windowed configs")
                 head_spec = "tp" if mesh.size("tp") > 1 else None
                 if self.sequence_parallel == "ring":
                     from paddle_tpu.distributed.ring_attention import (
                         make_ring_attention)
                     attend = make_ring_attention(mesh, causal=True,
-                                                 head_spec=head_spec)
+                                                 head_spec=head_spec,
+                                                 window=self.window)
                 else:
                     from paddle_tpu.distributed.ulysses import (
                         make_ulysses_attention)

@@ -130,10 +130,64 @@ def test_zigzag_ring_gqa():
                                rtol=2e-4, atol=2e-5)
 
 
-def test_ring_with_window_raises():
+@pytest.mark.parametrize("window", [4, 9, 100])
+def test_windowed_ring_matches_windowed_full(window):
+    """Global sliding window across shard boundaries == windowed full
+    attention."""
+    from paddle_tpu.distributed.ring_attention import make_ring_attention
+    from paddle_tpu.ops.attention import xla_attention
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(2, 32, 2, 8).astype(np.float32))
+    k = jnp.asarray(rs.randn(2, 32, 2, 8).astype(np.float32))
+    v = jnp.asarray(rs.randn(2, 32, 2, 8).astype(np.float32))
+    ref = xla_attention(q, k, v, is_causal=True, window=window)
+    mesh = HybridMesh(sp=8)
+    with mesh:
+        out = make_ring_attention(mesh, causal=True, window=window)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_windowed_ring_grads_match():
+    from paddle_tpu.distributed.ring_attention import make_ring_attention
+    from paddle_tpu.ops.attention import xla_attention
+    rs = np.random.RandomState(8)
+    q = jnp.asarray(rs.randn(1, 16, 1, 4).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 16, 1, 4).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 16, 1, 4).astype(np.float32))
+    mesh = HybridMesh(sp=4, devices=jax.devices()[:4])
+    with mesh:
+        attend = make_ring_attention(mesh, causal=True, window=5)
+        g_ring = jax.grad(lambda a, b, c: jnp.sum(attend(a, b, c) ** 2),
+                          argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(
+        xla_attention(a, b, c, is_causal=True, window=5) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_mistral_ring_matches_single_device():
+    """Mistral (sliding window) + sequence_parallel='ring' == unsharded."""
+    from paddle_tpu.models.mistral import MistralConfig, MistralForCausalLM
     pt.seed(0)
-    cfg = LlamaConfig.tiny(num_hidden_layers=1, sequence_parallel="ring",
-                           sliding_window=8)
+    cfg = MistralConfig.tiny(sliding_window=10, num_hidden_layers=2)
+    model = MistralForCausalLM(cfg)
+    ids, labels = _data(cfg)
+    ref_loss = float(model.loss(ids, labels))
+    for lyr in model.model.layers:
+        lyr.self_attn.sequence_parallel = "ring"
+    mesh = HybridMesh(sp=4, devices=jax.devices()[:4])
+    with mesh:
+        loss = float(jax.jit(lambda m, i, l: m.loss(i, l))(model, ids, labels))
+    assert abs(loss - ref_loss) < 2e-4, (loss, ref_loss)
+
+
+def test_ulysses_with_window_still_raises():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1, sequence_parallel="ulysses",
+                           sliding_window=8, num_key_value_heads=4)
     m = LlamaForCausalLM(cfg)
     ids, _ = _data(cfg, batch=1, seq=16)
     mesh = HybridMesh(sp=4, devices=jax.devices()[:4])
