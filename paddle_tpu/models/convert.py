@@ -11,8 +11,8 @@ safetensors file) into the fused TPU layouts used here:
   * lm_head transposes to [h, vocab].
 
 Covers the LLaMA family (LLaMA / Mistral / Qwen2 — Qwen2 adds q/k/v
-biases), GPT-2 (Conv1D [in, out] layout), T5 (v1.0 relu, tied rescaled
-head) and BERT. Numerical parity with the torch reference is asserted
+biases), GPT-2 (Conv1D [in, out] layout), T5 (v1.0 relu tied + v1.1 gated-gelu
+untied) and BERT. Numerical parity with the torch reference is asserted
 in tests/test_convert.py (logits match to fp32 tolerance).
 """
 from __future__ import annotations
@@ -208,16 +208,22 @@ def load_t5_state_dict(model, state_dict, dtype=None):
     def j(a):
         return jnp.asarray(a, dtype)
 
-    # tied checkpoints surface lm_head.weight too (same tensor); only a
-    # genuinely different head makes the tied+rescaled model wrong
-    if "lm_head.weight" in sd and not np.array_equal(
-            sd["lm_head.weight"], sd["shared.weight"]):
+    untied = "lm_head.weight" in sd and not np.array_equal(
+        sd["lm_head.weight"], sd["shared.weight"])
+    if untied and model.lm_head is None:
         raise ValueError(
-            "untied T5 checkpoint (distinct lm_head.weight): this model ties "
-            "the head to the shared embedding with the v1.0 rescale; untied "
-            "(v1.1/gated) checkpoints are not supported yet")
+            "untied T5 checkpoint (distinct lm_head.weight) loaded into a "
+            "tied config: construct the model with "
+            "tie_word_embeddings=False (v1.1)")
+    if not untied and model.lm_head is not None:
+        raise ValueError(
+            "tied T5 checkpoint loaded into an untied config: the tied head "
+            "carries the d_model**-0.5 rescale, so construct the model with "
+            "tie_word_embeddings=True")
     t5 = model.t5
     t5.shared = j(sd["shared.weight"])
+    if model.lm_head is not None:
+        model.lm_head = j(sd["lm_head.weight"].T)
 
     def load_attn(att, p):
         att.q = j(sd[p + ".q.weight"].T)
@@ -237,7 +243,20 @@ def load_t5_state_dict(model, state_dict, dtype=None):
             if blk.is_decoder:
                 load_attn(blk.cross_attn, p + "1.EncDecAttention")
                 blk.ln_cross.weight = j(sd[p + "1.layer_norm.weight"])
-            blk.ff.wi = j(sd[p + f"{ff_idx}.DenseReluDense.wi.weight"].T)
+            gated_key = p + f"{ff_idx}.DenseReluDense.wi_0.weight"
+            ckpt_gated = gated_key in sd
+            if ckpt_gated != blk.ff.gated:
+                raise ValueError(
+                    f"T5 FF variant mismatch at layer {i}: checkpoint is "
+                    f"{'gated' if ckpt_gated else 'relu'} but the config is "
+                    f"{'gated-gelu' if blk.ff.gated else 'relu'}; set "
+                    "feed_forward_proj accordingly")
+            if ckpt_gated:  # v1.1 gated-gelu: fuse wi_0|wi_1
+                wi0 = sd[gated_key].T
+                wi1 = sd[p + f"{ff_idx}.DenseReluDense.wi_1.weight"].T
+                blk.ff.wi = j(np.concatenate([wi0, wi1], axis=1))
+            else:
+                blk.ff.wi = j(sd[p + f"{ff_idx}.DenseReluDense.wi.weight"].T)
             blk.ff.wo = j(sd[p + f"{ff_idx}.DenseReluDense.wo.weight"].T)
             blk.ln2.weight = j(sd[p + f"{ff_idx}.layer_norm.weight"])
         stack.final_norm.weight = j(sd[f"{name}.final_layer_norm.weight"])

@@ -33,6 +33,8 @@ class T5Config:
     relative_attention_num_buckets: int = 32
     relative_attention_max_distance: int = 128
     layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"   # "relu" (v1.0) | "gated-gelu" (v1.1)
+    tie_word_embeddings: bool = True  # v1.1 checkpoints untie the head
     dtype: object = None
     pad_token_id: int = 0
     decoder_start_token_id: int = 0
@@ -40,6 +42,10 @@ class T5Config:
     def __post_init__(self):
         if self.dtype is None:
             self.dtype = get_default_dtype()
+        if self.feed_forward_proj not in ("relu", "gated-gelu"):
+            raise ValueError(
+                f"feed_forward_proj={self.feed_forward_proj!r} not supported "
+                "(use 'relu' for v1.0 or 'gated-gelu' for v1.1)")
 
     @staticmethod
     def tiny(**kw):
@@ -136,11 +142,23 @@ class T5Attention(Module):
 class T5FF(Module):
     def __init__(self, cfg: T5Config):
         super().__init__()
-        self.wi = I.Normal(0.0, cfg.d_model ** -0.5)((cfg.d_model, cfg.d_ff), cfg.dtype)
+        self.gated = cfg.feed_forward_proj.startswith("gated")
+        init_i = I.Normal(0.0, cfg.d_model ** -0.5)
+        if self.gated:  # v1.1: wi_0 (gate, gelu) * wi_1, fused into one matmul
+            self.wi = init_i((cfg.d_model, 2 * cfg.d_ff), cfg.dtype)
+        else:
+            self.wi = init_i((cfg.d_model, cfg.d_ff), cfg.dtype)
         self.wo = I.Normal(0.0, cfg.d_ff ** -0.5)((cfg.d_ff, cfg.d_model), cfg.dtype)
 
     def __call__(self, x):
-        return jax.nn.relu(x @ self.wi) @ self.wo
+        h = x @ self.wi
+        if self.gated:
+            gate, up = jnp.split(h, 2, axis=-1)
+            # HF NewGELUActivation == tanh-approximate gelu
+            h = jax.nn.gelu(gate, approximate=True) * up
+        else:
+            h = jax.nn.relu(h)
+        return h @ self.wo
 
 
 class T5Block(Module):
@@ -203,13 +221,22 @@ class T5ForConditionalGeneration(Module):
         super().__init__()
         self.cfg = cfg
         self.t5 = T5Model(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:  # v1.1: separate head, no rescale
+            self.lm_head = I.Normal(0.0, cfg.d_model ** -0.5)(
+                (cfg.d_model, cfg.vocab_size), cfg.dtype)
+
+    def _project(self, hidden):
+        if self.lm_head is None:
+            # tied embedding head with T5's rescale
+            return (hidden * (self.cfg.d_model ** -0.5)) @ self.t5.shared.T
+        return hidden @ self.lm_head
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
         enc = self.t5.encode(input_ids, attention_mask)
         hidden = self.t5.decode(decoder_input_ids, enc, attention_mask)
-        # tied embedding head with T5's rescale
-        hidden = hidden * (self.cfg.d_model ** -0.5)
-        return hidden @ self.t5.shared.T
+        return self._project(hidden)
 
     def loss(self, input_ids, labels, attention_mask=None):
         """Teacher-forced seq2seq loss; decoder inputs = labels shifted right."""
@@ -237,11 +264,10 @@ class T5ForConditionalGeneration(Module):
             tokens, done = state
             hidden = self.t5.decode(tokens[:, :max_new_tokens + 1], enc,
                                     attention_mask)
-            hidden = hidden * (cfg.d_model ** -0.5)
             # project ONLY step i into the vocab (the [b, L, vocab] matmul
             # would be ~L× wasted MXU work per decode step)
             h_i = jax.lax.dynamic_slice_in_dim(hidden, i, 1, axis=1)[:, 0]
-            step_logits = h_i @ self.t5.shared.T
+            step_logits = self._project(h_i)
             nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
             nxt = jnp.where(done, eos_token_id, nxt)
             done = done | (nxt == eos_token_id)

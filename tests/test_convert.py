@@ -215,3 +215,66 @@ def test_t5_logits_match_transformers():
     got = np.asarray(ours(jnp.asarray(enc_ids), jnp.asarray(dec_ids)),
                      np.float32)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_t5_v11_gated_untied_logits_match_transformers():
+    import torch
+    from transformers import T5Config as HFConfig
+    from transformers import T5ForConditionalGeneration as HFModel
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, d_kv=8, d_ff=64,
+                          num_layers=2, num_decoder_layers=2, num_heads=4,
+                          feed_forward_proj="gated-gelu", dropout_rate=0.0,
+                          tie_word_embeddings=False)).eval()
+    from paddle_tpu.models.convert import load_t5_state_dict
+    from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    pt.seed(0)
+    cfg = T5Config(vocab_size=96, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+                   num_decoder_layers=2, num_heads=4,
+                   feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+                   layer_norm_epsilon=hf.config.layer_norm_epsilon,
+                   dtype=jnp.float32)
+    ours = load_t5_state_dict(T5ForConditionalGeneration(cfg).eval(),
+                              hf.state_dict())
+    rs = np.random.RandomState(6)
+    enc_ids = rs.randint(0, 96, (2, 6))
+    dec_ids = rs.randint(0, 96, (2, 4))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(enc_ids),
+                 decoder_input_ids=torch.tensor(dec_ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(enc_ids), jnp.asarray(dec_ids)),
+                     np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_t5_variant_mismatches_raise():
+    import torch
+    from transformers import T5Config as HFConfig
+    from transformers import T5ForConditionalGeneration as HFModel
+    from paddle_tpu.models.convert import load_t5_state_dict
+    from paddle_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    torch.manual_seed(0)
+    tied_relu = HFModel(HFConfig(vocab_size=96, d_model=32, d_kv=8, d_ff=64,
+                                 num_layers=1, num_decoder_layers=1,
+                                 num_heads=4, feed_forward_proj="relu",
+                                 tie_word_embeddings=True)).eval()
+    pt.seed(0)
+    # tied ckpt -> untied config: raises (rescale mismatch)
+    untied_cfg = T5Config(vocab_size=96, d_model=32, d_kv=8, d_ff=64,
+                          num_layers=1, num_decoder_layers=1, num_heads=4,
+                          tie_word_embeddings=False, dtype=jnp.float32)
+    with pytest.raises(ValueError):
+        load_t5_state_dict(T5ForConditionalGeneration(untied_cfg),
+                           tied_relu.state_dict())
+    # relu ckpt -> gated config: raises (FF variant mismatch)
+    gated_cfg = T5Config(vocab_size=96, d_model=32, d_kv=8, d_ff=64,
+                         num_layers=1, num_decoder_layers=1, num_heads=4,
+                         feed_forward_proj="gated-gelu", dtype=jnp.float32)
+    with pytest.raises(ValueError):
+        load_t5_state_dict(T5ForConditionalGeneration(gated_cfg),
+                           tied_relu.state_dict())
+    # unsupported activation string rejected at config time
+    with pytest.raises(ValueError):
+        T5Config(feed_forward_proj="gated-silu")
