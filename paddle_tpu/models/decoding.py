@@ -21,39 +21,78 @@ from paddle_tpu.ops import attention as A
 
 @dataclass
 class KVCache:
-    """Per-layer [B, max_len, H_kv, D] k/v buffers + current length."""
+    """Per-layer [B, cap, H_kv, D] k/v buffers + current length.
+
+    With ``window`` set (sliding-window models), the cache is a RING of
+    ``cap = min(max_len, window)`` slots: writes land at ``pos % cap`` and
+    ``slot_pos`` tracks each slot's absolute position for masking — decode
+    memory is bounded by the window, not the generation length."""
     k: list
     v: list
     length: jnp.ndarray  # scalar int32
+    slot_pos: object = None  # [cap] int32 absolute positions, or None
 
     @staticmethod
-    def init(num_layers, batch, max_len, num_kv_heads, head_dim, dtype):
-        z = lambda: jnp.zeros((batch, max_len, num_kv_heads, head_dim), dtype)
+    def init(num_layers, batch, max_len, num_kv_heads, head_dim, dtype,
+             window=None):
+        cap = max_len if window is None else min(max_len, window)
+        z = lambda: jnp.zeros((batch, cap, num_kv_heads, head_dim), dtype)
+        slot_pos = None if window is None else jnp.full((cap,), -1, jnp.int32)
         return KVCache([z() for _ in range(num_layers)],
                        [z() for _ in range(num_layers)],
-                       jnp.zeros((), jnp.int32))
+                       jnp.zeros((), jnp.int32), slot_pos)
 
 
 jax.tree_util.register_pytree_node(
     KVCache,
-    lambda c: ((c.k, c.v, c.length), None),
+    lambda c: ((c.k, c.v, c.length, c.slot_pos), None),
     lambda aux, ch: KVCache(*ch))
 
 
 def _attend_with_cache(q, k_cache, v_cache, new_k, new_v, pos,
-                       window=None):
-    """Write new_k/new_v at pos, attend q over cache[:pos+new]. ``window``
-    keeps decode consistent with sliding-window training (Mistral)."""
-    k_cache = lax.dynamic_update_slice_in_dim(k_cache, new_k, pos, axis=1)
-    v_cache = lax.dynamic_update_slice_in_dim(v_cache, new_v, pos, axis=1)
+                       window=None, slot_pos=None):
+    """Write new_k/new_v at pos, attend q over the cache. ``window`` keeps
+    decode consistent with sliding-window training (Mistral). With
+    ``slot_pos`` the cache is a ring of ``cap`` slots: writes wrap at
+    ``pos % cap`` and masking uses each slot's absolute position."""
     sq = q.shape[1]
-    # mask: key index must be <= query absolute position (and in-window)
-    key_idx = jnp.arange(k_cache.shape[1])[None, :]
+    cap = k_cache.shape[1]
     q_idx = pos + jnp.arange(sq)[:, None]
-    keep = key_idx <= q_idx
-    if window is not None:
-        keep &= (q_idx - key_idx) < window
-    mask = keep[None, None]  # [1,1,Sq,Smax]
+    if slot_pos is not None:
+        if sq > 1:
+            # prefill: the whole chunk is in hand — attend over it directly
+            # (the ring may be smaller than the chunk, so early queries'
+            # keys would already be evicted); then keep only the last cap
+            # positions in the ring for decode.
+            if not isinstance(pos, int) and pos is not None:
+                pass  # traced pos: generate() always prefills at pos=0
+            a = jnp.arange(sq)
+            keep = a[:, None] >= a[None, :]
+            if window is not None:
+                keep &= (a[:, None] - a[None, :]) < window
+            out = A.xla_attention(q, new_k, new_v, attn_mask=keep[None, None])
+            tail = min(sq, cap)
+            tail_pos = pos + jnp.arange(sq - tail, sq)
+            idx = tail_pos % cap
+            k_cache = k_cache.at[:, idx].set(new_k[:, sq - tail:])
+            v_cache = v_cache.at[:, idx].set(new_v[:, sq - tail:])
+            return out, k_cache, v_cache
+        idx = (pos + jnp.arange(sq)) % cap
+        k_cache = k_cache.at[:, idx].set(new_k)
+        v_cache = v_cache.at[:, idx].set(new_v)
+        key_abs = slot_pos[None, :]  # [1, cap] (already updated by caller)
+        keep = (key_abs >= 0) & (key_abs <= q_idx)
+        if window is not None:
+            keep &= (q_idx - key_abs) < window
+    else:
+        k_cache = lax.dynamic_update_slice_in_dim(k_cache, new_k, pos, axis=1)
+        v_cache = lax.dynamic_update_slice_in_dim(v_cache, new_v, pos, axis=1)
+        # mask: key index must be <= query absolute position (and in-window)
+        key_idx = jnp.arange(cap)[None, :]
+        keep = key_idx <= q_idx
+        if window is not None:
+            keep &= (q_idx - key_idx) < window
+    mask = keep[None, None]  # [1,1,Sq,cap]
     out = A.xla_attention(q, k_cache, v_cache, attn_mask=mask)
     return out, k_cache, v_cache
 
@@ -66,6 +105,13 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
     positions = pos + jnp.arange(input_ids.shape[1])
     cos, sin = A.rope_cos_sin(input_ids.shape[1], d, base=cfg.rope_theta,
                               position_ids=positions)
+    slot_pos = cache.slot_pos
+    if slot_pos is not None:  # ring cache: record absolute slot positions
+        cap = slot_pos.shape[0]
+        s = input_ids.shape[1]
+        tail = min(s, cap)  # prefill writes only the last cap positions
+        tail_pos = positions[s - tail:]
+        slot_pos = slot_pos.at[tail_pos % cap].set(tail_pos)
     new_k_list, new_v_list = [], []
     for li, lyr in enumerate(model.model.layers):
         h = lyr.input_layernorm(x)
@@ -82,14 +128,16 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
         out, k_c, v_c = _attend_with_cache(q, cache.k[li], cache.v[li],
                                            k, v, pos,
                                            window=getattr(cfg, "sliding_window",
-                                                          None))
+                                                          None),
+                                           slot_pos=slot_pos)
         new_k_list.append(k_c)
         new_v_list.append(v_c)
         x = x + out.reshape(b, s, nh * hd) @ att.o_proj
         x = x + lyr.mlp(lyr.post_attention_layernorm(x))
     x = model.model.norm(x)
     logits = model.logits(x)
-    new_cache = KVCache(new_k_list, new_v_list, pos + input_ids.shape[1])
+    new_cache = KVCache(new_k_list, new_v_list, pos + input_ids.shape[1],
+                        slot_pos)
     return logits, new_cache
 
 
@@ -134,7 +182,8 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=None,
 
     cache = KVCache.init(cfg.num_hidden_layers, b, max_len,
                          cfg.num_key_value_heads,
-                         cfg.hidden_size // cfg.num_attention_heads, cfg.dtype)
+                         cfg.hidden_size // cfg.num_attention_heads, cfg.dtype,
+                         window=getattr(cfg, "sliding_window", None))
 
     def constrain(logits, appeared, gen_len):
         logits = _apply_repetition_penalty(logits, appeared, repetition_penalty)
