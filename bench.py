@@ -5,16 +5,23 @@ fused train step (fwd+bwd+AdamW, bf16 params, fp32 master weights, remat)
 and report MFU against the chip's peak bf16 FLOPs. vs_baseline is MFU/0.50 —
 the reference's own A100 LLaMA MFU ballpark from BASELINE.json.
 
-Prints ONE JSON line.
+Prints ONE JSON line and always exits 0.
+
+Structure: the default entry point is a thin ORCHESTRATOR that never imports
+jax itself. It probes backend init in a subprocess (the axon tunnel, when
+down, hangs interpreter startup for ~60s — even with JAX_PLATFORMS=cpu in
+the inherited env, because the env's AXON_*/PYTHONPATH hooks dial the
+tunnel at import). On probe failure it re-runs the worker under a CLEAN
+env (``env -i``-equivalent) forced to CPU and stamps ``"degraded": true``
+so a dead tunnel degrades to a CPU smoke number instead of rc=1.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 PEAK_BF16 = {
     "TPU v5 lite": 197e12,   # v5e
@@ -22,6 +29,15 @@ PEAK_BF16 = {
     "TPU v5p": 459e12,
     "TPU v4": 275e12,
     "TPU v6": 918e12,
+}
+
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT", "1800"))
+
+CLEAN_ENV = {
+    "PATH": "/opt/venv/bin:/usr/bin:/bin",
+    "HOME": os.environ.get("HOME", "/root"),
+    "JAX_PLATFORMS": "cpu",
 }
 
 
@@ -33,7 +49,102 @@ def chip_peak_flops(dev) -> float:
     return 197e12  # assume v5e-class
 
 
+def _probe_backend(env, timeout=PROBE_TIMEOUT_S):
+    """Probe backend init in a fresh interpreter; return platform str or None."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform)"],
+            env=env, timeout=timeout, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        if r.returncode == 0:
+            platform = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+            print(f"bench probe: backend ok ({platform})", file=sys.stderr)
+            return platform
+        print(f"bench probe: rc={r.returncode} {r.stderr.strip()[-300:]}",
+              file=sys.stderr)
+        return None
+    except subprocess.TimeoutExpired:
+        print(f"bench probe: timed out after {timeout}s", file=sys.stderr)
+        return None
+
+
+def _run_worker(env, timeout=WORKER_TIMEOUT_S):
+    """Run the real bench in a subprocess; return parsed JSON dict or None."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, timeout=timeout, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    except subprocess.TimeoutExpired:
+        print(f"bench worker: timed out after {timeout}s", file=sys.stderr)
+        return None
+    sys.stderr.write(r.stderr[-4000:])
+    if r.returncode != 0:
+        print(f"bench worker: rc={r.returncode}", file=sys.stderr)
+        return None
+    # last JSON-object stdout line is the result
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    print("bench worker: no JSON-object line in stdout", file=sys.stderr)
+    return None
+
+
+def orchestrate():
+    """Never-fail entry: probe inherited env, else clean-env CPU fallback.
+
+    A result counts as non-degraded ONLY when the probe saw a real TPU —
+    a CPU-only env (e.g. JAX_PLATFORMS=cpu during a tunnel outage) still
+    produces a number, but stamped ``"degraded": true`` so the driver
+    never records a CPU smoke as an on-chip bench.
+    """
+    inherited = dict(os.environ)
+    platform = _probe_backend(inherited)
+    reason = None
+    if platform == "tpu":
+        result = _run_worker(inherited)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        reason = "worker failed/timed out under live tpu backend; clean-env cpu smoke"
+        print("bench: worker failed under live backend; falling back to "
+              "clean-env CPU", file=sys.stderr)
+    elif platform is not None:
+        reason = f"backend is '{platform}', not tpu; clean-env cpu smoke"
+        print(f"bench: probe found non-tpu backend '{platform}'; running "
+              "clean-env CPU (degraded)", file=sys.stderr)
+    else:
+        reason = "tpu backend init failed or hung; clean-env cpu smoke"
+        print("bench: backend init failed/hung; falling back to clean-env "
+              "CPU (degraded)", file=sys.stderr)
+    result = _run_worker(dict(CLEAN_ENV), timeout=WORKER_TIMEOUT_S)
+    if result is not None:
+        result["degraded"] = True
+        extra = result.setdefault("extra", {})
+        if isinstance(extra, dict):
+            extra["degraded_reason"] = reason
+        print(json.dumps(result))
+        return
+    # absolute last resort: still one JSON line, rc 0
+    print(json.dumps({
+        "metric": "llama train step tokens/sec/chip",
+        "value": 0.0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "degraded": True,
+        "extra": {"degraded_reason": reason + "; and clean-env cpu worker failed"},
+    }))
+
+
 def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
     import paddle_tpu as pt
     import paddle_tpu.optimizer as opt
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, num_flops_per_token
@@ -82,8 +193,6 @@ def main():
     except Exception as e:  # pragma: no cover - TPU-compile specific
         if not on_tpu:
             raise  # flash never dispatches off-TPU; surface the real error
-        import os
-        import sys
         print(f"flash path failed ({type(e).__name__}); retrying with XLA "
               "attention", file=sys.stderr)
         os.environ["PADDLE_TPU_DISABLE_FLASH"] = "1"
@@ -127,4 +236,16 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        main()
+    else:
+        try:
+            orchestrate()
+        except Exception as e:  # noqa: BLE001 — contract: one JSON line, rc 0
+            print(f"bench orchestrator crashed: {e!r}", file=sys.stderr)
+            print(json.dumps({
+                "metric": "llama train step tokens/sec/chip",
+                "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+                "degraded": True,
+                "extra": {"degraded_reason": f"orchestrator crash: {type(e).__name__}"},
+            }))

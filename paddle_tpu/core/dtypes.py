@@ -45,3 +45,22 @@ def default_dtype(dtype):
 
 def is_floating(dtype) -> bool:
     return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def canonical_int_dtype(dtype):
+    """Platform-canonical integer dtype WITHOUT jax's truncation warning.
+
+    The reference defaults index-producing ops (randint, argmax, ...) to
+    int64; under jax without x64 those arrays are int32. Requesting int64
+    would produce the same int32 array plus a per-call UserWarning — map it
+    up front instead (deliberate, documented difference: MIGRATING.md).
+    """
+    import numpy as np
+    try:
+        import jax
+        x64 = bool(jax.config.jax_enable_x64)
+    except Exception:
+        x64 = False
+    if not x64 and np.dtype(dtype) in (np.dtype("int64"), np.dtype("uint64")):
+        return jnp.int32 if np.dtype(dtype) == np.dtype("int64") else jnp.uint32
+    return dtype

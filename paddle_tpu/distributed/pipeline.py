@@ -4,12 +4,24 @@ meta_parallel/pipeline_parallel.py`` — PipelineLayer + 1F1B scheduler).
 The reference runs an imperative per-rank scheduler exchanging activations
 with NCCL send/recv. TPU-native formulation: SPMD over the ``pp`` mesh axis —
 stage weights live stacked on a leading pp dimension sharded P("pp", ...),
-the microbatch loop is a ``lax.scan``, and the stage handoff is a
-``ppermute`` ring. XLA overlaps the permute with the next microbatch's
-compute (fill-drain/GPipe schedule; the backward pass is derived by autodiff
-through the scan+ppermute, which replays the ring in reverse — activations
-are rematerialised per-stage via ``jax.checkpoint`` so pipeline memory
-matches 1F1B's working set rather than storing every microbatch).
+the schedule is a ``lax.scan`` over global ticks, and the stage handoff is a
+``ppermute`` ring.
+
+Two schedules live here:
+
+- ``pipeline_apply`` — forward-only fill-drain (GPipe) wavefront. Used for
+  inference/eval and by ``PipelineLayer.__call__``; differentiating through
+  it gives GPipe's all-forward-then-all-backward with per-stage remat.
+- ``pipeline_train_1f1b`` — TRUE 1F1B training schedule with a manually
+  written backward pass (the reference's ``_1f1b_schedule``): each global
+  tick every stage runs one forward microbatch AND one backward microbatch
+  (the SPMD "shifted-buffer" formulation of 1F1B — GSPMD-style), so the
+  in-flight residual window is a ring of ``2*pp - 1`` saved stage inputs
+  **independent of the number of microbatches M** (GPipe stores M). The
+  backward slot recomputes the stage forward from its saved input
+  (activation-checkpoint style, like the reference's recompute+1F1B mode)
+  and accumulates param grads in fp32. Steady-state bubble fraction is
+  ``2(pp-1)/(M + 2(pp-1))`` and vanishes as M grows.
 """
 from __future__ import annotations
 
@@ -83,13 +95,271 @@ def pipeline_apply(stacked_stage_params, layer_fn: Callable, x_microbatches,
         return (ring_next, out_buf), None
 
     # initial carry must be marked pp-varying (the loop makes it so)
-    try:
-        ring0 = lax.pvary(ring0, (axis_name,))
-        out_buf = lax.pvary(out_buf, (axis_name,))
-    except Exception:
-        pass
+    ring0 = _pvary(ring0, axis_name)
+    out_buf = _pvary(out_buf, axis_name)
     (_, out_buf), _ = lax.scan(tick, (ring0, out_buf), jnp.arange(ticks))
     return out_buf
+
+
+def _f32_zeros_like(tree):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+
+
+def _pvary(tree, axis_name: str):
+    """Mark every leaf as varying over `axis_name` (no-op on old jax).
+
+    Needed for replicated params differentiated inside shard_map: AD
+    transposes the unvarying→varying broadcast into an implicit psum, which
+    would sum per-stage cotangents (including masked-garbage stages) before
+    our own masking — marking the primal varying keeps grads per-stage.
+    """
+    def mark(v):
+        try:
+            return lax.pcast(v, axis_name, to="varying")
+        except ValueError:
+            return v  # already varying over axis_name — idempotent no-op
+        except (AttributeError, TypeError):
+            try:
+                return lax.pvary(v, (axis_name,))
+            except Exception:
+                return v
+    return jax.tree_util.tree_map(mark, tree)
+
+
+def _masked_add(acc, upd, valid):
+    return jax.tree_util.tree_map(
+        lambda a, u: a + jnp.where(valid, u.astype(a.dtype), 0), acc, upd)
+
+
+def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
+                        axis_name: str = "pp",
+                        embed_params=None, embed_fn: Callable = None,
+                        head_params=None, head_loss_fn: Callable = None):
+    """TRUE 1F1B pipeline training step. Call inside ``shard_map``.
+
+    Ref: ``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
+    (1F1B) — here as an SPMD shifted-buffer schedule: at global tick ``t``
+    stage ``s`` runs the forward of microbatch ``t - s`` and the backward of
+    microbatch ``t - (2*(pp-1) - s)``; at the last stage a microbatch's
+    backward fires on the SAME tick as its forward (that is the "1B after
+    1F" property), and cotangents ride a reverse ``ppermute`` ring one stage
+    per tick. Residuals (stage inputs) live in a ring of ``2*pp - 1`` slots
+    — constant in M — and the backward slot recomputes the stage forward
+    under ``jax.vjp`` (recompute-style 1F1B, the reference's
+    recompute+1F1B mode).
+
+    Args:
+      stage_params: this stage's parameter pytree (sharded P("pp", ...)
+        outside; inside shard_map it is the local stage's block).
+      stage_fwd(stage_params, x) -> y: applies the whole local stage.
+      x_mb: [M, mb, ...] microbatched stage-0 input (token ids if
+        ``embed_fn`` is given, else already-embedded activations).
+      y_mb: [M, mb, ...] per-microbatch labels, consumed at the last stage.
+      embed_params/embed_fn(embed_params, tokens) -> activations: optional
+        replicated pre-stage (embedding) evaluated at stage 0; its grads are
+        returned replicated (psum over pp).
+      head_params/head_loss_fn(head_params, y, labels) -> scalar mean loss:
+        the loss head evaluated at the LAST stage. When ``head_loss_fn`` is
+        None, ``y_mb`` must be unused and the loss is mean(y) (testing).
+
+    Returns:
+      (loss, dstage, dembed, dhead): scalar mean loss over all microbatches
+      (replicated), fp32 grads for the local stage (P("pp", ...)), and
+      replicated fp32 grads for embed/head params (``()`` where unused).
+    """
+    pp = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+    R = 2 * pp - 1                      # residual ring slots, M-independent
+    T = M + 2 * (pp - 1)                # global ticks
+
+    has_head = head_loss_fn is not None
+    has_embed = embed_fn is not None
+    if not has_head:
+        head_params = ()
+        head_loss_fn = lambda hp, y, lbl: jnp.mean(y)
+    if not has_embed:
+        embed_params = ()
+        embed_fn = lambda ep, x: x
+    # replicated params must be stage-varying before AD (see _pvary)
+    head_params = _pvary(head_params, axis_name)
+    embed_params = _pvary(embed_params, axis_name)
+
+    # activation shape: embed output of one microbatch
+    act = jax.eval_shape(embed_fn, embed_params,
+                         jax.eval_shape(lambda a: a[0], x_mb))
+    act_shape, act_dtype = act.shape, act.dtype
+
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+    is_last = s == pp - 1
+    is_first = s == 0
+
+    def loss_and_dy(y, labels):
+        def f(yy, hp):
+            return head_loss_fn(hp, yy, labels)
+        (loss, (dy, dhead)) = jax.value_and_grad(f, argnums=(0, 1))(
+            y, head_params)
+        return loss, dy, dhead
+
+    carry0 = dict(
+        fwd_ring=jnp.zeros(act_shape, act_dtype),
+        bwd_ring=jnp.zeros(act_shape, act_dtype),
+        resid=jnp.zeros((R,) + act_shape, act_dtype),
+        loss=jnp.zeros((), jnp.float32),
+        dstage=_f32_zeros_like(stage_params),
+        dembed=_f32_zeros_like(embed_params),
+        dhead=_f32_zeros_like(head_params),
+    )
+
+    tree_add = lambda acc, upd: jax.tree_util.tree_map(
+        lambda a, u: a + u.astype(a.dtype), acc, upd)
+
+    def tick(c, t):
+        # ---------------- forward slot: microbatch t - s ----------------
+        m_f = t - s
+        fwd_valid = jnp.logical_and(m_f >= 0, m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        tokens = lax.dynamic_index_in_dim(x_mb, m_f_c, 0, keepdims=False)
+        # per-device branch: only stage 0 pays for the embedding gather
+        # (inside shard_map the predicate is a local scalar, so lax.cond is
+        # real control flow, not a both-sides select)
+        x_in = lax.cond(is_first,
+                        lambda: embed_fn(embed_params, tokens)
+                        .astype(act_dtype),
+                        lambda: c["fwd_ring"])
+        y = stage_fwd(stage_params, x_in).astype(act_dtype)
+
+        resid_new = lax.dynamic_update_index_in_dim(
+            c["resid"], x_in, jnp.mod(m_f_c, R), 0)
+        resid = jnp.where(fwd_valid, resid_new, c["resid"])
+
+        # last stage only: loss + cotangent seed for this same microbatch
+        # (head fwd+bwd is often the biggest op in the step — gate it)
+        labels = lax.dynamic_index_in_dim(y_mb, m_f_c, 0, keepdims=False)
+        take_loss = jnp.logical_and(is_last, fwd_valid)
+
+        def head_branch(y, labels):
+            loss_m, dy, dhead_m = loss_and_dy(y, labels)
+            return (loss_m.astype(jnp.float32), dy.astype(act_dtype),
+                    dhead_m)
+
+        def head_skip(y, labels):
+            return _pvary((jnp.zeros((), jnp.float32), jnp.zeros_like(y),
+                           jax.tree_util.tree_map(jnp.zeros_like,
+                                                  head_params)), axis_name)
+
+        loss_m, dy, dhead_m = lax.cond(take_loss, head_branch, head_skip,
+                                       y, labels)
+        loss = c["loss"] + loss_m
+        dhead = tree_add(c["dhead"], dhead_m)
+
+        # ---------------- backward slot: microbatch t - (2(pp-1) - s) ----
+        m_b = t - (2 * (pp - 1) - s)
+        bwd_valid = jnp.logical_and(m_b >= 0, m_b < M)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        x_saved = lax.dynamic_index_in_dim(resid, jnp.mod(m_b_c, R), 0,
+                                           keepdims=False)
+        g = jnp.where(is_last, dy, c["bwd_ring"])
+        _, vjp_fn = jax.vjp(stage_fwd, stage_params, x_saved)
+        dp, dx = vjp_fn(g.astype(act.dtype))
+        dstage = _masked_add(c["dstage"], dp, bwd_valid)
+
+        # stage 0's backward also flows into the embedding — gated likewise
+        tokens_b = lax.dynamic_index_in_dim(x_mb, m_b_c, 0, keepdims=False)
+
+        def embed_grad_branch(dx):
+            _, evjp = jax.vjp(
+                lambda ep: embed_fn(ep, tokens_b).astype(act_dtype),
+                embed_params)
+            (dembed_m,) = evjp(dx)
+            return dembed_m
+
+        def embed_grad_skip(dx):
+            return _pvary(jax.tree_util.tree_map(jnp.zeros_like,
+                                                 embed_params), axis_name)
+
+        dembed_m = lax.cond(jnp.logical_and(is_first, bwd_valid),
+                            embed_grad_branch, embed_grad_skip, dx)
+        dembed = tree_add(c["dembed"], dembed_m)
+
+        # ---------------- ring handoffs ----------------
+        fwd_ring = lax.ppermute(y, axis_name, fwd_perm)
+        bwd_ring = lax.ppermute(dx.astype(act_dtype), axis_name, bwd_perm)
+        return dict(fwd_ring=fwd_ring, bwd_ring=bwd_ring, resid=resid,
+                    loss=loss, dstage=dstage, dembed=dembed,
+                    dhead=dhead), None
+
+    # the loop makes every carry leaf pp-varying; mark the init accordingly
+    carry0 = _pvary(carry0, axis_name)
+    c, _ = lax.scan(tick, carry0, jnp.arange(T))
+
+    inv_m = 1.0 / M
+    loss = lax.psum(c["loss"], axis_name) * inv_m
+    scale = lambda tr: jax.tree_util.tree_map(lambda g: g * inv_m, tr)
+    dstage = scale(c["dstage"])
+    dembed = jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axis_name), scale(c["dembed"]))
+    dhead = jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axis_name), scale(c["dhead"]))
+    return loss, dstage, dembed, dhead
+
+
+def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
+                        layer_call: Callable = None,
+                        head_loss_fn: Callable = None, head_params=None,
+                        embed_fn: Callable = None, embed_params=None):
+    """1F1B loss+grads for a PipelineLayer under ``mesh`` (pp axis).
+
+    Splits the batch into ``pipe.num_microbatches``, runs the 1F1B schedule
+    in a ``shard_map`` over the pp axis, and returns
+    ``(loss, stacked_grads, dembed, dhead)`` — grads are fp32, stacked
+    grads sharded P("pp", ...) exactly like the params, embed/head grads
+    replicated (``None`` when the corresponding part was not given).
+    """
+    from jax import shard_map
+
+    layer_call = layer_call or (lambda lyr, h: lyr(h))
+    mb_n = pipe.num_microbatches
+    b = x.shape[0]
+    assert b % mb_n == 0, \
+        f"num_microbatches ({mb_n}) must divide the batch size ({b})"
+    xm = x.reshape((mb_n, b // mb_n) + x.shape[1:])
+    ym = y.reshape((mb_n, b // mb_n) + y.shape[1:])
+
+    has_embed = embed_fn is not None
+    has_head = head_loss_fn is not None
+    embed_params = embed_params if has_embed else ()
+    head_params = head_params if has_head else ()
+
+    pspec = pipe.stage_specs()
+    rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+    xspec = P(*(None,) * xm.ndim)
+    yspec = P(*(None,) * ym.ndim)
+
+    def stage_fwd(stage_params, h):
+        def body(hh, lyr):
+            return layer_call(lyr, hh), None
+        run = lambda p, v: lax.scan(body, v, p)[0]
+        if pipe.remat:
+            run = jax.checkpoint(run)
+        return run(stage_params, h)
+
+    @functools.partial(
+        shard_map, mesh=mesh.mesh,
+        in_specs=(pspec, xspec, yspec, rep(embed_params), rep(head_params)),
+        out_specs=(P(), pspec, rep(embed_params), rep(head_params)))
+    def run(stage_params, xm, ym, embed_params, head_params):
+        return pipeline_train_1f1b(
+            stage_params, stage_fwd, xm, ym,
+            embed_params=embed_params, embed_fn=embed_fn,
+            head_params=head_params, head_loss_fn=head_loss_fn)
+
+    loss, dstage, dembed, dhead = run(pipe.stacked, xm, ym,
+                                      embed_params, head_params)
+    return (loss, dstage,
+            dembed if has_embed else None, dhead if has_head else None)
 
 
 class PipelineLayer(Module):

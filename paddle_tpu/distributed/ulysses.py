@@ -34,7 +34,16 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
             f"axis '{axis_name}' size {sp}")
     if k.shape[2] % sp != 0:
         # GQA with fewer KV heads than sp: replicate KV groups up to sp so
-        # the head-scatter has something to split (standard Ulysses-GQA)
+        # the head-scatter has something to split (standard Ulysses-GQA).
+        # COST: the repeat materialises rep x the local KV before the
+        # all_to_all (transient memory) and the exchange then moves
+        # S_local*(sp-1)*D bytes/device instead of the no-GQA
+        # S_local*kv_heads*(sp-1)/sp*D — an ICI multiplier of
+        # rep = sp/kv_heads. There is no "repeat after the exchange"
+        # alternative here: with kv_heads < sp the heads cannot be split sp
+        # ways un-replicated, and an all_gather(seq) of the original KV
+        # costs MORE ((sp-1)*S_local*kv_heads*D). When this bites, prefer
+        # sequence_parallel="ring" (rotates un-replicated KV).
         if sp % k.shape[2] == 0:
             rep = sp // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)

@@ -61,10 +61,15 @@ def fused_feedforward(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
     if pre_layer_norm:
         x = _F.layer_norm(x, x.shape[-1], ln_scale, ln_bias, epsilon)
     act = {"gelu": _F.gelu, "relu": _F.relu, "silu": _F.silu}[activation]
+    # two INDEPENDENT dropout masks (ref uses two distinct dropout ops)
+    rng1 = rng2 = rng
+    if dropout_p and rng is not None:
+        import jax
+        rng1, rng2 = jax.random.split(rng)
     h = act(x @ w1 + (b1 if b1 is not None else 0))
-    h = _F.dropout(h, dropout_p, training, rng=rng) if dropout_p else h
+    h = _F.dropout(h, dropout_p, training, rng=rng1) if dropout_p else h
     h = h @ w2 + (b2 if b2 is not None else 0)
-    h = _F.dropout(h, dropout_p, training, rng=rng) if dropout_p else h
+    h = _F.dropout(h, dropout_p, training, rng=rng2) if dropout_p else h
     if add_residual:
         h = h + residual
     if not pre_layer_norm:

@@ -1,5 +1,7 @@
 """Metrics (ref: ``python/paddle/metric/metrics.py`` — Metric, Accuracy,
-Precision, Recall, Auc). Host-accumulated; updates accept jax or numpy."""
+Precision, Recall, Auc — plus the legacy ``paddle/fluid/metrics.py`` family:
+CompositeMetric, ChunkEvaluator, EditDistance). Host-accumulated; updates
+accept jax or numpy."""
 from __future__ import annotations
 
 import numpy as np
@@ -117,6 +119,192 @@ class Auc(Metric):
         tpr = np.concatenate([tp / tot_pos, [0.0]])
         fpr = np.concatenate([fp / tot_neg, [0.0]])
         return float(np.abs(np.trapezoid(tpr, fpr)))
+
+
+class CompositeMetric(Metric):
+    """Ref ``fluid.metrics.CompositeMetric`` — evaluate several metrics on
+    the same (pred, label) stream; ``accumulate`` returns their results in
+    registration order."""
+
+    def __init__(self, *metrics):
+        self._metrics = list(metrics)
+
+    def add_metric(self, metric):
+        if not isinstance(metric, Metric):
+            raise TypeError("add_metric expects a Metric instance")
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, pred, label):
+        for m in self._metrics:
+            m.update(pred, label)
+
+    def accumulate(self):
+        return [m.accumulate() for m in self._metrics]
+
+
+def extract_chunks(tags, scheme: str = "IOB", num_chunk_types: int = None):
+    """Decode a tag sequence into (start, end, type) chunks.
+
+    Tag encoding follows the reference ChunkEvaluator: for scheme "IOB"
+    tag = chunk_type * 2 + {0: B, 1: I}, and the last tag id (==
+    num_chunk_types * 2) is O. "IOE" uses {0: E, 1: I}; "IOBES" uses
+    tag = chunk_type * 4 + {0:B, 1:I, 2:E, 3:S}, O = num_chunk_types*4.
+    """
+    chunks = []
+    n = len(tags)
+    width = {"IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    start = None
+    ctype = None
+
+    def flush(end):
+        nonlocal start, ctype
+        if start is not None:
+            chunks.append((start, end, ctype))
+        start, ctype = None, None
+
+    for i, t in enumerate(list(tags) + [None]):
+        if t is None or (num_chunk_types is not None
+                         and t >= num_chunk_types * width):
+            flush(i - 1)  # O tag / end of sentence
+            continue
+        ct, pos = int(t) // width, int(t) % width
+        if scheme == "IOB":
+            if pos == 0:  # B
+                flush(i - 1)
+                start, ctype = i, ct
+            else:  # I: continues only if same type is open
+                if start is None or ctype != ct:
+                    flush(i - 1)
+                    start, ctype = i, ct  # tolerate I-start (common lenient)
+        elif scheme == "IOE":
+            if pos == 1:  # I
+                if start is None or ctype != ct:
+                    flush(i - 1)
+                    start, ctype = i, ct
+            else:  # E closes the chunk
+                if start is None or ctype != ct:
+                    start, ctype = i, ct
+                flush(i)
+        else:  # IOBES
+            if pos == 0:  # B
+                flush(i - 1)
+                start, ctype = i, ct
+            elif pos == 1:  # I
+                if start is None or ctype != ct:
+                    flush(i - 1)
+                    start, ctype = i, ct
+            elif pos == 2:  # E
+                if start is None or ctype != ct:
+                    start, ctype = i, ct
+                flush(i)
+            else:  # S: single-token chunk
+                flush(i - 1)
+                chunks.append((i, i, ct))
+    return chunks
+
+
+class ChunkEvaluator(Metric):
+    """Ref ``fluid.metrics.ChunkEvaluator`` / chunk_eval op — micro-averaged
+    precision/recall/F1 over decoded chunks (NER-style sequence labeling).
+
+    ``update(preds, labels, seq_lens)`` takes int tag ids [B, T] and per-row
+    valid lengths; ``accumulate`` returns (precision, recall, f1).
+    """
+
+    def __init__(self, num_chunk_types: int, chunk_scheme: str = "IOB"):
+        if chunk_scheme not in ("IOB", "IOE", "IOBES"):
+            raise ValueError(f"unsupported chunk_scheme {chunk_scheme!r}")
+        if not isinstance(num_chunk_types, int) or num_chunk_types < 1:
+            # without it O tags would decode as phantom chunk types and the
+            # metric would be silently wrong (the reference requires it too)
+            raise ValueError("num_chunk_types (a positive int) is required")
+        self.num_chunk_types = num_chunk_types
+        self.scheme = chunk_scheme
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, preds, labels, seq_lens=None):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels)
+        if preds.ndim == 1:
+            preds, labels = preds[None], labels[None]
+        if seq_lens is None:
+            seq_lens = [preds.shape[1]] * preds.shape[0]
+        for p_row, l_row, n in zip(preds, labels, np.asarray(seq_lens)):
+            p_chunks = set(extract_chunks(p_row[:n], self.scheme,
+                                          self.num_chunk_types))
+            l_chunks = set(extract_chunks(l_row[:n], self.scheme,
+                                          self.num_chunk_types))
+            self.num_infer_chunks += len(p_chunks)
+            self.num_label_chunks += len(l_chunks)
+            self.num_correct_chunks += len(p_chunks & l_chunks)
+        return self.accumulate()
+
+    def accumulate(self):
+        p = (self.num_correct_chunks / self.num_infer_chunks
+             if self.num_infer_chunks else 0.0)
+        r = (self.num_correct_chunks / self.num_label_chunks
+             if self.num_label_chunks else 0.0)
+        f1 = 2 * p * r / (p + r) if (p + r) else 0.0
+        return p, r, f1
+
+
+class EditDistance(Metric):
+    """Ref ``fluid.metrics.EditDistance`` — average Levenshtein distance
+    between predicted and reference sequences, optionally normalized by the
+    reference length."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    @staticmethod
+    def _levenshtein(a, b):
+        la, lb = len(a), len(b)
+        prev = np.arange(lb + 1, dtype=np.int64)
+        for i in range(1, la + 1):
+            cur = np.empty(lb + 1, np.int64)
+            cur[0] = i
+            for j in range(1, lb + 1):
+                cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                             prev[j - 1] + (a[i - 1] != b[j - 1]))
+            prev = cur
+        return int(prev[lb])
+
+    def update(self, preds, labels):
+        """preds/labels: lists of sequences (token-id lists or strings)."""
+        if len(preds) != len(labels):
+            raise ValueError(
+                f"EditDistance.update: {len(preds)} preds vs "
+                f"{len(labels)} labels (batch sizes must match)")
+        for p, l in zip(preds, labels):
+            p = list(np.asarray(p).reshape(-1)) if not isinstance(p, str) else p
+            l = list(np.asarray(l).reshape(-1)) if not isinstance(l, str) else l
+            d = self._levenshtein(p, l)
+            if self.normalized:
+                d = d / max(len(l), 1)
+            self.total_distance += d
+            self.seq_num += 1
+            self.instance_error += int(d != 0)
+        return self.accumulate()
+
+    def accumulate(self):
+        avg = self.total_distance / self.seq_num if self.seq_num else 0.0
+        err = self.instance_error / self.seq_num if self.seq_num else 0.0
+        return avg, err
 
 
 def accuracy(pred, label, k=1):

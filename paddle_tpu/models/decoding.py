@@ -64,8 +64,16 @@ def _attend_with_cache(q, k_cache, v_cache, new_k, new_v, pos,
             # (the ring may be smaller than the chunk, so early queries'
             # keys would already be evicted); then keep only the last cap
             # positions in the ring for decode.
-            if not isinstance(pos, int) and pos is not None:
-                pass  # traced pos: generate() always prefills at pos=0
+            # The chunk-local attention below IGNORES pre-existing ring
+            # contents, so resuming/chunked prefill over a non-empty ring
+            # would be silently wrong — require a statically-known pos==0
+            # (generate()/beam_search prefill with a literal 0).
+            if not (isinstance(pos, int) and pos == 0):
+                raise NotImplementedError(
+                    "ring-cache (windowed) prefill requires static pos==0; "
+                    f"got pos={pos!r}. Chunked prefill over an existing "
+                    "ring cache is not supported — prefill the whole "
+                    "prompt at once.")
             a = jnp.arange(sq)
             keep = a[:, None] >= a[None, :]
             if window is not None:

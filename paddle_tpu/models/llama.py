@@ -256,6 +256,60 @@ class LlamaForCausalLM(Module):
         return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
+def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
+                              labels, num_microbatches: int):
+    """1F1B pipeline-parallel loss + grads for LLaMA over the pp mesh axis.
+
+    Decoder layers are the pipeline stages; the embedding runs at stage 0
+    and the (final-norm + lm_head + masked-CE) head at the last stage, both
+    with replicated grads. Per-microbatch losses are averaged, which equals
+    ``model.loss`` exactly when every microbatch masks the same number of
+    label positions (the standard shifted-labels -100 tail does).
+    Ref: ``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``.
+
+    Returns ``(loss, grads)`` with ``grads = {layers, embed_tokens,
+    norm_weight, lm_head}`` — ``layers`` stacked [L, ...] and sharded
+    P("pp", ...) like the stage params.
+    """
+    from paddle_tpu.distributed.pipeline import (PipelineLayer,
+                                                 pipeline_train_step)
+    cfg = model.cfg
+    assert model.lm_head is not None, \
+        "pipeline head needs untied embeddings (tie_word_embeddings=False)"
+    mdl = model.model
+    assert mdl.layers, "pipeline stages need scan_layers=False"
+    pipe = PipelineLayer(mdl.layers, num_stages=mesh.pp,
+                         num_microbatches=num_microbatches, remat=cfg.remat)
+    cos, sin = A.rope_cos_sin(input_ids.shape[1],
+                              cfg.hidden_size // cfg.num_attention_heads,
+                              base=cfg.rope_theta)
+
+    def layer_call(lyr, h):
+        return lyr(h, cos, sin, None)
+
+    def embed_fn(emb_w, ids):
+        return jnp.take(emb_w, ids, axis=0)
+
+    eps = cfg.rms_norm_eps
+
+    def head_loss(hp, hidden, lbl):
+        norm_w, head_w = hp
+        h = fused_rms_norm(hidden, norm_w, eps)
+        logits = (h @ head_w).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        per_tok = -jnp.take_along_axis(
+            logp, jnp.maximum(lbl, 0)[..., None], -1)[..., 0]
+        mask = (lbl >= 0).astype(jnp.float32)
+        return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    loss, dstage, dembed, dhead = pipeline_train_step(
+        pipe, mesh, input_ids, labels, layer_call=layer_call,
+        head_loss_fn=head_loss, head_params=(mdl.norm.weight, model.lm_head),
+        embed_fn=embed_fn, embed_params=mdl.embed_tokens)
+    return loss, dict(layers=dstage, embed_tokens=dembed,
+                      norm_weight=dhead[0], lm_head=dhead[1])
+
+
 def num_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Training FLOPs/token ≈ 6*N_params + attention term (for MFU)."""
     h, m, L, v = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers, cfg.vocab_size

@@ -129,6 +129,12 @@ class Optimizer:
     # -- state --------------------------------------------------------------
     def init(self, params) -> dict:
         state = {"step": jnp.zeros((), jnp.int32)}
+        if not isinstance(self.learning_rate, LRScheduler):
+            # the lr is STATE, not a Python constant: inside a jitted train
+            # step it is a traced array, so set_lr(..., state) takes effect
+            # immediately without recompiling the step (ref Optimizer.set_lr
+            # semantics; a folded-in float would freeze after first compile)
+            state["lr"] = jnp.asarray(float(self.learning_rate), jnp.float32)
         if self.multi_precision:
             # master copies ONLY for reduced-precision float params — an
             # fp32 "copy" via astype (or a passthrough leaf) would alias the
@@ -150,22 +156,40 @@ class Optimizer:
         lr = self.learning_rate
         if isinstance(lr, LRScheduler):
             return lr.value_at(state["step"])
+        if "lr" in state:
+            return state["lr"]
         return jnp.asarray(lr, jnp.float32)
 
-    def set_lr(self, value):
+    def set_lr(self, value, state=None):
         """Ref Optimizer.set_lr — override the current learning rate (only
-        valid with a float lr, matching the reference's restriction)."""
+        valid with a float lr, matching the reference's restriction).
+
+        The lr lives in the optimizer state, so for a compiled train step
+        pass that state and use the returned copy:
+        ``state = opt.set_lr(3e-5, state)`` — the new value flows into the
+        jitted step as data, no recompile. Called without ``state`` it
+        updates future ``init()`` calls and the eager ``minimize`` state.
+        """
         if isinstance(self.learning_rate, LRScheduler):
             raise RuntimeError(
                 "set_lr is not allowed when the lr is an LRScheduler "
                 "(reference behavior); mutate the scheduler instead")
         self.learning_rate = float(value)
+        if state is not None:
+            new = dict(state)
+            new["lr"] = jnp.asarray(float(value), jnp.float32)
+            return new
+        if hasattr(self, "_eager_state") and "lr" in self._eager_state:
+            self._eager_state["lr"] = jnp.asarray(float(value), jnp.float32)
+        return None
 
     def get_lr(self, state=None):
         if isinstance(self.learning_rate, LRScheduler):
             if state is not None:
                 return float(self.learning_rate.value_at(state["step"]))
             return self.learning_rate.get_lr()
+        if state is not None and "lr" in state:
+            return float(state["lr"])
         return self.learning_rate
 
     # -- update -------------------------------------------------------------

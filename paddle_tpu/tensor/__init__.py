@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddle_tpu.core import dtypes as _dt
 from paddle_tpu.core.dtypes import get_default_dtype
 
 # -- creation (ref python/paddle/tensor/creation.py) ------------------------
@@ -696,12 +697,12 @@ def unique_consecutive(x, axis=None):
 
 def argmax(x, axis=None, keepdim=False, dtype="int64"):
     out = jnp.argmax(x, axis=axis, keepdims=keepdim)
-    return out.astype(dtype)
+    return out.astype(_dt.canonical_int_dtype(dtype))
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64"):
     out = jnp.argmin(x, axis=axis, keepdims=keepdim)
-    return out.astype(dtype)
+    return out.astype(_dt.canonical_int_dtype(dtype))
 
 
 def argsort(x, axis=-1, descending=False, stable=True):
@@ -806,7 +807,8 @@ def randn(shape, dtype=None):
 def randint(low, high=None, shape=(1,), dtype="int64"):
     if high is None:
         low, high = 0, low
-    return jax.random.randint(_k(), shape, low, high, dtype=dtype)
+    return jax.random.randint(_k(), shape, low, high,
+                              dtype=_dt.canonical_int_dtype(dtype))
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0):
@@ -819,7 +821,7 @@ def normal(mean=0.0, std=1.0, shape=(1,)):
 
 
 def randperm(n, dtype="int64"):
-    return jax.random.permutation(_k(), n).astype(dtype)
+    return jax.random.permutation(_k(), n).astype(_dt.canonical_int_dtype(dtype))
 
 
 def multinomial(x, num_samples=1, replacement=False):

@@ -15,12 +15,17 @@ C ABI convention (documented to extension authors):
 Inputs arrive as contiguous fp32 buffers with their element counts; the
 output buffer is pre-allocated by the caller from ``out_shape``. A
 gradient op named ``<name>_grad`` with the same ABI (inputs = primal
-inputs + upstream cotangent, output = input cotangent) is wired into a
-``jax.custom_vjp`` automatically when present.
+inputs + upstream cotangent, output = cotangent of input 0) is wired into
+a ``jax.custom_vjp`` automatically when present; additional inputs get
+their own symbols ``<name>_grad1``, ``<name>_grad2``, ... (same ABI,
+output shaped like input i). Inputs WITHOUT a grad symbol are
+NaN-poisoned in the backward pass, so differentiating w.r.t. them fails
+loudly instead of silently producing zeros.
 """
 from __future__ import annotations
 
 import ctypes
+import functools
 import hashlib
 import os
 import subprocess
@@ -28,6 +33,10 @@ import tempfile
 from types import SimpleNamespace
 
 import numpy as np
+
+
+def _ghost_call(gfn, out_shape, *arrays):
+    return _call(gfn, arrays, out_shape)
 
 
 def _compile(sources, name, extra_cflags=None, build_directory=None,
@@ -128,12 +137,25 @@ def load(name, sources, functions, extra_cflags=None, build_directory=None,
 
             grad_name = fname + "_grad"
             if hasattr(lib, grad_name):
+                # Multi-input ABI: `<name>_grad` yields input 0's cotangent;
+                # optional `<name>_grad1`, `<name>_grad2`, ... yield inputs
+                # 1, 2, ... Each receives (primal inputs..., g) and writes a
+                # buffer shaped like ITS input. Inputs without a grad symbol
+                # are non-differentiable: their cotangent is a loud NaN fill
+                # so a grad taken w.r.t. them can never be silently wrong
+                # (r1 advice: zeros masked missing-gradient bugs).
+                gfns = {0: _bind(lib, grad_name)}
+                i = 1
+                while hasattr(lib, f"{grad_name}{i}"):
+                    gfns[i] = _bind(lib, f"{grad_name}{i}")
+                    i += 1
                 import warnings
                 warnings.warn(
-                    f"custom op {fname!r}: {grad_name} provides the "
-                    "cotangent of the FIRST input only; other inputs are "
-                    "treated as constants (zero gradient)", stacklevel=2)
-                gfn = _bind(lib, grad_name)
+                    f"custom op {fname!r}: gradients defined for input(s) "
+                    f"{sorted(gfns)} (symbols {grad_name}<i>); any OTHER "
+                    "input's cotangent is NaN-poisoned — differentiating "
+                    "w.r.t. it fails loudly instead of silently yielding "
+                    "zeros", stacklevel=2)
 
                 @jax.custom_vjp
                 def op_vjp(*args):
@@ -143,15 +165,19 @@ def load(name, sources, functions, extra_cflags=None, build_directory=None,
                     return op(*args), args
 
                 def bwd(res, g):
-                    def ghost(*arrays):
-                        return _call(gfn, arrays, arrays[0].shape)
-                    gx = jax.pure_callback(
-                        ghost,
-                        jax.ShapeDtypeStruct(jnp.shape(res[0]), jnp.float32),
-                        *res, g, vmap_method="sequential")
-                    # cotangent for the first input; others get zeros
-                    return (gx,) + tuple(
-                        jnp.zeros(jnp.shape(r), jnp.float32) for r in res[1:])
+                    outs = []
+                    for idx, r in enumerate(res):
+                        if idx in gfns:
+                            gi = jax.pure_callback(
+                                functools.partial(
+                                    _ghost_call, gfns[idx], jnp.shape(r)),
+                                jax.ShapeDtypeStruct(jnp.shape(r),
+                                                     jnp.float32),
+                                *res, g, vmap_method="sequential")
+                        else:
+                            gi = jnp.full(jnp.shape(r), jnp.nan, jnp.float32)
+                        outs.append(gi)
+                    return tuple(outs)
 
                 op_vjp.defvjp(fwd, bwd)
                 return op_vjp

@@ -21,6 +21,15 @@ extern "C" void scaled_square_grad(const float** ins, const long long* sizes,
     const float* g = ins[2];
     for (long long i = 0; i < out_size; ++i) out[i] = 2.0f * s * x[i] * g[i];
 }
+extern "C" void scaled_square_grad1(const float** ins, const long long* sizes,
+                                    int n_ins, float* out, long long out_size) {
+    // inputs: x, s, upstream g -> ds = sum(x^2 * g) (out_size == 1)
+    const float* x = ins[0];
+    const float* g = ins[2];
+    float acc = 0.f;
+    for (long long i = 0; i < sizes[0]; ++i) acc += x[i] * x[i] * g[i];
+    out[0] = acc;
+}
 extern "C" void row_sums(const float** ins, const long long* sizes,
                          int n_ins, float* out, long long out_size) {
     // x flattened [rows, cols]; out [rows]
@@ -62,6 +71,41 @@ def test_custom_op_grad(ext):
     s = jnp.asarray([2.0])
     g = jax.grad(lambda a: jnp.sum(ext.scaled_square(a, s)))(x)
     np.testing.assert_allclose(np.asarray(g), [4.0, 8.0, 12.0])
+
+
+def test_custom_op_grad_second_input(ext):
+    """<name>_grad1 provides input 1's cotangent (multi-input ABI)."""
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    s = jnp.asarray([2.0])
+    gs = jax.grad(lambda b: jnp.sum(ext.scaled_square(x, b)))(s)
+    # d/ds sum(s x^2) = sum(x^2) = 14
+    np.testing.assert_allclose(np.asarray(gs), [14.0])
+
+
+def test_custom_op_missing_grad_is_nan_not_zero(tmp_path_factory):
+    """An input without a grad symbol must fail LOUDLY (NaN), not silently
+    return zeros (r1 advice / verdict sharp edge)."""
+    from paddle_tpu.utils.cpp_extension import load
+    src = r"""
+extern "C" void mul2(const float** ins, const long long* sizes,
+                     int n_ins, float* out, long long out_size) {
+    for (long long i = 0; i < out_size; ++i) out[i] = ins[0][i] * ins[1][i];
+}
+extern "C" void mul2_grad(const float** ins, const long long* sizes,
+                          int n_ins, float* out, long long out_size) {
+    for (long long i = 0; i < out_size; ++i) out[i] = ins[1][i] * ins[2][i];
+}
+"""
+    with pytest.warns(UserWarning):
+        ops = load("mul2ops", [src], functions={"mul2": None},
+                   build_directory=str(tmp_path_factory.mktemp("ext2")))
+    a = jnp.asarray([1.0, 2.0])
+    b = jnp.asarray([3.0, 4.0])
+    ga = jax.grad(lambda u: jnp.sum(ops.mul2(u, b)))(a)
+    np.testing.assert_allclose(np.asarray(ga), [3.0, 4.0])
+    gb = jax.grad(lambda u: jnp.sum(ops.mul2(a, u)))(b)
+    assert np.all(np.isnan(np.asarray(gb))), \
+        "missing grad symbol must poison the cotangent, not zero it"
 
 
 def test_custom_op_shape_fn(ext):

@@ -117,6 +117,27 @@ def test_optimizer_set_lr():
         o2.set_lr(0.5)
 
 
+def test_set_lr_takes_effect_inside_compiled_step():
+    """The lr is optimizer STATE: set_lr(value, state) must change a jitted
+    step's behaviour without recompilation (ADVICE r1: a Python-float lr is
+    folded into the trace as a constant and set_lr silently no-ops)."""
+    import paddle_tpu.optimizer as opt
+    o = opt.SGD(learning_rate=0.1)
+    params = {"w": jnp.ones((2,))}
+    state = o.init(params)
+    grads = {"w": jnp.ones((2,))}
+
+    compiled = jax.jit(lambda p, g, s: o.step(p, g, s))
+    p1, state = compiled(params, grads, state)
+    np.testing.assert_allclose(np.asarray(p1["w"]), 1.0 - 0.1, rtol=1e-6)
+
+    state = o.set_lr(0.5, state)
+    assert o.get_lr(state) == 0.5
+    p2, state = compiled(p1, grads, state)  # same compiled fn, new lr
+    np.testing.assert_allclose(np.asarray(p2["w"]), (1.0 - 0.1) - 0.5,
+                               rtol=1e-6)
+
+
 def test_dist_split_linear():
     pt.seed(0)
     x = jnp.ones((2, 8))

@@ -52,6 +52,48 @@ def test_metrics():
     assert auc.accumulate() > 0.7
 
 
+def test_chunk_evaluator():
+    from paddle_tpu.metric import ChunkEvaluator
+    # IOB, 2 chunk types: tag = type*2 + {0:B, 1:I}; O = 4
+    ce = ChunkEvaluator(num_chunk_types=2, chunk_scheme="IOB")
+    labels = np.asarray([[0, 1, 4, 2, 3, 4]])  # chunks: (0,1,t0), (3,4,t1)
+    preds = np.asarray([[0, 1, 4, 2, 4, 4]])   # chunks: (0,1,t0), (3,3,t1)
+    p, r, f1 = ce.update(preds, labels)
+    assert p == 0.5 and r == 0.5 and abs(f1 - 0.5) < 1e-9
+    # perfect second batch improves the running totals
+    p, r, f1 = ce.update(labels, labels)
+    assert p == 0.75 and r == 0.75
+    # seq_lens truncation: trailing positions ignored
+    ce2 = ChunkEvaluator(num_chunk_types=1)
+    p, r, f1 = ce2.update(np.asarray([[0, 1, 0]]), np.asarray([[0, 1, 2]]),
+                          seq_lens=[2])
+    assert p == 1.0 and r == 1.0 and f1 == 1.0
+    # IOBES single-token chunks
+    ce3 = ChunkEvaluator(num_chunk_types=1, chunk_scheme="IOBES")
+    p, r, f1 = ce3.update(np.asarray([[3, 4, 3]]), np.asarray([[3, 4, 3]]))
+    assert (p, r, f1) == (1.0, 1.0, 1.0)
+
+
+def test_edit_distance_metric():
+    from paddle_tpu.metric import EditDistance
+    ed = EditDistance(normalized=False)
+    avg, err = ed.update([[1, 2, 3], [1, 2]], [[1, 2, 4], [1, 2]])
+    assert avg == 0.5 and err == 0.5  # one sub in seq 1, exact seq 2
+    ed_n = EditDistance(normalized=True)
+    avg, err = ed_n.update(["kitten"], ["sitting"])
+    assert abs(avg - 3 / 7) < 1e-9 and err == 1.0
+
+
+def test_composite_metric():
+    from paddle_tpu.metric import CompositeMetric, Precision, Recall
+    cm = CompositeMetric(Precision(), Recall())
+    cm.update(np.asarray([0.9, 0.8, 0.2]), np.asarray([1, 0, 0]))
+    p, r = cm.accumulate()
+    assert p == 0.5 and r == 1.0
+    cm.reset()
+    assert cm.accumulate() == [0.0, 0.0]
+
+
 def test_regularizers():
     params = {"w": jnp.ones((2, 2)), "b": jnp.asarray([3.0])}
     np.testing.assert_allclose(float(L2Decay(1.0)(params)), 0.5 * (4 + 9), rtol=1e-6)
