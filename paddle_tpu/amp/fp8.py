@@ -1,0 +1,136 @@
+"""fp8 training with delayed scaling (ref capability: the reference stack's
+fp8 path — PaddleNLP llm fp8 + PHI fp8 GEMM; design follows the public
+TransformerEngine/flax recipe re-thought for a functional TPU stack).
+
+Core pieces:
+  * e4m3 forward operands / e5m2 gradients, with per-tensor scales derived
+    from a rolling amax HISTORY (delayed scaling: the scale used at step t
+    comes from steps < t, so quantization adds no serial amax-reduction
+    dependency before the matmul).
+  * ``fp8_matmul(x, w, meta)`` — a ``jax.custom_vjp`` whose backward ALSO
+    returns the UPDATED meta (amax histories rolled, scales recomputed) as
+    the meta's "cotangent". Meta tensors live in the module tree under the
+    ``fp8_meta`` name marker; the optimizer OVERWRITES them with this
+    "gradient" instead of applying an update rule (flax's
+    overwrite-with-gradient pattern — the idiomatic way to thread mutable
+    scaling state through a pure ``jit(grad(...))`` training step).
+  * On hardware without native fp8 MXU support XLA computes the quantized
+    matmul by upcasting — numerics (and tests) are identical; the speedup
+    arrives on fp8-capable chips with the same code.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+FP8_META_MARKER = "fp8_meta"  # path substring the optimizer overwrites
+
+
+def new_fp8_meta(history_len: int = 16):
+    """Delayed-scaling state for one matmul: one amax history per operand
+    role (x = activation, w = weight, g = upstream gradient). Scales are
+    DERIVED from the history at use time (_compute_scale) — no duplicate
+    scale state to drift out of sync."""
+    return {f"amax_{role}": jnp.zeros((history_len,), jnp.float32)
+            for role in ("x", "w", "g")}
+
+
+def _compute_scale(amax_history, fp8_max, margin: float = 0.0):
+    """TransformerEngine-style: scale so that amax maps to fp8_max."""
+    amax = jnp.max(amax_history)
+    scale = fp8_max / jnp.maximum(amax, 1e-12) / (2.0 ** margin)
+    # no history yet (amax == 0): keep scale 1
+    return jnp.where(amax > 0, scale, 1.0)
+
+
+def _roll(history, amax_now):
+    return jnp.concatenate([amax_now[None].astype(history.dtype),
+                            history[:-1]])
+
+
+def _quant(x, scale, dtype, fp8_max):
+    scaled = x.astype(jnp.float32) * scale
+    return jnp.clip(scaled, -fp8_max, fp8_max).astype(dtype)
+
+
+@jax.custom_vjp
+def fp8_matmul(x, w, meta):
+    """x @ w with e4m3 operands under delayed scaling. x: [..., K],
+    w: [K, N]. The backward pass quantizes the upstream gradient to e5m2
+    and returns the rolled/rescaled meta as meta's cotangent."""
+    y, _ = _fp8_fwd(x, w, meta)
+    return y
+
+
+def _fp8_fwd(x, w, meta):
+    sx = _compute_scale(meta["amax_x"], E4M3_MAX)
+    sw = _compute_scale(meta["amax_w"], E4M3_MAX)
+    qx = _quant(x, sx, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _quant(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+    y = jnp.matmul(qx.astype(jnp.bfloat16), qw.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    y = (y / (sx * sw)).astype(x.dtype)
+    # residuals keep only the fp8 copies + scalar amaxes (the memory saving
+    # IS the point); zero-sized sentinels carry the primal dtypes
+    ax, aw = jnp.max(jnp.abs(x)), jnp.max(jnp.abs(w))
+    res = (qx, qw, sx, sw, ax, aw, meta,
+           jnp.zeros((), x.dtype), jnp.zeros((), w.dtype))
+    return y, res
+
+
+def _fp8_bwd(res, g):
+    qx, qw, sx, sw, ax, aw, meta, x_dt, w_dt = res
+    sg = _compute_scale(meta["amax_g"], E5M2_MAX)
+    qg = _quant(g, sg, jnp.float8_e5m2, E5M2_MAX)
+    gb = qg.astype(jnp.bfloat16)
+    # dx = g @ w^T, dw = x^T @ g — both from quantized operands
+    dx = jnp.matmul(gb, qw.astype(jnp.bfloat16).T,
+                    preferred_element_type=jnp.float32)
+    dx = (dx / (sg * sw)).astype(x_dt.dtype)
+    x2 = qx.reshape(-1, qx.shape[-1])
+    g2 = gb.reshape(-1, gb.shape[-1])
+    dw = jnp.matmul(x2.astype(jnp.bfloat16).T, g2,
+                    preferred_element_type=jnp.float32)
+    dw = (dw / (sx * sg)).astype(w_dt.dtype)
+    # meta "cotangent" = UPDATED meta (overwrite-with-gradient)
+    new_meta = dict(meta)
+    new_meta["amax_x"] = _roll(meta["amax_x"], ax)
+    new_meta["amax_w"] = _roll(meta["amax_w"], aw)
+    new_meta["amax_g"] = _roll(meta["amax_g"], jnp.max(jnp.abs(g)))
+    return dx, dw, new_meta
+
+
+fp8_matmul.defvjp(lambda x, w, m: _fp8_fwd(x, w, m), _fp8_bwd)
+
+
+def is_fp8_meta_path(path_str: str) -> bool:
+    return FP8_META_MARKER in path_str
+
+
+from paddle_tpu.core.module import Module as _Module
+
+
+class Fp8Linear(_Module):
+    """Linear layer computing through ``fp8_matmul`` (delayed scaling).
+
+    A drop-in for ``nn.Linear`` in fp8-trained blocks: weight/bias train
+    normally; the ``fp8_meta`` attribute holds the scaling state, which the
+    optimizer overwrites from its custom-vjp "gradient" (see module
+    docstring)."""
+
+    def __init__(self, in_features, out_features, bias_attr=True,
+                 history_len: int = 16, dtype=jnp.bfloat16):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+        self.weight = I.XavierUniform()((in_features, out_features), dtype)
+        self.bias = (jnp.zeros((out_features,), dtype)
+                     if bias_attr else None)
+        self.fp8_meta = new_fp8_meta(history_len)
+
+    def __call__(self, x):
+        y = fp8_matmul(x, self.weight, self.fp8_meta)
+        return y if self.bias is None else y + self.bias

@@ -23,8 +23,9 @@ _NEG_INF = -1e30
 
 def _block_attend(q, k, v, scale, mask):
     """Scores for one (q_block, kv_block) pair in fp32.
-    q: [B,Sq,H,D] k,v: [B,Sk,Hkv,D]; mask: [Sq,Sk] bool or None. GQA
-    (Hkv < H) runs as a grouped einsum — repeated K/V is never
+    q: [B,Sq,H,D] k,v: [B,Sk,Hkv,D]; mask: bool, broadcastable to
+    [B,H,Sq,Sk] (e.g. [1,1,Sq,Sk] causal or [B,1,Sq,Sk] varlen), or None.
+    GQA (Hkv < H) runs as a grouped einsum — repeated K/V is never
     materialised, so the ring rotates 1/rep the bytes."""
     b, sq, hq, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
@@ -38,13 +39,13 @@ def _block_attend(q, k, v, scale, mask):
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                        preferred_element_type=jnp.float32) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None], s, _NEG_INF)
+        s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # [B,H,Sq]
     p = jnp.exp(s - m[..., None])
     if mask is not None:
         # a fully-masked row has m = NEG_INF and exp(s - m) = 1 — zero the
         # masked entries explicitly so dead rows contribute l = 0, not Sk
-        p = jnp.where(mask[None, None], p, 0.0)
+        p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)  # [B,H,Sq]
     if hq != hk:
         pg = p.reshape(b, hk, rep, sq, sk).astype(v.dtype)
@@ -57,7 +58,8 @@ def _block_attend(q, k, v, scale, mask):
 
 
 def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
-                   scale: float | None = None, window: int | None = None):
+                   scale: float | None = None, window: int | None = None,
+                   kv_lens=None, attn_mask=None):
     """Blockwise ring attention with online-softmax accumulation.
 
     Equals full attention over the gathered sequence (see
@@ -65,6 +67,12 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     backward pass is itself a ring pass — no full-sequence gather ever.
     ``window``: Mistral-style causal sliding window over GLOBAL positions
     (query position i sees [i-window+1, i] across shard boundaries).
+    ``kv_lens``: [B] GLOBAL valid key lengths (padded-varlen batches) —
+    per-step masking against the rotating block's global key positions, no
+    mask tensor materialised.
+    ``attn_mask``: [B, S_loc, S_global] bool — this rank's query rows vs
+    ALL global key columns (the O(S^2/sp)-per-device general-mask path);
+    each ring step slices the arriving block's column range.
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
@@ -104,9 +112,21 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
                 dist = (my - src) * s_loc + a_ix - b_ix
                 block_mask = block_mask & (dist < window)
                 allowed = allowed & ((my - src) * s_loc - (s_loc - 1) < window)
+            block_mask = block_mask[None, None]  # [1,1,Sq,Sk]
         else:
             block_mask = None
             allowed = True
+        if kv_lens is not None:
+            # this block's keys hold global positions src*s_loc + [0, s_loc)
+            g_idx = src * s_loc + jnp.arange(s_loc)
+            key_ok = (g_idx[None, :] < jnp.asarray(kv_lens)[:, None]
+                      )[:, None, None, :]  # [B,1,1,Sk]
+            block_mask = key_ok if block_mask is None else block_mask & key_ok
+        if attn_mask is not None:
+            cols = lax.dynamic_slice_in_dim(attn_mask, src * s_loc, s_loc,
+                                            axis=2)  # [B, Sq, Sk]
+            cols = cols[:, None]  # [B,1,Sq,Sk]
+            block_mask = cols if block_mask is None else block_mask & cols
         o_b, m_b, l_b = _block_attend(q, k_blk, v_blk, scale, block_mask)
         if causal:
             o_b = jnp.where(allowed, o_b, 0.0)
@@ -127,22 +147,35 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh, causal=True, head_spec=None, window=None):
+def make_ring_attention(mesh, causal=True, head_spec=None, window=None,
+                        varlen=False, masked=False):
     """shard_map-wrapped ring attention: global [B, S, H, D] with S sharded
     over sp; drop-in replacement for full attention. ``head_spec="tp"``
     composes with tensor parallelism (heads stay tp-sharded through the
     ring — each tp member rings its own head slice over sp); ``window``
-    applies a global causal sliding window (Mistral)."""
+    applies a global causal sliding window (Mistral).
+    ``varlen=True``: attend(q, k, v, kv_lens) with [B] global key lengths.
+    ``masked=True``: attend(..., attn_mask) with a [B, S, S] bool mask
+    (sharded on q rows); combine with varlen by passing both in order."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(("dp", "fsdp"), "sp", head_spec, None)
+    in_specs = [spec, spec, spec]
+    if varlen:
+        in_specs.append(P(("dp", "fsdp")))            # kv_lens [B]
+    if masked:
+        # [B, S, S_global]: q rows sharded over sp, key columns replicated
+        in_specs.append(P(("dp", "fsdp"), "sp", None))
 
     @functools.partial(shard_map, mesh=mesh.mesh,
-                       in_specs=(spec, spec, spec), out_specs=spec)
-    def attend(q, k, v):
+                       in_specs=tuple(in_specs), out_specs=spec)
+    def attend(q, k, v, *extra):
+        it = iter(extra)
+        lens = next(it) if varlen else None
+        mask = next(it) if masked else None
         return ring_attention(q, k, v, axis_name="sp", causal=causal,
-                              window=window)
+                              window=window, kv_lens=lens, attn_mask=mask)
 
     return attend
 

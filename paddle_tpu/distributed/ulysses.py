@@ -23,10 +23,16 @@ from paddle_tpu.ops import attention as A
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
-                      scale=None):
+                      scale=None, window=None, kv_lens=None, attn_mask=None):
     """Attention over the full sequence with inputs sequence-sharded on
     ``axis_name``. [B, S_local, H, D] in and out; H must divide by the axis
-    size. Call inside shard_map."""
+    size. Call inside shard_map.
+
+    ``kv_lens``: [B] global valid key lengths (padded varlen) — applied by
+    the inner attention after the head-scatter, so the fused kernel's
+    varlen path still runs. ``attn_mask``: [B, S, S] bool over GLOBAL
+    positions, replicated (after the all_to_all every member holds the full
+    sequence for its head slice, so the full mask is needed anyway)."""
     sp = lax.axis_size(axis_name)
     if q.shape[2] % sp != 0:
         raise ValueError(
@@ -57,24 +63,45 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    mask = attn_mask[:, None] if attn_mask is not None else None  # [B,1,S,S]
+    # window works unchanged: after the all_to_all the inner attention sees
+    # the FULL sequence (global positions intact), so the sliding window is
+    # exactly the single-device banded computation on a head slice
     out = A.scaled_dot_product_attention(qh, kh, vh, is_causal=causal,
-                                         scale=scale)
+                                         scale=scale, window=window,
+                                         kv_lens=kv_lens, attn_mask=mask)
     # head-sharded -> seq-sharded
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
 
 
 def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp",
-                           head_spec=None, batch_axes=("dp", "fsdp")):
+                           head_spec=None, batch_axes=("dp", "fsdp"),
+                           window: int | None = None,
+                           varlen: bool = False, masked: bool = False):
     """Bind ulysses_attention onto a HybridMesh via shard_map: takes/returns
     [B, S, H, D] arrays sequence-sharded over ``axis_name``; batch sharded
     over ``batch_axes``; ``head_spec="tp"`` composes with tensor
     parallelism (each tp member re-shards its own head slice over sp, so
-    local heads must divide by sp * tp)."""
+    local heads must divide by sp * tp).
+    ``varlen=True``: attend(q, k, v, kv_lens) with [B] key lengths.
+    ``masked=True``: attend(..., attn_mask) with [B, S, S] bool (replicated
+    over sp — the head-sharded inner attention needs the whole mask)."""
     from jax import shard_map
 
     spec = P(batch_axes, axis_name, head_spec, None)
-    fn = functools.partial(ulysses_attention, axis_name=axis_name,
-                           causal=causal)
-    return shard_map(fn, mesh=mesh.mesh, in_specs=(spec, spec, spec),
+    in_specs = [spec, spec, spec]
+    if varlen:
+        in_specs.append(P(batch_axes))
+    if masked:
+        in_specs.append(P(batch_axes, None, None))
+
+    def fn(q, k, v, *extra):
+        it = iter(extra)
+        lens = next(it) if varlen else None
+        mask = next(it) if masked else None
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal,
+                                 window=window, kv_lens=lens, attn_mask=mask)
+
+    return shard_map(fn, mesh=mesh.mesh, in_specs=tuple(in_specs),
                      out_specs=spec, check_vma=False)

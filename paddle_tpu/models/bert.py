@@ -33,6 +33,11 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     dtype: object = jnp.float32
+    # padded-varlen attention: interpret attention_mask as a CONTIGUOUS
+    # prefix (standard right-padding) and pass per-row lengths to the fused
+    # flash kernel instead of a dense additive mask (which forces the XLA
+    # fallback). Ref: flash_attn varlen / PaddleNLP padded-batch pretraining.
+    varlen_attention: bool = False
 
     @staticmethod
     def base(**kw):
@@ -88,12 +93,15 @@ class BertLayer(Module):
         self.out_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
-    def __call__(self, x, attn_mask=None, rng=None):
-        r1, r2 = (None, None) if rng is None else tuple(jax.random.split(rng))
-        h = self.attention(x, attn_mask=attn_mask, rng=r1)
-        x = self.attn_norm(x + self.dropout(h, rng=r1))
+    def __call__(self, x, attn_mask=None, rng=None, kv_lens=None):
+        # three INDEPENDENT dropout draws: attention-internal, post-attn
+        # residual, post-FF residual
+        r1, r2, r3 = ((None,) * 3 if rng is None
+                      else tuple(jax.random.split(rng, 3)))
+        h = self.attention(x, attn_mask=attn_mask, rng=r1, kv_lens=kv_lens)
+        x = self.attn_norm(x + self.dropout(h, rng=r2))
         h = self.output(F.gelu(self.intermediate(x)))
-        return self.out_norm(x + self.dropout(h, rng=r2))
+        return self.out_norm(x + self.dropout(h, rng=r3))
 
 
 class BertModel(Module):
@@ -105,13 +113,20 @@ class BertModel(Module):
         self.pooler = Linear(cfg.hidden_size, cfg.hidden_size, dtype=cfg.dtype)
 
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None, rng=None):
+        kv_lens = None
         if attention_mask is not None:
-            # [B, S] 1/0 -> additive mask [B, 1, 1, S]
-            attention_mask = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+            if self.cfg.varlen_attention:
+                # contiguous right-padding: lengths keep the fused kernel
+                kv_lens = jnp.sum(attention_mask.astype(jnp.int32), axis=1)
+                attention_mask = None
+            else:
+                # [B, S] 1/0 -> additive mask [B, 1, 1, S]
+                attention_mask = (1.0 - attention_mask[:, None, None, :]
+                                  .astype(jnp.float32)) * -1e9
         x = self.embeddings(input_ids, token_type_ids, rng=rng)
         for i, lyr in enumerate(self.layers):
             sub = None if rng is None else jax.random.fold_in(rng, i)
-            x = lyr(x, attn_mask=attention_mask, rng=sub)
+            x = lyr(x, attn_mask=attention_mask, rng=sub, kv_lens=kv_lens)
         pooled = jnp.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

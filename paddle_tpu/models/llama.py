@@ -44,6 +44,7 @@ class LlamaConfig:
     dtype: object = jnp.bfloat16
     remat: bool = True
     use_flash: bool = True
+    fp8: bool = False  # e4m3/e5m2 projections with delayed scaling (amp.fp8)
     scan_layers: bool = False  # stack layers + lax.scan: O(1) compile depth
     sliding_window: int | None = None  # Mistral-style causal window
     attention_bias: bool = False       # Qwen2: bias on fused qkv only
@@ -99,6 +100,11 @@ class LlamaAttention(Module):
         self.use_flash = cfg.use_flash
         self.window = cfg.sliding_window
         self.sequence_parallel = cfg.sequence_parallel
+        if cfg.fp8:
+            from paddle_tpu.amp.fp8 import new_fp8_meta
+            self.fp8_meta = {"qkv": new_fp8_meta(), "o": new_fp8_meta()}
+        else:
+            self.fp8_meta = None
 
     def _attend(self, q, k, v, attn_mask):
         # sequence parallelism over the sp axis — trace-time dispatch,
@@ -112,27 +118,51 @@ class LlamaAttention(Module):
             from paddle_tpu.distributed.mesh import current_mesh
             mesh = current_mesh()
             if mesh is not None and mesh.size("sp") > 1:
-                if attn_mask is not None or (
-                        self.window is not None
-                        and self.sequence_parallel != "ring"):
-                    raise NotImplementedError(
-                        f"{self.sequence_parallel} attention does not "
-                        "support attn_mask (or, for ulysses, "
-                        "sliding_window); use sequence_parallel=None "
-                        "(GSPMD sp sharding) or ring for windowed configs")
+                # normalise attn_mask to [B, S, S] bool over global
+                # positions (both sp paths consume that form); a [B, S]
+                # or [B,1,1,S] key-padding mask broadcasts to rows, and an
+                # ADDITIVE float mask (0 = attend, big-negative = block)
+                # maps via `>= 0` — hard masks only: a soft bias (finite
+                # non-zero values) cannot ride the boolean sp paths, and a
+                # PER-HEAD mask has no [B,S,S] form, so raise rather than
+                # silently collapse to head 0
+                mask3 = None
+                if attn_mask is not None:
+                    m = attn_mask
+                    if m.ndim == 4 and m.shape[1] > 1:
+                        raise NotImplementedError(
+                            "per-head attn_mask is not supported under "
+                            "sequence_parallel (needs [B,S,S]); use "
+                            "sequence_parallel=None")
+                    if jnp.issubdtype(m.dtype, jnp.floating):
+                        m = m >= 0
+                    else:
+                        m = m.astype(bool)
+                    s_full = q.shape[1]
+                    if m.ndim == 4:
+                        m = m[:, 0]          # [B,(1|S),S]
+                    elif m.ndim == 2:
+                        m = m[:, None, :]    # key padding -> rows
+                    if m.shape[1] == 1:
+                        m = jnp.broadcast_to(m, (m.shape[0], s_full, s_full))
+                    mask3 = m
                 head_spec = "tp" if mesh.size("tp") > 1 else None
                 if self.sequence_parallel == "ring":
                     from paddle_tpu.distributed.ring_attention import (
                         make_ring_attention)
                     attend = make_ring_attention(mesh, causal=True,
                                                  head_spec=head_spec,
-                                                 window=self.window)
+                                                 window=self.window,
+                                                 masked=mask3 is not None)
                 else:
                     from paddle_tpu.distributed.ulysses import (
                         make_ulysses_attention)
                     attend = make_ulysses_attention(mesh, causal=True,
-                                                    head_spec=head_spec)
-                return attend(q, k, v)
+                                                    head_spec=head_spec,
+                                                    window=self.window,
+                                                    masked=mask3 is not None)
+                args = (q, k, v) if mask3 is None else (q, k, v, mask3)
+                return attend(*args)
         return F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=True,
             training=self.training, window=self.window)
@@ -140,7 +170,11 @@ class LlamaAttention(Module):
     def __call__(self, x, cos, sin, attn_mask=None):
         b, s, h = x.shape
         nh, nkv, d = self.num_heads, self.num_kv_heads, self.head_dim
-        qkv = x @ self.qkv_proj
+        if self.fp8_meta is not None:
+            from paddle_tpu.amp.fp8 import fp8_matmul
+            qkv = fp8_matmul(x, self.qkv_proj, self.fp8_meta["qkv"])
+        else:
+            qkv = x @ self.qkv_proj
         if self.qkv_bias is not None:
             qkv = qkv + self.qkv_bias
         q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
@@ -150,7 +184,11 @@ class LlamaAttention(Module):
         q = A.apply_rope(q, cos, sin)
         k = A.apply_rope(k, cos, sin)
         out = self._attend(q, k, v, attn_mask)
-        return out.reshape(b, s, nh * d) @ self.o_proj
+        out = out.reshape(b, s, nh * d)
+        if self.fp8_meta is not None:
+            from paddle_tpu.amp.fp8 import fp8_matmul
+            return fp8_matmul(out, self.o_proj, self.fp8_meta["o"])
+        return out @ self.o_proj
 
 
 class LlamaMLP(Module):
@@ -164,8 +202,20 @@ class LlamaMLP(Module):
         self.set_pspec("gate_up_proj", P(None, "tp"))
         self.set_pspec("down_proj", P("tp", None))
         self.intermediate_size = m
+        if cfg.fp8:
+            from paddle_tpu.amp.fp8 import new_fp8_meta
+            self.fp8_meta = {"gate_up": new_fp8_meta(),
+                             "down": new_fp8_meta()}
+        else:
+            self.fp8_meta = None
 
     def __call__(self, x):
+        if self.fp8_meta is not None:
+            from paddle_tpu.amp.fp8 import fp8_matmul
+            gu = fp8_matmul(x, self.gate_up_proj, self.fp8_meta["gate_up"])
+            gate, up = jnp.split(gu, 2, axis=-1)
+            return fp8_matmul(jax.nn.silu(gate) * up, self.down_proj,
+                              self.fp8_meta["down"])
         gu = x @ self.gate_up_proj
         gate, up = jnp.split(gu, 2, axis=-1)
         return (jax.nn.silu(gate) * up) @ self.down_proj

@@ -1,0 +1,317 @@
+"""Paged KV cache + continuous batched decode (serving story).
+
+Ref capability: PaddleNLP ``llm`` predictor block-attention +
+``fused_multi_transformer_op.cu``'s block KV cache. TPU-native split of
+responsibilities:
+
+  * DEVICE: fixed-shape jitted steps — ``llama_prefill_paged`` (padded
+    ragged prompts through the varlen flash path, K/V scattered into the
+    block pool) and ``llama_decode_step_paged`` (one token per sequence,
+    pool-direct paged attention via the scalar-prefetch Pallas kernel).
+  * HOST: ``BlockManager`` — the free-list/allocation policy (what vLLM's
+    scheduler does). Between steps it grows block tables and recycles a
+    finished sequence's blocks. Host-side management is the TPU-idiomatic
+    design: allocation is control flow, not math, and the device program
+    keeps a single static shape.
+
+HBM for the cache is ``num_blocks * block_size`` tokens ≈ Σ actual sequence
+lengths (rounded up per block) — NOT batch × max_len as in the static
+``KVCache`` (models/decoding.py), which this complements, not replaces.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import attention as A
+from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+
+@dataclass
+class PagedKVCache:
+    """Per-layer block pools + per-sequence block tables (pytree)."""
+    k_pools: list   # [L] of [N_blocks, block_size, H_kv, D]
+    v_pools: list
+    block_tables: jnp.ndarray  # [B, max_blocks] int32 (pad = n_blocks)
+    lens: jnp.ndarray          # [B] int32 — tokens currently in cache
+
+    @property
+    def block_size(self):
+        return self.k_pools[0].shape[1]
+
+    @property
+    def num_blocks(self):
+        return self.k_pools[0].shape[0]
+
+    def pool_tokens(self):
+        """Total cache capacity in tokens (the HBM bound)."""
+        return self.num_blocks * self.block_size
+
+    @staticmethod
+    def init(num_layers, num_blocks, block_size, num_kv_heads, head_dim,
+             batch, max_blocks_per_seq, dtype):
+        z = lambda: jnp.zeros((num_blocks, block_size, num_kv_heads,
+                               head_dim), dtype)
+        return PagedKVCache(
+            [z() for _ in range(num_layers)],
+            [z() for _ in range(num_layers)],
+            jnp.full((batch, max_blocks_per_seq), num_blocks, jnp.int32),
+            jnp.zeros((batch,), jnp.int32))
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache,
+    lambda c: ((c.k_pools, c.v_pools, c.block_tables, c.lens), None),
+    lambda aux, ch: PagedKVCache(*ch))
+
+
+class BlockManager:
+    """Host-side free-list allocator for the shared block pool."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self.tables: dict[int, list[int]] = {}
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    def allocate(self, seq_id: int, n_tokens: int):
+        """Ensure seq_id owns enough blocks for n_tokens; grow as needed."""
+        table = self.tables.setdefault(seq_id, [])
+        need = self.blocks_needed(n_tokens) - len(table)
+        if need > len(self._free):
+            raise MemoryError(
+                f"paged cache out of blocks: need {need}, "
+                f"free {len(self._free)} (of {self.num_blocks})")
+        for _ in range(max(need, 0)):
+            table.append(self._free.pop())
+        return table
+
+    def free(self, seq_id: int):
+        self._free.extend(reversed(self.tables.pop(seq_id, [])))
+
+    def table_array(self, seq_ids, max_blocks):
+        """[B, max_blocks] int32; unused slots = num_blocks (OOB sentinel,
+        dropped by scatter, clamped-masked by the kernel contract)."""
+        out = np.full((len(seq_ids), max_blocks), self.num_blocks, np.int32)
+        for row, sid in enumerate(seq_ids):
+            t = self.tables.get(sid, [])
+            out[row, :len(t)] = t
+        return jnp.asarray(out)
+
+
+def _rope_rows(positions, head_dim, base):
+    """cos/sin for PER-ROW positions: [B] -> [B, 1, 1, D/2] (ragged decode:
+    every sequence sits at a different position)."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    f = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    return (jnp.cos(f)[:, None, None, :], jnp.sin(f)[:, None, None, :])
+
+
+def _apply_rope_rows(x, cos, sin):
+    """x: [B, 1, H, D]; cos/sin: [B, 1, 1, D/2] (rotate-half, NeoX)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=-1).astype(x.dtype)
+
+
+def _scatter_prefill(pool, vals, tables, lens, num_blocks, block_size):
+    """Write [B, S, H, D] tokens into the pool at table positions; token
+    (b, i) -> (tables[b, i // bs], i % bs), dropped where i >= lens[b]."""
+    bsz, s = vals.shape[:2]
+    i = jnp.arange(s)
+    blk = jnp.take_along_axis(tables, (i[None, :] // block_size), axis=1)
+    blk = jnp.where(i[None, :] < lens[:, None], blk, num_blocks)  # OOB=drop
+    off = jnp.broadcast_to(i[None, :] % block_size, (bsz, s))
+    return pool.at[blk, off].set(vals, mode="drop")
+
+
+def _scatter_decode(pool, vals, tables, lens, active, num_blocks, block_size):
+    """Write ONE token per sequence at position lens[b]; inactive rows
+    write nowhere (their blocks may already be recycled)."""
+    blk = jnp.take_along_axis(tables, (lens // block_size)[:, None],
+                              axis=1)[:, 0]
+    blk = jnp.where(active, blk, num_blocks)  # OOB -> dropped
+    off = lens % block_size
+    return pool.at[blk, off].set(vals[:, 0], mode="drop")
+
+
+def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache):
+    """Prefill padded ragged prompts [B, S]; returns (last_logits, cache).
+
+    Attention runs the padded-varlen path (kv_lens) — the fused kernel on
+    TPU; K/V of every valid position is scattered into the block pool.
+    ``last_logits`` are taken at each row's LAST VALID position."""
+    cfg = model.cfg
+    if getattr(cfg, "fp8", False):
+        raise NotImplementedError(
+            "paged serving ignores the fp8 training path (its inline "
+            "decoder forward runs bf16 matmuls); serve an fp8-trained "
+            "model with fp8=False weights, or use weight-only quantization")
+    b, s = input_ids.shape
+    nb, bs = cache.num_blocks, cache.block_size
+    x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
+    d = cfg.hidden_size // cfg.num_attention_heads
+    cos, sin = A.rope_cos_sin(s, d, base=cfg.rope_theta)
+    k_pools, v_pools = [], []
+    for li, lyr in enumerate(model.model.layers):
+        h = lyr.input_layernorm(x)
+        att = lyr.self_attn
+        qkv = h @ att.qkv_proj
+        if getattr(att, "qkv_bias", None) is not None:
+            qkv = qkv + att.qkv_bias
+        nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = A.apply_rope(q.reshape(b, s, nh, hd), cos, sin)
+        k = A.apply_rope(k.reshape(b, s, nkv, hd), cos, sin)
+        v = v.reshape(b, s, nkv, hd)
+        out = A.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             kv_lens=prompt_lens,
+                                             window=getattr(cfg, "sliding_window", None))
+        k_pools.append(_scatter_prefill(cache.k_pools[li], k,
+                                        cache.block_tables, prompt_lens,
+                                        nb, bs))
+        v_pools.append(_scatter_prefill(cache.v_pools[li], v,
+                                        cache.block_tables, prompt_lens,
+                                        nb, bs))
+        x = x + out.reshape(b, s, nh * hd) @ att.o_proj
+        x = x + lyr.mlp(lyr.post_attention_layernorm(x))
+    x = model.model.norm(x)
+    logits = model.logits(x)
+    last = jnp.take_along_axis(
+        logits, (prompt_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    new_cache = PagedKVCache(k_pools, v_pools, cache.block_tables,
+                             prompt_lens.astype(jnp.int32))
+    return last, new_cache
+
+
+def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
+    """One decode token per sequence. tokens: [B] int32; active: [B] bool
+    (finished rows neither write KV nor advance). Returns (logits, cache)."""
+    cfg = model.cfg
+    b = tokens.shape[0]
+    nb, bs = cache.num_blocks, cache.block_size
+    x = jnp.take(model.model.embed_tokens, tokens[:, None], axis=0)  # [B,1,E]
+    d = cfg.hidden_size // cfg.num_attention_heads
+    cos, sin = _rope_rows(cache.lens, d, cfg.rope_theta)
+    window = getattr(cfg, "sliding_window", None)
+    k_pools, v_pools = [], []
+    new_lens = jnp.where(active, cache.lens + 1, cache.lens)
+    for li, lyr in enumerate(model.model.layers):
+        h = lyr.input_layernorm(x)
+        att = lyr.self_attn
+        qkv = h @ att.qkv_proj
+        if getattr(att, "qkv_bias", None) is not None:
+            qkv = qkv + att.qkv_bias
+        nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = _apply_rope_rows(q.reshape(b, 1, nh, hd), cos, sin)
+        k = _apply_rope_rows(k.reshape(b, 1, nkv, hd), cos, sin)
+        v = v.reshape(b, 1, nkv, hd)
+        k_pool = _scatter_decode(cache.k_pools[li], k, cache.block_tables,
+                                 cache.lens, active, nb, bs)
+        v_pool = _scatter_decode(cache.v_pools[li], v, cache.block_tables,
+                                 cache.lens, active, nb, bs)
+        k_pools.append(k_pool)
+        v_pools.append(v_pool)
+        # sliding-window configs: the pool retains all tokens (blocks
+        # below the window could be recycled — not done yet) but decode
+        # attends only the last `window` positions, matching prefill
+        out = paged_decode_attention(q[:, 0], k_pool, v_pool,
+                                     cache.block_tables, new_lens,
+                                     window=window)
+        x = x + out.reshape(b, 1, nh * hd) @ att.o_proj
+        x = x + lyr.mlp(lyr.post_attention_layernorm(x))
+    x = model.model.norm(x)
+    logits = model.logits(x)[:, 0]
+    return logits, PagedKVCache(k_pools, v_pools, cache.block_tables,
+                                new_lens)
+
+
+def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
+                   block_size=16, num_blocks=None, eos_token_id=None):
+    """Greedy continuous-batch decode over a paged cache.
+
+    ``input_ids``: [B, S] right-padded ragged prompts with ``prompt_lens``
+    [B]. The pool holds ``num_blocks`` blocks (default: exactly enough for
+    Σ(prompt_len + max_new_tokens), the ragged bound — NOT B × max_len);
+    finished sequences release their blocks back to the manager.
+
+    Host-driven step loop (the serving-engine shape: scheduling/allocation
+    on host, fixed-shape jitted compute on device). Returns [B, S +
+    max_new_tokens] tokens (finished rows are tail-padded with
+    ``eos_token_id``).
+    """
+    cfg = model.cfg
+    b, s = input_ids.shape
+    lens_np = np.asarray(prompt_lens, np.int64)
+    max_total = lens_np + max_new_tokens
+    max_blocks = int(-(-(int(max_total.max())) // block_size))
+    if num_blocks is None:
+        num_blocks = int(sum(-(-int(t) // block_size) for t in max_total))
+    mgr = BlockManager(num_blocks, block_size)
+    for sid in range(b):
+        mgr.allocate(sid, int(lens_np[sid]))
+    cache = PagedKVCache.init(cfg.num_hidden_layers, num_blocks, block_size,
+                              cfg.num_key_value_heads,
+                              cfg.hidden_size // cfg.num_attention_heads,
+                              b, max_blocks, cfg.dtype)
+    cache.block_tables = mgr.table_array(range(b), max_blocks)
+
+    prefill = jax.jit(llama_prefill_paged)
+    step = jax.jit(llama_decode_step_paged)
+
+    logits, cache = prefill(model, jnp.asarray(input_ids),
+                            jnp.asarray(lens_np, jnp.int32), cache)
+    tokens = np.concatenate(
+        [np.asarray(input_ids),
+         np.zeros((b, max_new_tokens), np.asarray(input_ids).dtype)], axis=1)
+    next_tok = np.asarray(jnp.argmax(logits.astype(jnp.float32), axis=-1))
+    active = np.ones((b,), bool)
+    cur = lens_np.copy()
+    for sid in range(b):
+        tokens[sid, cur[sid]] = next_tok[sid]
+    if eos_token_id is not None:
+        newly = next_tok == eos_token_id
+        for sid in np.nonzero(newly)[0]:
+            active[sid] = False
+            mgr.free(int(sid))
+
+    for _ in range(max_new_tokens - 1):
+        if not active.any():
+            break
+        # grow tables for rows about to cross a block boundary
+        for sid in range(b):
+            if active[sid]:
+                mgr.allocate(sid, int(cur[sid]) + 1)
+        cache.block_tables = mgr.table_array(range(b), max_blocks)
+        logits, cache = step(model, jnp.asarray(next_tok, jnp.int32), cache,
+                             jnp.asarray(active))
+        nxt = np.asarray(jnp.argmax(logits.astype(jnp.float32), axis=-1))
+        next_tok = np.where(active, nxt, next_tok)
+        cur = cur + active.astype(np.int64)
+        for sid in range(b):
+            if active[sid]:
+                tokens[sid, cur[sid]] = next_tok[sid]
+        if eos_token_id is not None:
+            newly = active & (next_tok == eos_token_id)
+            for sid in np.nonzero(newly)[0]:
+                active[sid] = False
+                mgr.free(int(sid))
+    if eos_token_id is not None:
+        # finished rows: pad the tail with EOS (HF/PaddleNLP convention)
+        for sid in range(b):
+            if not active[sid]:
+                tokens[sid, int(cur[sid]) + 1:] = eos_token_id
+    return jnp.asarray(tokens), cache

@@ -770,18 +770,21 @@ def square_error_cost(input, label):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
-                                 *, rng=None, scale=None, window=None):
+                                 *, rng=None, scale=None, window=None,
+                                 kv_lens=None):
     """[B, S, H, D] layout (reference flash_attention convention).
 
     Dispatches to the Pallas TPU flash kernel when available, else a fused
     XLA path (softmax in fp32, MXU matmuls in input dtype). ``window`` is a
-    Mistral-style causal sliding window.
+    Mistral-style causal sliding window. ``kv_lens`` ([B] ints) is the
+    padded-varlen path — key padding expressed as lengths keeps the fused
+    kernel (a dense attn_mask always falls back to XLA).
     """
     from paddle_tpu.ops import attention as _attn
     return _attn.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
         is_causal=is_causal, training=training, rng=rng, scale=scale,
-        window=window)
+        window=window, kv_lens=kv_lens)
 
 
 def softmax_mask_fuse_upper_triangle(x):

@@ -36,7 +36,7 @@ class MultiHeadAttention(Module):
         self.dropout = dropout
 
     def __call__(self, query, key=None, value=None, attn_mask=None, is_causal=False,
-                 cache=None, rng=None):
+                 cache=None, rng=None, kv_lens=None):
         key = query if key is None else key
         value = key if value is None else value
         b, sq, _ = query.shape
@@ -48,7 +48,8 @@ class MultiHeadAttention(Module):
             k, v, new_cache = cache.update(k, v)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
-            is_causal=is_causal, training=self.training, rng=rng)
+            is_causal=is_causal, training=self.training, rng=rng,
+            kv_lens=kv_lens)
         out = self.out_proj(out.reshape(b, sq, self.embed_dim))
         return (out, new_cache) if cache is not None else out
 

@@ -29,13 +29,25 @@ def _use_pallas(q) -> bool:
 
 
 def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None,
-                  dropout_p=0.0, training=True, rng=None, window=None):
+                  dropout_p=0.0, training=True, rng=None, window=None,
+                  kv_lens=None):
     """Reference-semantics attention in pure XLA. [B,S,H,D]. ``window``:
-    causal sliding window (token i sees [i-window+1, i]), Mistral-style."""
+    causal sliding window (token i sees [i-window+1, i]), Mistral-style.
+    ``kv_lens``: [B] valid key lengths (padded-varlen batches)."""
     if window is not None and not is_causal:
         raise ValueError("window requires is_causal=True")
     b, sq, h, d = query.shape
     sk = key.shape[1]
+    if kv_lens is not None:
+        # [B] lengths -> [B,1,1,Sk] key-padding mask, merged with attn_mask
+        pad = (jnp.arange(sk)[None, :] < jnp.asarray(kv_lens)[:, None])
+        pad = pad[:, None, None, :]
+        if attn_mask is None:
+            attn_mask = pad
+        elif attn_mask.dtype == jnp.bool_:
+            attn_mask = attn_mask & pad
+        else:
+            attn_mask = jnp.where(pad, attn_mask, _NEG_INF)
     kv_heads = key.shape[2]
     if kv_heads != h:  # GQA: repeat KV heads
         rep = h // kv_heads
@@ -75,21 +87,27 @@ def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, rng=None, scale=None,
-                                 window=None):
+                                 window=None, kv_lens=None):
+    """Dispatch: Pallas flash (incl. the padded-varlen ``kv_lens`` path) →
+    XLA. An ARBITRARY ``attn_mask`` always takes the XLA path: a dense
+    [.., Sq, Sk] mask has already materialised O(S^2) memory, so flash's
+    advantage is gone — express padding as ``kv_lens`` to keep the fused
+    kernel (ref: flash_attn's varlen/padded variants)."""
     h, kv = query.shape[2], key.shape[2]
-    if (attn_mask is None and dropout_p == 0.0 and _use_pallas(query)
+    if (attn_mask is None and (dropout_p == 0.0 or not training)
+            and _use_pallas(query)
             and h % kv == 0 and (window is None or is_causal)):
         try:
             from paddle_tpu.ops.pallas.flash_attention import flash_attention
             # GQA handled inside the kernel (kv row = q row // rep) — no
             # materialised K/V repeat
             return flash_attention(query, key, value, causal=is_causal, scale=scale,
-                                   window=window)
+                                   window=window, kv_lens=kv_lens)
         except Exception:
             pass
     return xla_attention(query, key, value, attn_mask=attn_mask, is_causal=is_causal,
                          scale=scale, dropout_p=dropout_p, training=training, rng=rng,
-                         window=window)
+                         window=window, kv_lens=kv_lens)
 
 
 flash_attention = scaled_dot_product_attention

@@ -25,32 +25,43 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+_float0 = jax.dtypes.float0
 
 
-def _band_mask(s, i, j, block_q, block_k, causal, window, q_off):
-    """Apply causal and/or sliding-window banding to a score tile. ``q_off``
-    (= sk - sq) aligns query positions to the END of the key axis so a
-    short query block (KV-cache decode) sees the whole prefix."""
+def _band_mask(s, i, j, block_q, block_k, causal, window, q_off, klen=None):
+    """Apply causal/sliding-window banding and (padded-varlen) key-length
+    masking to a score tile. ``q_off`` (= sk - sq) aligns query positions to
+    the END of the key axis so a short query block (KV-cache decode) sees
+    the whole prefix. ``klen`` (traced scalar) masks keys >= the row's valid
+    length — the reference's padded/varlen flash_attn capability."""
     q_idx = q_off + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     keep = q_idx >= k_idx if causal else (q_idx == q_idx)
     if window is not None:
         keep &= (q_idx - k_idx) < window
+    if klen is not None:
+        keep &= k_idx < klen
     return jnp.where(keep, s, _NEG_INF)
 
 
-def _block_live(i, j, block_q, block_k, causal, window, q_off):
-    """Predicate: tile (i, j) has any unmasked entry — causal upper bound
-    and, with a window, a lower band bound (skip tiles fully below it)."""
-    live = j * block_k <= q_off + i * block_q + block_q - 1
+def _block_live(i, j, block_q, block_k, causal, window, q_off, klen=None):
+    """Predicate: tile (i, j) has any unmasked entry — causal upper bound,
+    with a window a lower band bound (skip tiles fully below it), and with
+    varlen a key-length bound (skip tiles entirely in the padding)."""
+    live = jnp.asarray(True)
+    if causal:
+        live &= j * block_k <= q_off + i * block_q + block_q - 1
     if window is not None:
         live &= q_off + i * block_q - (j * block_k + block_k - 1) < window
+    if klen is not None:
+        live &= j * block_k < klen
     return live
 
 
@@ -73,9 +84,15 @@ def _band_i_start(j, block_q, block_k, q_off):
     return jnp.maximum(0, (j * block_k - q_off) // block_q)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
-                *, scale, causal, window, q_off, block_q, block_k, nk,
-                banded, nsteps):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
+                scale, causal, window, q_off, block_q, block_k, nk,
+                banded, nsteps, has_lens):
+    if has_lens:
+        lens_ref, o_ref, lse_ref, acc, m_sc, l_sc = rest
+        klen = lens_ref[0, 0]
+    else:
+        o_ref, lse_ref, acc, m_sc, l_sc = rest
+        klen = None
     i, jl = pl.program_id(1), pl.program_id(2)
     # banded grid: the j-axis is a window-relative offset from the first
     # live k block of this q block; full grid: jl IS the k block index
@@ -92,8 +109,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         k = k_ref[0]  # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off)
+        if causal or window is not None or has_lens:
+            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
+                           klen)
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         corr = jnp.exp(m_prev - m_new)
@@ -106,12 +124,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         acc[:] = acc[:] * corr[:, None] + pv
 
     if banded:
-        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off)
-                & (j < nk))(compute)
-    elif causal:
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off,
+                            klen) & (j < nk))(compute)
+    elif causal or has_lens:
         # block (i, j) has any unmasked entry iff j*Bk <= i*Bq + Bq - 1
-        # (and, windowed, iff it is not entirely below the band)
-        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
+        # (windowed: not entirely below the band; varlen: not all padding)
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off,
+                            klen))(compute)
     else:
         compute()
 
@@ -121,14 +140,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
         # lse is [Bq, 1]: kept 2D with q on the sublane dim so the block
         # tiling is TPU-legal and it broadcasts against [Bq, Bk] scores.
-        lse_ref[0] = (m_sc[:] + jnp.log(l)).astype(lse_ref.dtype)
+        # Fully-masked rows (q in the padding of a varlen batch): l == 0 —
+        # emit lse = 0 so the backward's exp(s - lse) underflows to 0
+        # instead of exploding (s = -1e30, a real lse would be ~-1e30 too).
+        lse = m_sc[:] + jnp.log(l)
+        if has_lens:
+            lse = jnp.where(l_sc[:] > 0, lse, 0.0)
+        lse_ref[0] = lse.astype(lse_ref.dtype)
 
 
-def _flash_fwd(q, k, v, *, scale, causal, window, kv_rep, block_q, block_k,
-               interpret):
+def _flash_fwd(q, k, v, lens, *, scale, causal, window, kv_rep, block_q,
+               block_k, interpret):
     bh, s, d = q.shape
     sk = k.shape[1]
     q_off = sk - s  # align queries to the end of the key axis (decode)
+    has_lens = lens is not None
     # GQA: k/v carry bh/kv_rep batch-head rows; q row b reads kv row
     # b // kv_rep via the index map — no repeated K/V is ever materialised
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
@@ -148,15 +174,20 @@ def _flash_fwd(q, k, v, *, scale, causal, window, kv_rep, block_q, block_k,
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                window=window, q_off=q_off, block_q=block_q,
                                block_k=block_k, nk=nk, banded=banded,
-                               nsteps=nsteps)
+                               nsteps=nsteps, has_lens=has_lens)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    args = [q, k, v]
+    if has_lens:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        args.append(lens)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -171,13 +202,19 @@ def _flash_fwd(q, k, v, *, scale, causal, window, kv_rep, block_q, block_k,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-               *, scale, causal, window, q_off, block_q, block_k, nk,
-               banded, nsteps):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, window, q_off, block_q, block_k, nk,
+               banded, nsteps, has_lens):
+    if has_lens:
+        lens_ref, dq_ref, dq_acc = rest
+        klen = lens_ref[0, 0]
+    else:
+        dq_ref, dq_acc = rest
+        klen = None
     i, jl = pl.program_id(1), pl.program_id(2)
     j = _band_j_start(i, block_q, block_k, window, q_off) + jl if banded else jl
 
@@ -192,8 +229,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off)
+        if causal or window is not None or has_lens:
+            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
+                           klen)
         p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [Bq, 1]
         dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -202,10 +240,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
                                          preferred_element_type=jnp.float32)
 
     if banded:
-        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off)
-                & (j < nk))(compute)
-    elif causal:
-        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off,
+                            klen) & (j < nk))(compute)
+    elif causal or has_lens:
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off,
+                            klen))(compute)
     else:
         compute()
 
@@ -214,9 +253,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc, dv_acc, *, scale, causal, window, q_off, block_q,
-                block_k, nq, banded, nsteps):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, window, q_off, block_q,
+                block_k, nq, banded, nsteps, has_lens):
+    if has_lens:
+        lens_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+        klen = lens_ref[0, 0]
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        klen = None
     j, il = pl.program_id(1), pl.program_id(2)  # kv-major: q iterated fastest
     i = _band_i_start(j, block_q, block_k, q_off) + il if banded else il
 
@@ -232,8 +277,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window is not None:
-            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off)
+        if causal or window is not None or has_lens:
+            s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
+                           klen)
         p = jnp.exp(s - lse_ref[0])  # [Bq, Bk]; lse_ref[0]: [Bq, 1]
         dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -244,10 +290,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                                          preferred_element_type=jnp.float32)
 
     if banded:
-        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off)
-                & (i < nq))(compute)
-    elif causal:
-        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off))(compute)
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off,
+                            klen) & (i < nq))(compute)
+    elif causal or has_lens:
+        # varlen: k blocks fully in the padding keep zero dk/dv (init runs
+        # on il==0 regardless, so the outputs are well-defined zeros)
+        pl.when(_block_live(i, j, block_q, block_k, causal, window, q_off,
+                            klen))(compute)
     else:
         compute()
 
@@ -259,11 +308,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
                interpret):
-    q, k, v, out, lse = res
+    q, k, v, lens, out, lse = res
     bh, s, d = q.shape
     sk = k.shape[1]
     bh_kv = k.shape[0]
     q_off = sk - s
+    has_lens = lens is not None
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, S, 1] to match lse layout
@@ -288,40 +338,50 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
         def q_index_dkv(b, j, il):
             return (b, il, 0)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index_dq),
+        pl.BlockSpec((1, block_k, d), kv_index_dq),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_args = [q, k, v, g, lse, delta]
+    if has_lens:
+        dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        dq_args.append(lens)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           window=window, q_off=q_off, block_q=block_q,
                           block_k=block_k, nk=nk, banded=banded,
-                          nsteps=nk_steps),
+                          nsteps=nk_steps, has_lens=has_lens),
         grid=(bh, nq, nk_steps),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index_dq),
-            pl.BlockSpec((1, block_k, d), kv_index_dq),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dq_args)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), q_index_dkv),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: _kv_row_index(kv_rep)(b, i, j)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: _kv_row_index(kv_rep)(b, i, j)),
+        pl.BlockSpec((1, block_q, d), q_index_dkv),
+        pl.BlockSpec((1, block_q, 1), q_index_dkv),
+        pl.BlockSpec((1, block_q, 1), q_index_dkv),
+    ]
+    dkv_args = [q, k, v, g, lse, delta]
+    if has_lens:
+        dkv_in_specs.append(pl.BlockSpec((1, 1), lambda b, j, i: (b, 0)))
+        dkv_args.append(lens)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           window=window, q_off=q_off, block_q=block_q,
                           block_k=block_k, nq=nq, banded=banded,
-                          nsteps=nq_steps),
+                          nsteps=nq_steps, has_lens=has_lens),
         grid=(bh, nk, nq_steps),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_index_dkv),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: _kv_row_index(kv_rep)(b, i, j)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: _kv_row_index(kv_rep)(b, i, j)),
-            pl.BlockSpec((1, block_q, d), q_index_dkv),
-            pl.BlockSpec((1, block_q, 1), q_index_dkv),
-            pl.BlockSpec((1, block_q, 1), q_index_dkv),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -335,7 +395,7 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dkv_args)
     if kv_rep > 1:
         # per-q-head partials -> sum over each kv group (rows are contiguous)
         dk = dk.reshape(bh_kv, kv_rep, sk, d).sum(axis=1).astype(k.dtype)
@@ -343,34 +403,38 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, scale, causal, window, kv_rep, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, window=window,
-                        kv_rep=kv_rep, block_q=block_q, block_k=block_k,
-                        interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, lens, scale, causal, window, kv_rep, block_q, block_k,
+           interpret):
+    out, _ = _flash_fwd(q, k, v, lens, scale=scale, causal=causal,
+                        window=window, kv_rep=kv_rep, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, window, kv_rep, block_q, block_k,
-                   interpret):
-    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal, window=window,
-                          kv_rep=kv_rep, block_q=block_q, block_k=block_k,
-                          interpret=interpret)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, lens, scale, causal, window, kv_rep, block_q,
+                   block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, lens, scale=scale, causal=causal,
+                          window=window, kv_rep=kv_rep, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    return out, (q, k, v, lens, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, window, kv_rep, block_q, block_k, interpret,
                    res, g):
-    return _flash_bwd(res, g, scale=scale, causal=causal, window=window,
-                      kv_rep=kv_rep, block_q=block_q, block_k=block_k,
-                      interpret=interpret)
+    dq, dk, dv = _flash_bwd(res, g, scale=scale, causal=causal, window=window,
+                            kv_rep=kv_rep, block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    lens = res[3]
+    dlens = None if lens is None else np.zeros(lens.shape, _float0)
+    return dq, dk, dv, dlens
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
-                    window: int | None = None,
+                    window: int | None = None, kv_lens=None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool | None = None):
     """q,k,v: [B, S, H, D] (reference flash_attention layout). GQA supported
@@ -378,7 +442,12 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     row b//rep through the index map, so no repeated K/V is materialised.
     ``window``: causal sliding-window size (Mistral-style; token i attends
     to [i-window+1, i]) — the banded grid skips out-of-band tiles AND their
-    DMAs, so long-sequence cost is O(S*window)."""
+    DMAs, so long-sequence cost is O(S*window).
+    ``kv_lens``: [B] int32 valid key lengths — the padded-varlen path (ref
+    ``flash_attn_varlen`` capability): keys >= the row's length are masked
+    in-kernel and fully-padded key blocks are skipped, with no O(S^2) mask
+    tensor. Queries in the padding produce zero output rows and zero grads
+    (callers mask the loss)."""
     b, s, h, d = q.shape
     sk = k.shape[1]
     h_kv = k.shape[2]
@@ -396,6 +465,10 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     def to_bh(x):
         return jnp.swapaxes(x, 1, 2).reshape(-1, x.shape[1], d)
 
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, window, kv_rep,
-                 bq, bk, interpret)
+    lens = None
+    if kv_lens is not None:
+        # [B] -> [B*H, 1]: one scalar per q batch-head row
+        lens = jnp.repeat(jnp.asarray(kv_lens, jnp.int32), h)[:, None]
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), lens, scale, causal, window,
+                 kv_rep, bq, bk, interpret)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

@@ -1,0 +1,184 @@
+"""Paged-attention decode kernel (ref capability: PaddleNLP ``llm``
+block-attention / ``paddle/phi/kernels/fusion/gpu/
+fused_multi_transformer_op.cu`` block KV cache).
+
+TPU-first design: the KV cache is a POOL of fixed-size blocks
+([num_blocks, block_size, H_kv, D]) shared by all sequences; each sequence
+owns a row of ``block_tables`` (pool indices). Decode attention reads a
+sequence's blocks pool-directly via a scalar-prefetched block table
+(``pltpu.PrefetchScalarGridSpec``) — the kernel's index_map picks the
+physical block for each grid step, so the gathered K/V is NEVER
+materialised: HBM holds pool ≈ Σ actual lengths (not B × max_len) and VMEM
+holds one block at a time.
+
+Layout: q [B, H, D] (one decode token per sequence), pool
+[N_blocks, block_size, H_kv, D], block_tables [B, max_blocks], lens [B].
+Unused table slots must hold a VALID pool index (0 is fine): the index map
+still reads them, the compute is masked off by ``lens``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc, m_sc, l_sc, *, block_size, scale, max_blocks,
+                         window):
+    """Grid (B*H, max_blocks); block j of row bh is pool block
+    tables[bh, j] (resolved by the BlockSpec index maps)."""
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    seq_len = lens_ref[bh, 0]
+    n_live = pl.cdiv(seq_len, block_size)
+    live = j < n_live
+    if window is not None:
+        # sliding window: only the last `window` positions are visible —
+        # blocks entirely below seq_len - window are dead
+        live &= (j + 1) * block_size > seq_len - window
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]          # [1, D] — this head's single query row
+        k = k_ref[0, 0]       # [block_size, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q.astype(jnp.float32), k.astype(jnp.float32),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        # mask positions beyond the sequence length within the last block
+        pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        keep = pos < seq_len
+        if window is not None:
+            keep &= pos >= seq_len - window
+        s = jnp.where(keep, s, _NEG_INF)
+        m_prev = m_sc[0, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_sc[0, 0] = l_sc[0, 0] * corr + jnp.sum(p)
+        m_sc[0, 0] = m_new
+        pv = jax.lax.dot_general(p, v.astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * corr + pv
+
+    @pl.when(j == max_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc[:] / jnp.maximum(l_sc[0, 0], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
+                                  scale=None, window=None,
+                                  interpret: bool | None = None):
+    """One decode step over block tables. q: [B, H, D];
+    k_pool/v_pool: [N, bs, H_kv, D]; block_tables: [B, max_blocks] int32;
+    lens: [B] int32 (current lengths INCLUDING the new token, whose K/V
+    must already be written to the pool). Returns [B, H, D]."""
+    b, h, d = q.shape
+    n, bs, h_kv, _ = k_pool.shape
+    kv_rep = h // h_kv
+    max_blocks = block_tables.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # one grid row per (sequence, q head)
+    qf = q.reshape(b * h, 1, d)
+    tables_bh = jnp.repeat(block_tables.astype(jnp.int32), h, axis=0)
+    lens_bh = jnp.repeat(lens.astype(jnp.int32), h)[:, None]
+
+    # pool per kv head: [H_kv, N, bs, D] — one (head, block) tile is a
+    # contiguous [bs, D] slice
+    kp = jnp.moveaxis(k_pool, 2, 0)
+    vp = jnp.moveaxis(v_pool, 2, 0)
+
+    def kv_index(bh, j, tables, lens):
+        # unused slots hold the OOB sentinel (num_blocks) — clamp; their
+        # compute is masked off by lens in the kernel
+        return ((bh % h) // kv_rep, jnp.minimum(tables[bh, j], n - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda bh, j, t, l: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda bh, j, t, l: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, block_size=bs,
+                               scale=scale, max_blocks=max_blocks,
+                               window=window)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        interpret=interpret,
+    )(tables_bh, lens_bh, qf, kp, vp)
+    return out.reshape(b, h, d)
+
+
+def paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens, *,
+                               scale=None, window=None):
+    """Gather-based reference path (CPU tests / fallback). Same contract as
+    the Pallas kernel; materialises the gathered K/V transiently."""
+    b, h, d = q.shape
+    n, bs, h_kv, _ = k_pool.shape
+    scale = scale if scale is not None else d ** -0.5
+    max_blocks = block_tables.shape[1]
+    # clamp the OOB padding sentinel (= num_blocks): jnp.take's fill mode
+    # would yield NaN rows, which the length mask cannot launder
+    tables = jnp.minimum(block_tables, n - 1)
+    k = jnp.take(k_pool, tables, axis=0)  # [B, MB, bs, H_kv, D]
+    v = jnp.take(v_pool, tables, axis=0)
+    k = k.reshape(b, max_blocks * bs, h_kv, d)
+    v = v.reshape(b, max_blocks * bs, h_kv, d)
+    if h_kv != h:
+        rep = h // h_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_blocks * bs)[None, None, :]
+    keep = pos < lens[:, None, None]
+    if window is not None:
+        keep &= pos >= (lens[:, None, None] - window)
+    s = jnp.where(keep, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, lens, *,
+                           scale=None, window=None,
+                           interpret: bool | None = None):
+    """Dispatch: Pallas on TPU (pool-direct block reads), XLA elsewhere.
+    ``window``: sliding-window bound — only the last `window` positions
+    are visible (Mistral decode semantics)."""
+    if jax.default_backend() == "tpu":
+        try:
+            return paged_decode_attention_pallas(
+                q, k_pool, v_pool, block_tables, lens, scale=scale,
+                window=window, interpret=interpret)
+        except Exception:
+            pass
+    return paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens,
+                                      scale=scale, window=window)

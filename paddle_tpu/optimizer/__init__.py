@@ -193,8 +193,35 @@ class Optimizer:
         return self.learning_rate
 
     # -- update -------------------------------------------------------------
+    def _owg_mask(self, params):
+        """Bool tree marking overwrite-with-gradient leaves (fp8 delayed-
+        scaling meta: their 'gradient' IS the new value). None when absent."""
+        from paddle_tpu.amp.fp8 import FP8_META_MARKER
+        from paddle_tpu.core.module import _path_to_str
+        found = [False]
+
+        def mark(path, leaf):
+            hit = FP8_META_MARKER in _path_to_str(path)
+            found[0] = found[0] or hit
+            return hit
+
+        mask = jax.tree_util.tree_map_with_path(
+            mark, params, is_leaf=lambda x: x is None)
+        return mask if found[0] else None
+
     def step(self, params, grads, state):
         """Returns (new_params, new_state). Pure — safe under jit/donation."""
+        owg = self._owg_mask(params)
+        owg_values = None
+        if owg is not None:
+            # fp8 meta leaves: stash the incoming "grads" (= new values),
+            # zero them so clipping/update math never sees their magnitude,
+            # and splice them into new_params at the end. (Meta tensors are
+            # fp32 by construction, so no master-weight copy shadows them.)
+            owg_values = grads
+            grads = jax.tree_util.tree_map(
+                lambda m, g: jnp.zeros_like(g) if (m and g is not None) else g,
+                owg, grads, is_leaf=lambda x: x is None)
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)
         lr = self._lr(state)
@@ -215,6 +242,10 @@ class Optimizer:
                 params, state["master"], new_compute)
         else:
             new_params = new_compute
+        if owg is not None:
+            new_params = jax.tree_util.tree_map(
+                lambda m, p, v: v if (m and v is not None) else p,
+                owg, new_params, owg_values, is_leaf=lambda x: x is None)
         return new_params, new_state
 
     def _update(self, params, grads, state, lr):

@@ -32,6 +32,88 @@ def test_flash_bwd_matches_xla(causal):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_varlen_kv_lens_matches_masked_xla(causal):
+    """Padded-varlen path: kv_lens masking == dense key-padding mask, for
+    valid query rows, fwd + grads (ref flash_attn varlen capability)."""
+    rs = np.random.RandomState(3)
+    b, s, h, d = 3, 256, 2, 32
+    lens = jnp.asarray([256, 130, 7], jnp.int32)
+    q, k, v = (jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+               for _ in range(3))
+    pad = (jnp.arange(s)[None, :] < lens[:, None])[:, None, None, :]
+    valid_q = (jnp.arange(s)[None, :] < lens[:, None])[:, :, None, None]
+
+    ref = xla_attention(q, k, v, attn_mask=pad, is_causal=causal)
+    got = flash_attention(q, k, v, causal=causal, kv_lens=lens,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got * valid_q),
+                               np.asarray(ref * valid_q),
+                               rtol=1e-5, atol=1e-5)
+
+    # grads: loss only over valid query rows (callers mask the padding)
+    def loss(attend):
+        def f(q, k, v):
+            out = attend(q, k, v)
+            return jnp.sum((out * valid_q) ** 2)
+        return f
+
+    ref_g = jax.grad(loss(lambda q, k, v: xla_attention(
+        q, k, v, attn_mask=pad, is_causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    got_g = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, kv_lens=lens, interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    for r, g in zip(ref_g, got_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_varlen_gqa_and_padded_rows_zero():
+    """kv_lens composes with GQA; a row with ZERO valid keys (fully-masked
+    softmax) emits exact zeros and finite (zero) grads, not NaN."""
+    rs = np.random.RandomState(4)
+    b, s, h, hkv, d = 2, 128, 4, 2, 32
+    lens = jnp.asarray([128, 64], jnp.int32)
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, hkv, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, hkv, d).astype(np.float32))
+    out = flash_attention(q, k, v, causal=False, kv_lens=lens, interpret=True)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+    # GQA + kv_lens matches repeated-KV dense-masked reference on valid rows
+    pad = (jnp.arange(s)[None, :] < lens[:, None])[:, None, None, :]
+    ref = xla_attention(q, k, v, attn_mask=pad, is_causal=False)
+    valid_q = (jnp.arange(s)[None, :] < lens[:, None])[:, :, None, None]
+    np.testing.assert_allclose(np.asarray(out * valid_q),
+                               np.asarray(ref * valid_q), rtol=1e-5, atol=1e-5)
+
+    # a row with NO valid keys: fully-masked softmax -> zero rows, zero grads
+    lens0 = jnp.asarray([128, 0], jnp.int32)
+    out0 = flash_attention(q, k, v, causal=False, kv_lens=lens0,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(out0[1]), 0.0, atol=1e-6)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, causal=False, kv_lens=lens0, interpret=True) ** 2))(q)
+    assert np.all(np.isfinite(np.asarray(g))), "masked rows must not NaN grads"
+    np.testing.assert_allclose(np.asarray(g[1]), 0.0, atol=1e-6)
+
+
+def test_sdpa_dispatch_kv_lens_xla_path():
+    """scaled_dot_product_attention honours kv_lens on the XLA path too."""
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+    rs = np.random.RandomState(5)
+    b, s, h, d = 2, 64, 2, 16
+    lens = jnp.asarray([64, 20], jnp.int32)
+    q, k, v = (jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+               for _ in range(3))
+    pad = (jnp.arange(s)[None, :] < lens[:, None])[:, None, None, :]
+    ref = xla_attention(q, k, v, attn_mask=pad)
+    got = scaled_dot_product_attention(q, k, v, kv_lens=lens)
+    valid_q = (jnp.arange(s)[None, :] < lens[:, None])[:, :, None, None]
+    np.testing.assert_allclose(np.asarray(got * valid_q),
+                               np.asarray(ref * valid_q), rtol=1e-5, atol=1e-5)
+
+
 def test_flash_bf16():
     rs = np.random.RandomState(2)
     q, k, v = (jnp.asarray(rs.randn(1, 128, 2, 64)).astype(jnp.bfloat16) for _ in range(3))

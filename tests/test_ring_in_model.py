@@ -184,16 +184,23 @@ def test_mistral_ring_matches_single_device():
     assert abs(loss - ref_loss) < 2e-4, (loss, ref_loss)
 
 
-def test_ulysses_with_window_still_raises():
+def test_ulysses_with_window_matches_single_device():
+    """Round 1 raised here; the window now composes with Ulysses (the
+    post-all_to_all inner attention is full-sequence, so the global band
+    applies unchanged). Mistral x Ulysses == unsharded."""
     pt.seed(0)
-    cfg = LlamaConfig.tiny(num_hidden_layers=1, sequence_parallel="ulysses",
-                           sliding_window=8, num_key_value_heads=4)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1, sliding_window=8,
+                           num_key_value_heads=4)
     m = LlamaForCausalLM(cfg)
     ids, _ = _data(cfg, batch=1, seq=16)
+    ref = m(ids)
+    for lyr in m.model.layers:
+        lyr.self_attn.sequence_parallel = "ulysses"
     mesh = HybridMesh(sp=4, devices=jax.devices()[:4])
     with mesh:
-        with pytest.raises(NotImplementedError):
-            m(ids)
+        got = jax.jit(lambda m, i: m(i))(m, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
 
 
 def test_ulysses_model_matches_single_device():
