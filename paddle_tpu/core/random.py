@@ -19,7 +19,17 @@ class _GlobalRng:
         return sub
 
 
-_global = _GlobalRng()
+# LAZY: creating a PRNGKey initializes the jax backend, and importing the
+# package must stay computation-free (jax.distributed.initialize() has to
+# run before ANY backend use — the multi-host launch contract).
+_global = None
+
+
+def _get_global() -> _GlobalRng:
+    global _global
+    if _global is None:
+        _global = _GlobalRng()
+    return _global
 
 
 def seed(value: int) -> None:
@@ -33,7 +43,7 @@ def next_key() -> jax.Array:
 
     Never call inside jit — pass keys explicitly there (RngStream).
     """
-    return _global.split()
+    return _get_global().split()
 
 
 class RngStream:

@@ -47,10 +47,39 @@ class TokenBinDataset(IterableDataset):
 
     def __init__(self, path: str, batch_size: int, seq_len: int, seed: int = 0,
                  token_width: int = 2, num_workers: int = 2, prefetch: int = 8,
-                 num_batches: int | None = None):
+                 num_batches: int | None = None,
+                 shard: tuple[int, int] | None = None):
+        """``shard=(rank, world)`` de-correlates the random-window stream
+        across hosts (each host draws from a distinct seeded stream — the
+        standard dp recipe for window-sampling loaders). ``shard=None``
+        auto-detects from the launch env contract (PROCESS_ID /
+        NUM_PROCESSES) or an ALREADY-INITIALIZED jax.distributed runtime;
+        it never initializes the backend itself (constructing a dataset
+        before ``launch.initialize_cluster()`` must stay side-effect-free),
+        falling back to (0, 1)."""
+        if shard is None:
+            rank = int(os.environ.get("PROCESS_ID", "-1"))
+            world = int(os.environ.get("NUM_PROCESSES", "-1"))
+            if world > 0 and 0 <= rank < world:
+                shard = (rank, world)
+            else:
+                try:
+                    from jax._src import distributed as _jd
+                    if _jd.global_state.client is not None:
+                        import jax
+                        shard = (jax.process_index(), jax.process_count())
+                    else:
+                        shard = (0, 1)
+                except Exception:
+                    shard = (0, 1)
+        rank, world = shard
+        if not (0 <= rank < world):
+            raise ValueError(f"bad shard {shard}")
+        self.shard = (rank, world)
         self.path = os.fspath(path)
         self.batch_size = batch_size
         self.seq_len = seq_len
+        seed = seed * world + rank  # distinct stream per host
         self.seed = seed
         self.token_width = token_width
         self.num_workers = num_workers

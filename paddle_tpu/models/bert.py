@@ -11,6 +11,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.module import Module
 from paddle_tpu.nn import functional as F
@@ -116,7 +117,20 @@ class BertModel(Module):
         kv_lens = None
         if attention_mask is not None:
             if self.cfg.varlen_attention:
-                # contiguous right-padding: lengths keep the fused kernel
+                # contiguous right-padding only: lengths keep the fused
+                # kernel. Guard eagerly-passed masks (a traced mask inside
+                # jit cannot be checked — the contract is documented).
+                if not isinstance(attention_mask, jax.core.Tracer):
+                    am = np.asarray(attention_mask)
+                    lens_np = am.sum(axis=1)
+                    prefix = (np.arange(am.shape[1])[None, :]
+                              < lens_np[:, None]).astype(am.dtype)
+                    if not np.array_equal(am, prefix):
+                        raise ValueError(
+                            "varlen_attention=True requires a CONTIGUOUS "
+                            "right-padded attention_mask (1s then 0s); got "
+                            "a non-prefix mask — use varlen_attention="
+                            "False for arbitrary masks")
                 kv_lens = jnp.sum(attention_mask.astype(jnp.int32), axis=1)
                 attention_mask = None
             else:

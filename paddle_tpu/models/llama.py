@@ -120,12 +120,11 @@ class LlamaAttention(Module):
             if mesh is not None and mesh.size("sp") > 1:
                 # normalise attn_mask to [B, S, S] bool over global
                 # positions (both sp paths consume that form); a [B, S]
-                # or [B,1,1,S] key-padding mask broadcasts to rows, and an
-                # ADDITIVE float mask (0 = attend, big-negative = block)
-                # maps via `>= 0` — hard masks only: a soft bias (finite
-                # non-zero values) cannot ride the boolean sp paths, and a
-                # PER-HEAD mask has no [B,S,S] form, so raise rather than
-                # silently collapse to head 0
+                # or [B,1,1,S] key-padding mask broadcasts to rows. The sp
+                # paths are BOOLEAN-mask only: a float additive mask may be
+                # a soft bias (ALiBi-style), which cannot ride them without
+                # silently hardening — raise rather than diverge from the
+                # non-sp path; per-head masks have no [B,S,S] form either.
                 mask3 = None
                 if attn_mask is not None:
                     m = attn_mask
@@ -135,9 +134,12 @@ class LlamaAttention(Module):
                             "sequence_parallel (needs [B,S,S]); use "
                             "sequence_parallel=None")
                     if jnp.issubdtype(m.dtype, jnp.floating):
-                        m = m >= 0
-                    else:
-                        m = m.astype(bool)
+                        raise NotImplementedError(
+                            "additive float attn_mask under "
+                            "sequence_parallel would be silently hardened "
+                            "to allow/block; pass a BOOLEAN mask, or use "
+                            "sequence_parallel=None for soft biases")
+                    m = m.astype(bool)
                     s_full = q.shape[1]
                     if m.ndim == 4:
                         m = m[:, 0]          # [B,(1|S),S]

@@ -239,6 +239,12 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
                                 new_lens)
 
 
+# module-level jit wrappers: their compile caches persist across
+# paged_generate calls (a per-call jax.jit would recompile every request)
+_PREFILL_JIT = jax.jit(llama_prefill_paged)
+_DECODE_JIT = jax.jit(llama_decode_step_paged)
+
+
 def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
                    block_size=16, num_blocks=None, eos_token_id=None):
     """Greedy continuous-batch decode over a paged cache.
@@ -269,8 +275,8 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
                               b, max_blocks, cfg.dtype)
     cache.block_tables = mgr.table_array(range(b), max_blocks)
 
-    prefill = jax.jit(llama_prefill_paged)
-    step = jax.jit(llama_decode_step_paged)
+    prefill = _PREFILL_JIT
+    step = _DECODE_JIT
 
     logits, cache = prefill(model, jnp.asarray(input_ids),
                             jnp.asarray(lens_np, jnp.int32), cache)

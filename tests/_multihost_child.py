@@ -1,0 +1,104 @@
+"""Child program for the 2-process multi-host integration test.
+
+Launched twice by tests/test_multihost.py with COORDINATOR_ADDRESS /
+NUM_PROCESSES / PROCESS_ID in the env (the paddle_tpu.distributed.launch
+contract). Exercises, against a REAL second process:
+  * launch.initialize_cluster (jax.distributed over the CPU backend)
+  * a cross-process device collective through GSPMD (global-mesh sum)
+  * collective.all_gather_object (pickled host data)
+  * DistributedBatchSampler per-host disjoint sharding
+  * TokenBinDataset per-host stream sharding (native C++ loader)
+  * multi-host checkpoint: rank 0 writes, barrier, both ranks restore
+Prints one "MULTIHOST_OK <json>" line on success (the parent asserts it).
+"""
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed import launch
+    launch.initialize_cluster()
+    assert jax.process_count() == 2, jax.process_count()
+    pid = jax.process_index()
+    results = {"pid": pid}
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    # -- cross-process device collective (GSPMD-inserted all-reduce) -------
+    devs = np.asarray(jax.devices())            # 2 global devices
+    mesh = Mesh(devs, ("dp",))
+    local = jnp.asarray([np.float32(pid + 1)])  # host-local shard
+    garr = multihost_utils.host_local_array_to_global_array(
+        local, mesh, P("dp"))
+    total = jax.jit(jnp.sum,
+                    in_shardings=NamedSharding(mesh, P("dp")),
+                    out_shardings=NamedSharding(mesh, P()))(garr)
+    val = float(np.asarray(total.addressable_data(0)))
+    assert val == 3.0, val  # 1 (rank0) + 2 (rank1)
+    results["global_sum"] = val
+
+    # -- all_gather_object --------------------------------------------------
+    from paddle_tpu.distributed.collective import all_gather_object
+    objs = all_gather_object({"rank": pid, "payload": list(range(pid + 2))})
+    assert [o["rank"] for o in objs] == [0, 1], objs
+    assert objs[1]["payload"] == [0, 1, 2]
+    results["all_gather_object"] = True
+
+    # -- per-host data sharding (DistributedBatchSampler) -------------------
+    from paddle_tpu.io import DistributedBatchSampler
+    ds = list(range(16))
+    sampler = DistributedBatchSampler(ds, batch_size=2)  # auto rank/world
+    local_idx = [i for batch in sampler for i in batch]
+    gathered = all_gather_object(local_idx)
+    flat = sorted(i for part in gathered for i in part)
+    assert flat == list(range(16)), flat                 # full coverage
+    assert not (set(gathered[0]) & set(gathered[1]))     # disjoint
+    results["sampler_disjoint"] = True
+
+    # -- token-bin stream sharding (native loader, per-host streams) --------
+    shared_dir = os.environ["MULTIHOST_SHARED_DIR"]
+    bin_path = os.path.join(shared_dir, "tokens.bin")
+    if pid == 0:
+        np.arange(4096, dtype=np.uint16).tofile(bin_path)
+    multihost_utils.sync_global_devices("tokenbin_written")
+    from paddle_tpu.io.token_bin import TokenBinDataset
+    tb = TokenBinDataset(bin_path, batch_size=2, seq_len=16, seed=7,
+                         num_batches=4)  # shard auto-detected
+    mine = np.concatenate([x for x, _ in tb], axis=None)
+    streams = all_gather_object(mine.tolist())
+    assert streams[0] != streams[1], "host streams must differ"
+    # same rank+seed reproduces its stream
+    tb2 = TokenBinDataset(bin_path, batch_size=2, seq_len=16, seed=7,
+                          num_batches=4)
+    again = np.concatenate([x for x, _ in tb2], axis=None)
+    assert streams[pid] == again.tolist()
+    results["token_bin_sharded"] = True
+
+    # -- multi-host checkpoint: rank 0 writes, everyone restores ------------
+    from paddle_tpu.train.checkpoint import CheckpointManager
+    state = {"w": jnp.full((4,), 2.0 + pid), "step": jnp.asarray(3)}
+    ckdir = os.path.join(shared_dir, "ckpt")
+    mgr = CheckpointManager(ckdir, max_to_keep=2)
+    if pid == 0:
+        mgr.save(3, state)
+    multihost_utils.sync_global_devices("ckpt_saved")
+    restored = mgr.restore(state)
+    np.testing.assert_allclose(np.asarray(restored["w"]), 2.0)  # rank 0's
+    assert int(restored["step"]) == 3
+    results["checkpoint"] = True
+
+    multihost_utils.sync_global_devices("done")
+    print("MULTIHOST_OK " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

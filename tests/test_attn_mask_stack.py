@@ -145,10 +145,10 @@ def test_llama_ring_with_attn_mask():
                                rtol=2e-3, atol=2e-4)
 
 
-def test_llama_sp_additive_float_mask_not_inverted():
-    """An ADDITIVE float mask (0 = attend, -1e9 = block) through the sp
-    dispatch must not be inverted by boolification, and the broadcastable
-    [B,1,1,S] form must work (code-review r2 findings)."""
+def test_llama_sp_bool_broadcast_mask_and_float_raises():
+    """A [B,1,1,S] BOOL key-padding mask broadcasts through the sp dispatch;
+    a float additive mask raises (it could be a soft bias, which the
+    boolean sp paths would silently harden — code-review r2)."""
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     pt.seed(0)
@@ -161,7 +161,6 @@ def test_llama_sp_additive_float_mask_not_inverted():
     ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)))
     lens = jnp.asarray([32, 15], jnp.int32)
     keep = jnp.arange(s)[None, :] < lens[:, None]           # [B, S] bool
-    additive = jnp.where(keep, 0.0, -1e9)[:, None, None, :]  # [B,1,1,S] float
 
     ref = model(ids, attn_mask=keep[:, None, None, :])
 
@@ -172,11 +171,22 @@ def test_llama_sp_additive_float_mask_not_inverted():
     model_sp = LlamaForCausalLM(cfg_sp)
     mesh = HybridMesh(sp=8)
     with mesh:
-        got = model_sp(ids, attn_mask=additive)
+        got = model_sp(ids, attn_mask=keep[:, None, None, :])
     valid_q = (jnp.arange(s)[None, :] < lens[:, None])[..., None]
     np.testing.assert_allclose(np.asarray(got * valid_q),
                                np.asarray(ref * valid_q),
                                rtol=2e-3, atol=2e-4)
+
+    additive = jnp.where(keep, 0.0, -1e9)[:, None, None, :]
+    with mesh:
+        with pytest.raises(NotImplementedError):
+            model_sp(ids, attn_mask=additive)
+    # per-head masks also raise rather than collapsing to head 0
+    per_head = jnp.broadcast_to(keep[:, None, None, :],
+                                (b, cfg.num_attention_heads, s, s))
+    with mesh:
+        with pytest.raises(NotImplementedError):
+            model_sp(ids, attn_mask=per_head)
 
 
 def test_bert_varlen_matches_dense_mask():
