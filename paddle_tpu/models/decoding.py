@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.ops import attention as A
+from paddle_tpu.quantization import wo_matmul
 
 
 @dataclass
@@ -125,7 +126,7 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
         h = lyr.input_layernorm(x)
         b, s, _ = h.shape
         att = lyr.self_attn
-        qkv = h @ att.qkv_proj
+        qkv = wo_matmul(h, att.qkv_proj)
         if getattr(att, "qkv_bias", None) is not None:  # Qwen2
             qkv = qkv + att.qkv_bias
         nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
@@ -140,7 +141,7 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
                                            slot_pos=slot_pos)
         new_k_list.append(k_c)
         new_v_list.append(v_c)
-        x = x + out.reshape(b, s, nh * hd) @ att.o_proj
+        x = x + wo_matmul(out.reshape(b, s, nh * hd), att.o_proj)
         x = x + lyr.mlp(lyr.post_attention_layernorm(x))
     x = model.model.norm(x)
     logits = model.logits(x)

@@ -176,7 +176,8 @@ class LlamaAttention(Module):
             from paddle_tpu.amp.fp8 import fp8_matmul
             qkv = fp8_matmul(x, self.qkv_proj, self.fp8_meta["qkv"])
         else:
-            qkv = x @ self.qkv_proj
+            from paddle_tpu.quantization import wo_matmul
+            qkv = wo_matmul(x, self.qkv_proj)
         if self.qkv_bias is not None:
             qkv = qkv + self.qkv_bias
         q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
@@ -190,7 +191,8 @@ class LlamaAttention(Module):
         if self.fp8_meta is not None:
             from paddle_tpu.amp.fp8 import fp8_matmul
             return fp8_matmul(out, self.o_proj, self.fp8_meta["o"])
-        return out @ self.o_proj
+        from paddle_tpu.quantization import wo_matmul
+        return wo_matmul(out, self.o_proj)
 
 
 class LlamaMLP(Module):
@@ -218,9 +220,10 @@ class LlamaMLP(Module):
             gate, up = jnp.split(gu, 2, axis=-1)
             return fp8_matmul(jax.nn.silu(gate) * up, self.down_proj,
                               self.fp8_meta["down"])
-        gu = x @ self.gate_up_proj
+        from paddle_tpu.quantization import wo_matmul
+        gu = wo_matmul(x, self.gate_up_proj)
         gate, up = jnp.split(gu, 2, axis=-1)
-        return (jax.nn.silu(gate) * up) @ self.down_proj
+        return wo_matmul(jax.nn.silu(gate) * up, self.down_proj)
 
 
 class LlamaDecoderLayer(Module):
@@ -292,8 +295,9 @@ class LlamaForCausalLM(Module):
             self.set_pspec("lm_head", P(None, "tp"))
 
     def logits(self, hidden):
+        from paddle_tpu.quantization import wo_matmul
         w = self.model.embed_tokens.T if self.lm_head is None else self.lm_head
-        return hidden @ w
+        return wo_matmul(hidden, w)
 
     def __call__(self, input_ids, attn_mask=None, position_ids=None):
         hidden = self.model(input_ids, attn_mask, position_ids)

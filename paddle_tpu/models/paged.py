@@ -28,6 +28,7 @@ import numpy as np
 
 from paddle_tpu.ops import attention as A
 from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+from paddle_tpu.quantization import wo_matmul as _wo
 
 
 @dataclass
@@ -167,7 +168,7 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache):
     for li, lyr in enumerate(model.model.layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
-        qkv = h @ att.qkv_proj
+        qkv = _wo(h, att.qkv_proj)
         if getattr(att, "qkv_bias", None) is not None:
             qkv = qkv + att.qkv_bias
         nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
@@ -184,7 +185,7 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache):
         v_pools.append(_scatter_prefill(cache.v_pools[li], v,
                                         cache.block_tables, prompt_lens,
                                         nb, bs))
-        x = x + out.reshape(b, s, nh * hd) @ att.o_proj
+        x = x + _wo(out.reshape(b, s, nh * hd), att.o_proj)
         x = x + lyr.mlp(lyr.post_attention_layernorm(x))
     x = model.model.norm(x)
     logits = model.logits(x)
@@ -211,7 +212,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
     for li, lyr in enumerate(model.model.layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
-        qkv = h @ att.qkv_proj
+        qkv = _wo(h, att.qkv_proj)
         if getattr(att, "qkv_bias", None) is not None:
             qkv = qkv + att.qkv_bias
         nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
@@ -231,7 +232,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
         out = paged_decode_attention(q[:, 0], k_pool, v_pool,
                                      cache.block_tables, new_lens,
                                      window=window)
-        x = x + out.reshape(b, 1, nh * hd) @ att.o_proj
+        x = x + _wo(out.reshape(b, 1, nh * hd), att.o_proj)
         x = x + lyr.mlp(lyr.post_attention_layernorm(x))
     x = model.model.norm(x)
     logits = model.logits(x)[:, 0]
