@@ -112,8 +112,12 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
     x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
     positions = pos + jnp.arange(input_ids.shape[1])
+    # rope scaling: linear/ntk apply; dynamic-NTK needs a per-step global
+    # length the traced decode cannot carry — allow_dynamic=False raises
     cos, sin = A.rope_cos_sin(input_ids.shape[1], d, base=cfg.rope_theta,
-                              position_ids=positions)
+                              position_ids=positions,
+                              scaling=getattr(cfg, "rope_scaling", None),
+                              allow_dynamic=False)
     slot_pos = cache.slot_pos
     if slot_pos is not None:  # ring cache: record absolute slot positions
         cap = slot_pos.shape[0]

@@ -49,6 +49,8 @@ class LlamaConfig:
     sliding_window: int | None = None  # Mistral-style causal window
     attention_bias: bool = False       # Qwen2: bias on fused qkv only
     sequence_parallel: str | None = None  # "ring" | "ulysses" over sp
+    # long-context extension (ref rope_scaling: linear | ntk | dynamic)
+    rope_scaling: dict | None = None
 
     @staticmethod
     def llama2_7b(**kw):
@@ -266,7 +268,9 @@ class LlamaModel(Module):
         from paddle_tpu.distributed.sharded import maybe_shard
         x = maybe_shard(x, ("dp", "fsdp"), "sp", None)
         cos, sin = A.rope_cos_sin(input_ids.shape[1], cfg.hidden_size // cfg.num_attention_heads,
-                                  base=cfg.rope_theta, position_ids=position_ids)
+                                  base=cfg.rope_theta, position_ids=position_ids,
+                                  scaling=cfg.rope_scaling,
+                                  max_position_embeddings=cfg.max_position_embeddings)
         layer_fn = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin, attn_mask),
                                    static_argnums=())
                     if cfg.remat else (lambda lyr, h: lyr(h, cos, sin, attn_mask)))
@@ -338,7 +342,8 @@ def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
                          num_microbatches=num_microbatches, remat=cfg.remat)
     cos, sin = A.rope_cos_sin(input_ids.shape[1],
                               cfg.hidden_size // cfg.num_attention_heads,
-                              base=cfg.rope_theta)
+                              base=cfg.rope_theta, scaling=cfg.rope_scaling,
+                              max_position_embeddings=cfg.max_position_embeddings)
 
     def layer_call(lyr, h):
         return lyr(h, cos, sin, None)

@@ -110,11 +110,14 @@ class BlockManager:
         return jnp.asarray(out)
 
 
-def _rope_rows(positions, head_dim, base):
+def _rope_rows(positions, head_dim, base, scaling=None):
     """cos/sin for PER-ROW positions: [B] -> [B, 1, 1, D/2] (ragged decode:
-    every sequence sits at a different position)."""
+    every sequence sits at a different position). Shares the scaling math
+    with ops.attention (linear/ntk; dynamic raises — fixed-shape path)."""
+    base, pos_div = A.resolve_rope_scaling(base, head_dim, scaling,
+                                           allow_dynamic=False)
     inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
-    f = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    f = (positions.astype(jnp.float32) / pos_div)[:, None] * inv[None, :]
     return (jnp.cos(f)[:, None, None, :], jnp.sin(f)[:, None, None, :])
 
 
@@ -163,7 +166,9 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache):
     nb, bs = cache.num_blocks, cache.block_size
     x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
-    cos, sin = A.rope_cos_sin(s, d, base=cfg.rope_theta)
+    cos, sin = A.rope_cos_sin(s, d, base=cfg.rope_theta,
+                              scaling=getattr(cfg, "rope_scaling", None),
+                              allow_dynamic=False)
     k_pools, v_pools = [], []
     for li, lyr in enumerate(model.model.layers):
         h = lyr.input_layernorm(x)
@@ -205,7 +210,8 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
     nb, bs = cache.num_blocks, cache.block_size
     x = jnp.take(model.model.embed_tokens, tokens[:, None], axis=0)  # [B,1,E]
     d = cfg.hidden_size // cfg.num_attention_heads
-    cos, sin = _rope_rows(cache.lens, d, cfg.rope_theta)
+    cos, sin = _rope_rows(cache.lens, d, cfg.rope_theta,
+                          getattr(cfg, "rope_scaling", None))
     window = getattr(cfg, "sliding_window", None)
     k_pools, v_pools = [], []
     new_lens = jnp.where(active, cache.lens + 1, cache.lens)
@@ -247,7 +253,8 @@ _DECODE_JIT = jax.jit(llama_decode_step_paged)
 
 
 def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
-                   block_size=16, num_blocks=None, eos_token_id=None):
+                   block_size=16, num_blocks=None, eos_token_id=None,
+                   temperature=0.0, top_k=None, top_p=None, rng=None):
     """Greedy continuous-batch decode over a paged cache.
 
     ``input_ids``: [B, S] right-padded ragged prompts with ``prompt_lens``
@@ -258,8 +265,16 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
     Host-driven step loop (the serving-engine shape: scheduling/allocation
     on host, fixed-shape jitted compute on device). Returns [B, S +
     max_new_tokens] tokens (finished rows are tail-padded with
-    ``eos_token_id``).
+    ``eos_token_id``). ``temperature``/``top_k``/``top_p`` enable sampling
+    (0.0 = greedy), sharing the sampler with models/decoding.py.
     """
+    from paddle_tpu.models.decoding import _sample
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        return np.asarray(_sample(logits.astype(jnp.float32), key,
+                                  temperature, top_k, top_p))
     cfg = model.cfg
     b, s = input_ids.shape
     lens_np = np.asarray(prompt_lens, np.int64)
@@ -284,7 +299,8 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
     tokens = np.concatenate(
         [np.asarray(input_ids),
          np.zeros((b, max_new_tokens), np.asarray(input_ids).dtype)], axis=1)
-    next_tok = np.asarray(jnp.argmax(logits.astype(jnp.float32), axis=-1))
+    rng, sub = jax.random.split(rng)
+    next_tok = pick(logits, sub)
     active = np.ones((b,), bool)
     cur = lens_np.copy()
     for sid in range(b):
@@ -305,7 +321,8 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
         cache.block_tables = mgr.table_array(range(b), max_blocks)
         logits, cache = step(model, jnp.asarray(next_tok, jnp.int32), cache,
                              jnp.asarray(active))
-        nxt = np.asarray(jnp.argmax(logits.astype(jnp.float32), axis=-1))
+        rng, sub = jax.random.split(rng)
+        nxt = pick(logits, sub)
         next_tok = np.where(active, nxt, next_tok)
         cur = cur + active.astype(np.int64)
         for sid in range(b):

@@ -1,0 +1,127 @@
+"""Speculative decoding with a draft model (ref capability: the PaddleNLP
+``llm`` predictor's speculative/draft-model decoding).
+
+Greedy verification: the draft proposes ``gamma`` tokens autoregressively;
+the target verifies them in ONE forward over the chunk and commits the
+longest matching prefix plus its own next token (the correction, or the
+"bonus" token when everything matched). Output is EXACTLY the target's own
+greedy decode — speculation only changes how many target forwards it takes.
+
+TPU-native notes:
+  * both models run the static KV cache (models/decoding.py); "rollback"
+    of rejected tokens is free — chunk writes are positional overwrites and
+    causal masking never attends beyond the current query position, so
+    stale cache entries are always either overwritten or masked.
+  * chunk lengths vary with the acceptance count, so the jitted chunk
+    forward retraces at most gamma+1 times per model (then every shape is
+    cached).
+  * single-sequence (B == 1): per-row acceptance counts would make batched
+    positions ragged; the reference's speculative predictor is likewise
+    sequence-at-a-time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.decoding import KVCache, llama_forward_with_cache
+
+
+def _greedy(logits):
+    return int(np.asarray(jnp.argmax(logits.astype(jnp.float32),
+                                     axis=-1)).reshape(-1)[0])
+
+
+def speculative_generate(target, draft, input_ids, max_new_tokens: int = 32,
+                         gamma: int = 4, eos_token_id=None):
+    """Greedy speculative decode. input_ids: [1, S]. Returns
+    (tokens [1, S + max_new_tokens], stats dict with acceptance info)."""
+    t_cfg, d_cfg = target.cfg, draft.cfg
+    if input_ids.shape[0] != 1:
+        raise ValueError("speculative_generate is single-sequence (B == 1)")
+    if getattr(t_cfg, "sliding_window", None) or \
+            getattr(d_cfg, "sliding_window", None):
+        raise NotImplementedError(
+            "speculative decoding over a windowed ring cache is not "
+            "supported (positional overwrite-rollback needs the full cache)")
+    prompt_len = input_ids.shape[1]
+    max_len = prompt_len + max_new_tokens + gamma + 2
+
+    def make_cache(cfg):
+        return KVCache.init(cfg.num_hidden_layers, 1, max_len,
+                            cfg.num_key_value_heads,
+                            cfg.hidden_size // cfg.num_attention_heads,
+                            cfg.dtype)
+
+    fwd = jax.jit(llama_forward_with_cache, static_argnums=())
+
+    cache_t, cache_d = make_cache(t_cfg), make_cache(d_cfg)
+    ids = jnp.asarray(input_ids)
+    logits_t, cache_t = fwd(target, ids, cache_t, 0)
+    _, cache_d = fwd(draft, ids, cache_d, 0)
+
+    committed: list[int] = []          # tokens at positions prompt_len + i
+    c = _greedy(logits_t[:, -1])       # first committed token
+    committed.append(c)
+    pos = prompt_len                   # target cache valid through pos - 1
+    draft_pos = prompt_len             # draft cache valid through draft_pos-1
+    rounds = 0
+    accepted_total = 0
+
+    def done():
+        return (len(committed) >= max_new_tokens
+                or (eos_token_id is not None and eos_token_id in committed))
+
+    while not done():
+        rounds += 1
+        # ---- draft proposes gamma tokens ------------------------------
+        # first feed it any committed tokens it has not processed yet
+        # (suffix from draft_pos .. pos); its last logit starts proposals
+        pending = committed[draft_pos - prompt_len:]
+        chunk_d = jnp.asarray([pending], jnp.int32)
+        dl, cache_d = fwd(draft, chunk_d, cache_d, draft_pos)
+        draft_pos += len(pending)
+        props = [_greedy(dl[:, -1])]
+        for _ in range(gamma - 1):
+            dl, cache_d = fwd(draft, jnp.asarray([[props[-1]]], jnp.int32),
+                              cache_d, draft_pos)
+            draft_pos += 1
+            props.append(_greedy(dl[:, -1]))
+
+        # ---- target verifies the whole chunk in one forward ------------
+        chunk_t = jnp.asarray([[c] + props], jnp.int32)
+        # written at positions pos..pos+gamma
+        tl, cache_t = fwd(target, chunk_t, cache_t, pos)
+        vs = np.asarray(jnp.argmax(tl.astype(jnp.float32), axis=-1))[0]
+        # vs[i] = target's token for position pos+1+i
+        n_acc = 0
+        while n_acc < gamma and vs[n_acc] == props[n_acc]:
+            n_acc += 1
+        # accepted prefix + the target's own next token (correction, or the
+        # bonus token when every proposal matched — n_acc == gamma)
+        new = props[:n_acc] + [int(vs[n_acc])]
+        committed.extend(new)
+        accepted_total += n_acc
+        pos += n_acc + 1
+        c = committed[-1]
+        # draft cache holds proposals up to draft_pos-1; positions beyond
+        # the new committed frontier are stale but will be overwritten (its
+        # next chunk write starts at the frontier) — reset the pointer
+        draft_pos = min(draft_pos, pos)
+
+    committed = committed[:max_new_tokens]
+    if eos_token_id is not None and eos_token_id in committed:
+        # match generate()'s single-sequence semantics exactly: the buffer
+        # past the first EOS stays zero-initialized
+        committed = committed[: committed.index(eos_token_id) + 1]
+    out = np.concatenate(
+        [np.asarray(ids)[0],
+         np.asarray(committed, np.asarray(ids).dtype),
+         np.zeros((max_new_tokens - len(committed),),
+                  np.asarray(ids).dtype)])
+    stats = {"rounds": rounds,
+             "proposed": rounds * gamma,
+             "accepted": accepted_total,
+             "acceptance_rate": accepted_total / max(rounds * gamma, 1)}
+    return jnp.asarray(out[None]), stats

@@ -115,9 +115,52 @@ flash_attention = scaled_dot_product_attention
 
 # -- rotary embedding (ref: paddle.incubate.nn.functional.fused_rotary_position_embedding)
 
-def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32, position_ids=None):
+def resolve_rope_scaling(base, head_dim, scaling, seq_len=None,
+                         max_position_embeddings=None, *,
+                         allow_dynamic=True):
+    """The ONE place the rope_scaling math lives. Returns
+    ``(base, position_divisor)`` for the reference rope_scaling dict
+    (PaddleNLP/HF convention):
+      {"type": "linear",  "factor": f} — position interpolation (pos / f)
+      {"type": "ntk",     "factor": f} — base *= f^(d/(d-2)) (fixed NTK)
+      {"type": "dynamic", "factor": f} — NTK base grows once ``seq_len``
+        exceeds the trained length. Needs a per-call global length, so
+        fixed-shape decode paths pass ``allow_dynamic=False`` and raise
+        instead of silently mis-rotating.
+    """
+    if not scaling:
+        return base, 1.0
+    kind, factor = scaling["type"], float(scaling["factor"])
+    if kind == "linear":
+        return base, factor
+    if kind == "ntk":
+        return base * factor ** (head_dim / (head_dim - 2)), 1.0
+    if kind == "dynamic":
+        if not allow_dynamic:
+            raise NotImplementedError(
+                "dynamic-NTK rope_scaling needs the global sequence length "
+                "each step, which this fixed-shape decode path cannot "
+                "carry; use 'linear' or 'ntk' here")
+        trained = max_position_embeddings or seq_len
+        if seq_len is not None and seq_len > trained:
+            alpha = factor * seq_len / trained - (factor - 1)  # HF formula
+            base = base * alpha ** (head_dim / (head_dim - 2))
+        return base, 1.0
+    raise ValueError(f"unknown rope_scaling type {kind!r}")
+
+
+def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32, position_ids=None,
+                 scaling=None, max_position_embeddings=None,
+                 allow_dynamic=True):
+    """``scaling``: reference rope_scaling dict — see resolve_rope_scaling."""
+    base, pos_div = resolve_rope_scaling(
+        base, head_dim, scaling, seq_len=seq_len,
+        max_position_embeddings=max_position_embeddings,
+        allow_dynamic=allow_dynamic)
     inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     pos = jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None else position_ids
+    if pos_div != 1.0:
+        pos = pos / pos_div
     freqs = jnp.outer(pos, inv_freq)
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 

@@ -428,7 +428,10 @@ def _capture_calib(model, ids):
     cfg = model.cfg
     x = jnp.take(model.model.embed_tokens, ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
-    cos, sin = A.rope_cos_sin(ids.shape[1], d, base=cfg.rope_theta)
+    cos, sin = A.rope_cos_sin(ids.shape[1], d, base=cfg.rope_theta,
+                              scaling=getattr(cfg, "rope_scaling", None),
+                              max_position_embeddings=getattr(
+                                  cfg, "max_position_embeddings", None))
     out = []
     for lyr in model.model.layers:
         att, mlp = lyr.self_attn, lyr.mlp

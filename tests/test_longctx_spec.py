@@ -1,0 +1,164 @@
+"""RoPE scaling (linear/NTK/dynamic), speculative decoding, and paged
+sampling (PaddleNLP llm parity round 2)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.ops.attention import rope_cos_sin
+
+
+def test_rope_scaling_linear_is_position_interpolation():
+    d = 16
+    cos, sin = rope_cos_sin(8, d, scaling={"type": "linear", "factor": 4.0})
+    cos_ref, sin_ref = rope_cos_sin(8, d, position_ids=jnp.arange(8) / 4.0)
+    np.testing.assert_allclose(np.asarray(cos), np.asarray(cos_ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sin), np.asarray(sin_ref), rtol=1e-6)
+
+
+def test_rope_scaling_ntk_raises_base():
+    d = 16
+    cos, _ = rope_cos_sin(8, d, base=10000.0,
+                          scaling={"type": "ntk", "factor": 2.0})
+    cos_ref, _ = rope_cos_sin(8, d, base=10000.0 * 2.0 ** (d / (d - 2)))
+    np.testing.assert_allclose(np.asarray(cos), np.asarray(cos_ref), rtol=1e-6)
+
+
+def test_rope_scaling_dynamic_only_beyond_trained_length():
+    d = 16
+    # within the trained window: identical to unscaled
+    c1, _ = rope_cos_sin(8, d, scaling={"type": "dynamic", "factor": 2.0},
+                         max_position_embeddings=16)
+    c0, _ = rope_cos_sin(8, d)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c0), rtol=1e-6)
+    # beyond it: base grows (frequencies shrink)
+    c2, _ = rope_cos_sin(32, d, scaling={"type": "dynamic", "factor": 2.0},
+                         max_position_embeddings=16)
+    c3, _ = rope_cos_sin(32, d)
+    assert not np.allclose(np.asarray(c2), np.asarray(c3))
+
+
+def test_llama_rope_scaling_consistent_between_forward_and_decode():
+    """Model forward and the KV-cache decode path must rotate identically
+    under rope_scaling (linear)."""
+    from paddle_tpu.models.decoding import generate
+
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64,
+                           rope_scaling={"type": "linear", "factor": 2.0})
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 64, (1, 10)))
+    # teacher-forced check: decode-path logits at the last prompt position
+    # equal the full-forward logits there
+    full = model(ids)
+    from paddle_tpu.models.decoding import KVCache, llama_forward_with_cache
+    cache = KVCache.init(cfg.num_hidden_layers, 1, 16,
+                         cfg.num_key_value_heads,
+                         cfg.hidden_size // cfg.num_attention_heads,
+                         cfg.dtype)
+    dec, _ = llama_forward_with_cache(model, ids, cache, 0)
+    np.testing.assert_allclose(np.asarray(dec[:, -1]),
+                               np.asarray(full[:, -1]),
+                               rtol=2e-4, atol=2e-5)
+    # generation runs end-to-end
+    out = generate(model, ids, max_new_tokens=4)
+    assert out.shape == (1, 14)
+
+
+def _pair(seed_t=0, seed_d=1):
+    cfg = dict(num_hidden_layers=2, hidden_size=32, num_attention_heads=4,
+               num_key_value_heads=2, vocab_size=64)
+    pt.seed(seed_t)
+    target = LlamaForCausalLM(LlamaConfig.tiny(**cfg))
+    pt.seed(seed_d)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(
+        **{**cfg, "num_hidden_layers": 1}))
+    return target, draft
+
+
+def test_speculative_equals_target_greedy():
+    """Output must be EXACTLY the target's own greedy decode, whatever the
+    draft proposes."""
+    from paddle_tpu.models.decoding import generate
+    from paddle_tpu.models.speculative import speculative_generate
+
+    target, draft = _pair()
+    rs = np.random.RandomState(2)
+    ids = jnp.asarray(rs.randint(0, 64, (1, 8)))
+    new = 10
+    ref = generate(target, ids, max_new_tokens=new)
+    got, stats = speculative_generate(target, draft, ids,
+                                      max_new_tokens=new, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert stats["rounds"] >= 1 and 0.0 <= stats["acceptance_rate"] <= 1.0
+
+
+def test_speculative_perfect_draft_accepts_everything():
+    """Draft == target: every proposal accepted, so the target runs
+    ~max_new/(gamma+1) verification forwards instead of max_new."""
+    from paddle_tpu.models.decoding import generate
+    from paddle_tpu.models.speculative import speculative_generate
+
+    cfgkw = dict(num_hidden_layers=2, hidden_size=32, num_attention_heads=4,
+                 num_key_value_heads=2, vocab_size=64)
+    pt.seed(0)
+    target = LlamaForCausalLM(LlamaConfig.tiny(**cfgkw))
+    pt.seed(0)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(**cfgkw))
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(0, 64, (1, 8)))
+    new, gamma = 12, 3
+    ref = generate(target, ids, max_new_tokens=new)
+    got, stats = speculative_generate(target, draft, ids,
+                                      max_new_tokens=new, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert stats["acceptance_rate"] == 1.0
+    assert stats["rounds"] <= -(-new // (gamma + 1)) + 1
+
+
+def test_speculative_eos_matches_generate_exactly():
+    """With an eos token, the output buffer must equal generate()'s —
+    including the zero padding after the first EOS."""
+    from paddle_tpu.models.decoding import generate
+    from paddle_tpu.models.speculative import speculative_generate
+
+    target, draft = _pair()
+    rs = np.random.RandomState(5)
+    ids = jnp.asarray(rs.randint(0, 64, (1, 8)))
+    new = 10
+    ref_plain = generate(target, ids, max_new_tokens=new)
+    # pick a token the target actually emits early as "EOS"
+    eos = int(np.asarray(ref_plain)[0, 8 + 1])
+    ref = generate(target, ids, max_new_tokens=new, eos_token_id=eos)
+    got, _ = speculative_generate(target, draft, ids, max_new_tokens=new,
+                                  gamma=3, eos_token_id=eos)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_paged_generate_sampling_reproducible():
+    from paddle_tpu.models.paged import paged_generate
+
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(4)
+    b, s, new = 2, 8, 6
+    ids = jnp.asarray(rs.randint(0, 64, (b, s)))
+    kw = dict(max_new_tokens=new, block_size=4, temperature=0.8, top_k=8,
+              top_p=0.9)
+    out1, _ = paged_generate(model, ids, np.full((b,), s),
+                             rng=jax.random.PRNGKey(7), **kw)
+    out2, _ = paged_generate(model, ids, np.full((b,), s),
+                             rng=jax.random.PRNGKey(7), **kw)
+    out3, _ = paged_generate(model, ids, np.full((b,), s),
+                             rng=jax.random.PRNGKey(8), **kw)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert not np.array_equal(np.asarray(out1), np.asarray(out3))
+    assert np.asarray(out1).max() < 64 and np.asarray(out1).min() >= 0
