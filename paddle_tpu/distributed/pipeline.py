@@ -22,6 +22,17 @@ Two schedules live here:
   (activation-checkpoint style, like the reference's recompute+1F1B mode)
   and accumulates param grads in fp32. Steady-state bubble fraction is
   ``2(pp-1)/(M + 2(pp-1))`` and vanishes as M grows.
+
+Interleaved virtual stages (Megatron's V>1 chunks per device) are
+DELIBERATELY not implemented: in this SPMD lockstep-tick formulation every
+device executes every tick's full chunk workload with masking, so
+interleaving INCREASES total tick cost — the fill/drain ticks still cost a
+full V-chunk step while covering 1/V the work, making the bubble
+``2(V*pp-1)`` chunk-slots ≈ strictly worse than the non-interleaved
+``2(pp-1)`` full-slots. The interleave only pays off with per-device
+dynamic schedules (real divergent control flow between collectives), which
+SPMD-with-collectives cannot express safely. Megatron wins that trade
+because its per-rank imperative scheduler skips idle slots entirely.
 """
 from __future__ import annotations
 
@@ -106,24 +117,30 @@ def _f32_zeros_like(tree):
         lambda p: jnp.zeros(p.shape, jnp.float32), tree)
 
 
-def _pvary(tree, axis_name: str):
-    """Mark every leaf as varying over `axis_name` (no-op on old jax).
+def _pvary(tree, axes):
+    """Mark every leaf as varying over ``axes`` (str or tuple; idempotent).
 
-    Needed for replicated params differentiated inside shard_map: AD
-    transposes the unvarying→varying broadcast into an implicit psum, which
-    would sum per-stage cotangents (including masked-garbage stages) before
-    our own masking — marking the primal varying keeps grads per-stage.
+    Needed for params differentiated inside shard_map: AD transposes an
+    unvarying→varying broadcast into an implicit psum over that axis, which
+    would (a) sum per-stage cotangents before our masking and (b) double-
+    count against the schedule's explicit dp reductions — marking the
+    primals varying keeps every cross-device reduction explicit.
     """
+    if isinstance(axes, str):
+        axes = (axes,)
+
     def mark(v):
-        try:
-            return lax.pcast(v, axis_name, to="varying")
-        except ValueError:
-            return v  # already varying over axis_name — idempotent no-op
-        except (AttributeError, TypeError):
+        for ax in axes:
             try:
-                return lax.pvary(v, (axis_name,))
-            except Exception:
-                return v
+                v = lax.pcast(v, ax, to="varying")
+            except ValueError:
+                continue  # already varying over ax — idempotent no-op
+            except (AttributeError, TypeError):
+                try:
+                    v = lax.pvary(v, (ax,))
+                except Exception:
+                    pass
+        return v
     return jax.tree_util.tree_map(mark, tree)
 
 
@@ -133,7 +150,7 @@ def _masked_add(acc, upd, valid):
 
 
 def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
-                        axis_name: str = "pp",
+                        axis_name: str = "pp", batch_axes=(),
                         embed_params=None, embed_fn: Callable = None,
                         head_params=None, head_loss_fn: Callable = None):
     """TRUE 1F1B pipeline training step. Call inside ``shard_map``.
@@ -173,6 +190,7 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
     M = x_mb.shape[0]
     R = 2 * pp - 1                      # residual ring slots, M-independent
     T = M + 2 * (pp - 1)                # global ticks
+    batch_axes = tuple(batch_axes)
 
     has_head = head_loss_fn is not None
     has_embed = embed_fn is not None
@@ -182,9 +200,12 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
     if not has_embed:
         embed_params = ()
         embed_fn = lambda ep, x: x
-    # replicated params must be stage-varying before AD (see _pvary)
-    head_params = _pvary(head_params, axis_name)
-    embed_params = _pvary(embed_params, axis_name)
+    # params must be varying over every schedule axis before AD (see
+    # _pvary); stage_params are pp-varying already but dp-unvarying
+    axes_all = (axis_name,) + batch_axes
+    stage_params = _pvary(stage_params, axes_all)
+    head_params = _pvary(head_params, axes_all)
+    embed_params = _pvary(embed_params, axes_all)
 
     # activation shape: embed output of one microbatch
     act = jax.eval_shape(embed_fn, embed_params,
@@ -248,7 +269,7 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
         def head_skip(y, labels):
             return _pvary((jnp.zeros((), jnp.float32), jnp.zeros_like(y),
                            jax.tree_util.tree_map(jnp.zeros_like,
-                                                  head_params)), axis_name)
+                                                  head_params)), axes_all)
 
         loss_m, dy, dhead_m = lax.cond(take_loss, head_branch, head_skip,
                                        y, labels)
@@ -278,7 +299,7 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
 
         def embed_grad_skip(dx):
             return _pvary(jax.tree_util.tree_map(jnp.zeros_like,
-                                                 embed_params), axis_name)
+                                                 embed_params), axes_all)
 
         dembed_m = lax.cond(jnp.logical_and(is_first, bwd_valid),
                             embed_grad_branch, embed_grad_skip, dx)
@@ -291,8 +312,8 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
                     loss=loss, dstage=dstage, dembed=dembed,
                     dhead=dhead), None
 
-    # the loop makes every carry leaf pp-varying; mark the init accordingly
-    carry0 = _pvary(carry0, axis_name)
+    # the loop makes every carry leaf pp(+dp)-varying; mark the init so
+    carry0 = _pvary(carry0, axes_all)
     c, _ = lax.scan(tick, carry0, jnp.arange(T))
 
     inv_m = 1.0 / M
@@ -303,13 +324,25 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
         lambda g: lax.psum(g, axis_name), scale(c["dembed"]))
     dhead = jax.tree_util.tree_map(
         lambda g: lax.psum(g, axis_name), scale(c["dhead"]))
+    if batch_axes:
+        # data parallelism over the microbatch's batch dim: every grad and
+        # the loss are per-dp-shard means — average across the dp group
+        nb = 1
+        for a in batch_axes:
+            nb *= lax.axis_size(a)
+        pmean = lambda v: lax.psum(v, batch_axes) / nb
+        loss = pmean(loss)
+        dstage = jax.tree_util.tree_map(pmean, dstage)
+        dembed = jax.tree_util.tree_map(pmean, dembed)
+        dhead = jax.tree_util.tree_map(pmean, dhead)
     return loss, dstage, dembed, dhead
 
 
 def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
                         layer_call: Callable = None,
                         head_loss_fn: Callable = None, head_params=None,
-                        embed_fn: Callable = None, embed_params=None):
+                        embed_fn: Callable = None, embed_params=None,
+                        batch_axes=()):
     """1F1B loss+grads for a PipelineLayer under ``mesh`` (pp axis).
 
     Splits the batch into ``pipe.num_microbatches``, runs the 1F1B schedule
@@ -317,6 +350,11 @@ def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
     ``(loss, stacked_grads, dembed, dhead)`` — grads are fp32, stacked
     grads sharded P("pp", ...) exactly like the params, embed/head grads
     replicated (``None`` when the corresponding part was not given).
+
+    ``batch_axes`` (e.g. ``("dp",)``) composes pp with data parallelism:
+    each microbatch's batch dim is sharded across the dp group, every dp
+    member runs the same pipeline on its shard, and loss/grads are
+    dp-averaged inside the shard_map.
     """
     from jax import shard_map
 
@@ -333,10 +371,12 @@ def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
     embed_params = embed_params if has_embed else ()
     head_params = head_params if has_head else ()
 
+    batch_axes = tuple(batch_axes)
+    mb_axis = batch_axes if batch_axes else None
     pspec = pipe.stage_specs()
     rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
-    xspec = P(*(None,) * xm.ndim)
-    yspec = P(*(None,) * ym.ndim)
+    xspec = P(None, mb_axis, *(None,) * (xm.ndim - 2))
+    yspec = P(None, mb_axis, *(None,) * (ym.ndim - 2))
 
     def stage_fwd(stage_params, h):
         def body(hh, lyr):
@@ -352,7 +392,7 @@ def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
         out_specs=(P(), pspec, rep(embed_params), rep(head_params)))
     def run(stage_params, xm, ym, embed_params, head_params):
         return pipeline_train_1f1b(
-            stage_params, stage_fwd, xm, ym,
+            stage_params, stage_fwd, xm, ym, batch_axes=batch_axes,
             embed_params=embed_params, embed_fn=embed_fn,
             head_params=head_params, head_loss_fn=head_loss_fn)
 
@@ -382,6 +422,25 @@ class PipelineLayer(Module):
         self.remat = remat
         # leading axis is the stage axis
         flat, _ = jax.tree_util.tree_flatten(self.stacked)
+
+    @classmethod
+    def from_stacked(cls, stacked, *, n_layers: int, num_stages: int,
+                     num_microbatches: int = 1, remat: bool = True):
+        """Build from an ALREADY-STACKED [L, ...] layer pytree (e.g. the
+        canonical param tree of a jitted training loop) with the same
+        invariants as __init__."""
+        assert n_layers % num_stages == 0, \
+            f"n_layers ({n_layers}) must divide num_stages ({num_stages})"
+        self = cls.__new__(cls)
+        Module.__init__(self)
+        self.stacked = stacked
+        self.template = None
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.layers_per_stage = n_layers // num_stages
+        self.n_layers = n_layers
+        self.remat = remat
+        return self
 
     def stage_specs(self):
         """PartitionSpecs: leading (layer) axis on pp."""

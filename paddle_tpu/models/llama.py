@@ -317,7 +317,7 @@ class LlamaForCausalLM(Module):
 
 
 def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
-                              labels, num_microbatches: int):
+                              labels, num_microbatches: int, batch_axes=()):
     """1F1B pipeline-parallel loss + grads for LLaMA over the pp mesh axis.
 
     Decoder layers are the pipeline stages; the embedding runs at stage 0
@@ -331,27 +331,72 @@ def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
     norm_weight, lm_head}`` — ``layers`` stacked [L, ...] and sharded
     P("pp", ...) like the stage params.
     """
+    _check_pp_model(model)
+    from paddle_tpu.distributed.pipeline import stack_layers
+    params = dict(layers=stack_layers(model.model.layers),
+                  embed_tokens=model.model.embed_tokens,
+                  norm_weight=model.model.norm.weight,
+                  lm_head=model.lm_head)
+    return _pp_loss_and_grads(model, mesh, params, input_ids, labels,
+                              num_microbatches, batch_axes)
+
+
+def _check_pp_model(model):
+    assert model.lm_head is not None, \
+        "pipeline head needs untied embeddings (tie_word_embeddings=False)"
+    assert model.model.layers, "pipeline stages need scan_layers=False"
+
+
+def make_llama_pp_train_step(model: "LlamaForCausalLM", mesh, optimizer,
+                             num_microbatches: int, batch_axes=()):
+    """End-to-end 1F1B TRAINING: a jitted ``step(params, opt_state, ids,
+    labels) -> (params, opt_state, loss)`` where params =
+    ``{layers (stacked, P("pp",...)), embed_tokens, norm_weight, lm_head}``
+    and the optimizer consumes the pipeline's grads directly. Composes pp
+    with dp via ``batch_axes`` (each dp member pipelines its batch shard;
+    grads are dp-averaged inside the schedule). params and opt_state are
+    DONATED each step (the reference make_train_step's memory discipline).
+
+    Use ``init_llama_pp_state(model, optimizer)`` for the initial
+    (params, opt_state).
+    """
+    _check_pp_model(model)
+
+    def step(params, opt_state, input_ids, labels):
+        loss, grads = _pp_loss_and_grads(
+            model, mesh, params, input_ids, labels, num_microbatches,
+            batch_axes)
+        new_params, new_opt = optimizer.step(params, grads, opt_state)
+        return new_params, new_opt, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def _pp_loss_and_grads(model, mesh, params, input_ids, labels,
+                       num_microbatches, batch_axes):
+    """The ONE pipeline-LLaMA forward/backward: reads weights from
+    ``params`` ({layers, embed_tokens, norm_weight, lm_head}) so both the
+    module-level wrapper (llama_pipeline_train_step) and the jitted
+    optimizer loop share it."""
     from paddle_tpu.distributed.pipeline import (PipelineLayer,
                                                  pipeline_train_step)
     cfg = model.cfg
-    assert model.lm_head is not None, \
-        "pipeline head needs untied embeddings (tie_word_embeddings=False)"
     mdl = model.model
-    assert mdl.layers, "pipeline stages need scan_layers=False"
-    pipe = PipelineLayer(mdl.layers, num_stages=mesh.pp,
-                         num_microbatches=num_microbatches, remat=cfg.remat)
+    pipe = PipelineLayer.from_stacked(
+        params["layers"], n_layers=len(mdl.layers), num_stages=mesh.pp,
+        num_microbatches=num_microbatches, remat=cfg.remat)
+
     cos, sin = A.rope_cos_sin(input_ids.shape[1],
                               cfg.hidden_size // cfg.num_attention_heads,
                               base=cfg.rope_theta, scaling=cfg.rope_scaling,
                               max_position_embeddings=cfg.max_position_embeddings)
+    eps = cfg.rms_norm_eps
 
     def layer_call(lyr, h):
         return lyr(h, cos, sin, None)
 
     def embed_fn(emb_w, ids):
         return jnp.take(emb_w, ids, axis=0)
-
-    eps = cfg.rms_norm_eps
 
     def head_loss(hp, hidden, lbl):
         norm_w, head_w = hp
@@ -365,10 +410,24 @@ def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
 
     loss, dstage, dembed, dhead = pipeline_train_step(
         pipe, mesh, input_ids, labels, layer_call=layer_call,
-        head_loss_fn=head_loss, head_params=(mdl.norm.weight, model.lm_head),
-        embed_fn=embed_fn, embed_params=mdl.embed_tokens)
-    return loss, dict(layers=dstage, embed_tokens=dembed,
-                      norm_weight=dhead[0], lm_head=dhead[1])
+        head_loss_fn=head_loss,
+        head_params=(params["norm_weight"], params["lm_head"]),
+        embed_fn=embed_fn, embed_params=params["embed_tokens"],
+        batch_axes=batch_axes)
+    grads = dict(layers=dstage, embed_tokens=dembed,
+                 norm_weight=dhead[0], lm_head=dhead[1])
+    return loss, grads
+
+
+def init_llama_pp_state(model: "LlamaForCausalLM", optimizer):
+    """(params, opt_state) for ``make_llama_pp_train_step``."""
+    from paddle_tpu.distributed.pipeline import stack_layers
+    _check_pp_model(model)
+    params = dict(layers=stack_layers(model.model.layers),
+                  embed_tokens=model.model.embed_tokens,
+                  norm_weight=model.model.norm.weight,
+                  lm_head=model.lm_head)
+    return params, optimizer.init(params)
 
 
 def num_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:

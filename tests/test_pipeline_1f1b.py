@@ -116,6 +116,72 @@ def test_1f1b_residual_memory_bounded_by_pp_not_m():
         "found a per-microbatch activation stash — schedule is not 1F1B"
 
 
+def test_1f1b_composes_with_dp():
+    """pp x dp: each dp member pipelines its batch shard; loss+grads equal
+    the single-device run (dp-averaged inside the schedule)."""
+    pp, dp, M = 2, 2, 4
+    blocks, emb_w, head_w, tokens, tlabels = _setup(M=M, mb=4)  # mb div dp
+    pipe = PipelineLayer(blocks, num_stages=pp, num_microbatches=M)
+    ref, refg = jax.value_and_grad(_seq_ref, argnums=(0, 1, 2))(
+        pipe.stacked, emb_w, head_w, tokens, tlabels)
+    mesh = HybridMesh(dp=dp, pp=pp, devices=jax.devices()[:dp * pp])
+    loss, ds, de, dh = pipeline_train_step(
+        pipe, mesh, tokens, tlabels, head_loss_fn=_head_loss,
+        head_params=head_w, embed_fn=_embed, embed_params=emb_w,
+        batch_axes=("dp",))
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    for g, r in zip(jax.tree_util.tree_leaves((ds, de, dh)),
+                    jax.tree_util.tree_leaves(refg)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_1f1b_optimizer_integrated_training_matches_adamw():
+    """make_llama_pp_train_step: the jitted pp(+dp) train loop tracks the
+    non-pipelined AdamW trajectory."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         init_llama_pp_state,
+                                         make_llama_pp_train_step)
+    from paddle_tpu.train import make_train_step
+    from paddle_tpu.train.step import init_state
+
+    pt.seed(0)
+    pp, M, mb, seq = 4, 4, 2, 16
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, hidden_size=32,
+                           num_attention_heads=2, num_key_value_heads=2,
+                           vocab_size=64, tie_word_embeddings=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (M * mb, seq)))
+    labels = jnp.concatenate(
+        [ids[:, 1:], -100 * jnp.ones((M * mb, 1), ids.dtype)], axis=1)
+
+    # capture the pp param tree FIRST: the reference step donates its
+    # state, deleting buffers shared with the module
+    mesh = HybridMesh(pp=pp, devices=jax.devices()[:pp])
+    params, opt_state = init_llama_pp_state(model, opt.AdamW(learning_rate=1e-3))
+    params = jax.tree_util.tree_map(jnp.copy, params)
+
+    # reference: plain AdamW on the whole module
+    optimizer = opt.AdamW(learning_rate=1e-3)
+    ref_state = init_state(model, optimizer)
+    ref_step = make_train_step(lambda m, i, l: m.loss(i, l), optimizer)
+    ref_losses = []
+    for _ in range(3):
+        ref_state, l = ref_step(ref_state, ids, labels)
+        ref_losses.append(float(l))
+    pp_opt = opt.AdamW(learning_rate=1e-3)
+    step = make_llama_pp_train_step(model, mesh, pp_opt,
+                                    num_microbatches=M)
+    pp_losses = []
+    for _ in range(3):
+        params, opt_state, l = step(params, opt_state, ids, labels)
+        pp_losses.append(float(l))
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=1e-3, atol=1e-4)
+    assert pp_losses[-1] < pp_losses[0]
+
+
 def test_1f1b_llama_stages_match_model_loss():
     """Full LLaMA under the pipeline: loss equals model.loss, grads match."""
     from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
