@@ -412,7 +412,8 @@ class PipelineLayer(Module):
     def __init__(self, layers: list[Module], num_stages: int,
                  num_microbatches: int = 1, remat: bool = True):
         super().__init__()
-        assert len(layers) % num_stages == 0, "layers must divide stages"
+        assert len(layers) % num_stages == 0, \
+            f"num_stages ({num_stages}) must divide len(layers) ({len(layers)})"
         self.stacked = stack_layers(layers)
         self.template = layers[0]
         self.num_stages = num_stages
@@ -430,7 +431,7 @@ class PipelineLayer(Module):
         canonical param tree of a jitted training loop) with the same
         invariants as __init__."""
         assert n_layers % num_stages == 0, \
-            f"n_layers ({n_layers}) must divide num_stages ({num_stages})"
+            f"num_stages ({num_stages}) must divide n_layers ({n_layers})"
         self = cls.__new__(cls)
         Module.__init__(self)
         self.stacked = stacked

@@ -332,19 +332,31 @@ def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
     P("pp", ...) like the stage params.
     """
     _check_pp_model(model)
-    from paddle_tpu.distributed.pipeline import stack_layers
-    params = dict(layers=stack_layers(model.model.layers),
-                  embed_tokens=model.model.embed_tokens,
-                  norm_weight=model.model.norm.weight,
-                  lm_head=model.lm_head)
-    return _pp_loss_and_grads(model, mesh, params, input_ids, labels,
-                              num_microbatches, batch_axes)
+    params = _pp_params(model, copy=False)
+    return _pp_loss_and_grads(model.cfg, len(model.model.layers), mesh,
+                              params, input_ids, labels, num_microbatches,
+                              batch_axes)
 
 
 def _check_pp_model(model):
     assert model.lm_head is not None, \
         "pipeline head needs untied embeddings (tie_word_embeddings=False)"
     assert model.model.layers, "pipeline stages need scan_layers=False"
+
+
+def _pp_params(model, copy: bool):
+    """The canonical pp param tree. ``copy=True`` makes every leaf a fresh
+    buffer so a DONATING train loop can never delete the module's own
+    weights out from under later eval/checkpoint use."""
+    from paddle_tpu.distributed.pipeline import stack_layers
+    params = dict(layers=stack_layers(model.model.layers),  # stack = copy
+                  embed_tokens=model.model.embed_tokens,
+                  norm_weight=model.model.norm.weight,
+                  lm_head=model.lm_head)
+    if copy:
+        params = {k: jax.tree_util.tree_map(jnp.copy, v) if k != "layers"
+                  else v for k, v in params.items()}
+    return params
 
 
 def make_llama_pp_train_step(model: "LlamaForCausalLM", mesh, optimizer,
@@ -361,18 +373,21 @@ def make_llama_pp_train_step(model: "LlamaForCausalLM", mesh, optimizer,
     (params, opt_state).
     """
     _check_pp_model(model)
+    # capture only scalars — holding the module would pin a duplicate set
+    # of unstacked weights for the loop's lifetime
+    cfg, n_layers = model.cfg, len(model.model.layers)
 
     def step(params, opt_state, input_ids, labels):
         loss, grads = _pp_loss_and_grads(
-            model, mesh, params, input_ids, labels, num_microbatches,
-            batch_axes)
+            cfg, n_layers, mesh, params, input_ids, labels,
+            num_microbatches, batch_axes)
         new_params, new_opt = optimizer.step(params, grads, opt_state)
         return new_params, new_opt, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def _pp_loss_and_grads(model, mesh, params, input_ids, labels,
+def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
                        num_microbatches, batch_axes):
     """The ONE pipeline-LLaMA forward/backward: reads weights from
     ``params`` ({layers, embed_tokens, norm_weight, lm_head}) so both the
@@ -380,10 +395,8 @@ def _pp_loss_and_grads(model, mesh, params, input_ids, labels,
     optimizer loop share it."""
     from paddle_tpu.distributed.pipeline import (PipelineLayer,
                                                  pipeline_train_step)
-    cfg = model.cfg
-    mdl = model.model
     pipe = PipelineLayer.from_stacked(
-        params["layers"], n_layers=len(mdl.layers), num_stages=mesh.pp,
+        params["layers"], n_layers=n_layers, num_stages=mesh.pp,
         num_microbatches=num_microbatches, remat=cfg.remat)
 
     cos, sin = A.rope_cos_sin(input_ids.shape[1],
@@ -420,13 +433,11 @@ def _pp_loss_and_grads(model, mesh, params, input_ids, labels,
 
 
 def init_llama_pp_state(model: "LlamaForCausalLM", optimizer):
-    """(params, opt_state) for ``make_llama_pp_train_step``."""
-    from paddle_tpu.distributed.pipeline import stack_layers
+    """(params, opt_state) for ``make_llama_pp_train_step``. Every leaf is
+    a FRESH buffer (the train step donates its params, and donated aliases
+    of module weights would delete them for later eval/checkpointing)."""
     _check_pp_model(model)
-    params = dict(layers=stack_layers(model.model.layers),
-                  embed_tokens=model.model.embed_tokens,
-                  norm_weight=model.model.norm.weight,
-                  lm_head=model.lm_head)
+    params = _pp_params(model, copy=True)
     return params, optimizer.init(params)
 
 

