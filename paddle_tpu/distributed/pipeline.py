@@ -200,8 +200,14 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
     if not has_embed:
         embed_params = ()
         embed_fn = lambda ep, x: x
-    # params must be varying over every schedule axis before AD (see
-    # _pvary); stage_params are pp-varying already but dp-unvarying
+    # params must be varying over the pp and dp schedule axes before AD
+    # (see _pvary). NOTE deliberately NOT over a tp axis: tp-sharded stage
+    # leaves arrive varying from their in_specs, while tp-REPLICATED leaves
+    # (norms) and the embed/head params stay unvarying — jax's vma-aware AD
+    # then auto-psums their cross-member partial grads into the TRUE grad,
+    # and activations/cotangents stay tp-invariant so no spurious psum
+    # transposes are inserted (a varying-marked cotangent crossing the tp
+    # psum transposes would double the grads).
     axes_all = (axis_name,) + batch_axes
     stage_params = _pvary(stage_params, axes_all)
     head_params = _pvary(head_params, axes_all)
@@ -229,7 +235,10 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
         bwd_ring=jnp.zeros(act_shape, act_dtype),
         resid=jnp.zeros((R,) + act_shape, act_dtype),
         loss=jnp.zeros((), jnp.float32),
-        dstage=_f32_zeros_like(stage_params),
+        # (p * 0) keeps each leaf's varying axes (tp-sharded leaves carry
+        # tp-varying grads; fresh zeros would be unvarying and mismatch)
+        dstage=jax.tree_util.tree_map(
+            lambda p_: (p_ * 0).astype(jnp.float32), stage_params),
         dembed=_f32_zeros_like(embed_params),
         dhead=_f32_zeros_like(head_params),
     )
@@ -342,7 +351,7 @@ def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
                         layer_call: Callable = None,
                         head_loss_fn: Callable = None, head_params=None,
                         embed_fn: Callable = None, embed_params=None,
-                        batch_axes=()):
+                        batch_axes=(), stage_specs=None):
     """1F1B loss+grads for a PipelineLayer under ``mesh`` (pp axis).
 
     Splits the batch into ``pipe.num_microbatches``, runs the 1F1B schedule
@@ -373,7 +382,8 @@ def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
 
     batch_axes = tuple(batch_axes)
     mb_axis = batch_axes if batch_axes else None
-    pspec = pipe.stage_specs()
+    # stage_specs override: tp-aware per-leaf specs (e.g. llama_tp_stage_specs)
+    pspec = stage_specs if stage_specs is not None else pipe.stage_specs()
     rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
     xspec = P(None, mb_axis, *(None,) * (xm.ndim - 2))
     yspec = P(None, mb_axis, *(None,) * (ym.ndim - 2))

@@ -329,10 +329,15 @@ def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
 
     Returns ``(loss, grads)`` with ``grads = {layers, embed_tokens,
     norm_weight, lm_head}`` — ``layers`` stacked [L, ...] and sharded
-    P("pp", ...) like the stage params.
+    P("pp", ...) like the stage params. NOTE on a tp>1 mesh the layer
+    grads come back in the tp-INTERLEAVED column layout (matching the
+    weights the schedule trained on); convert to the canonical layout with
+    ``tp_shuffle_llama_params(grads, cfg, tp, inverse=True)``.
     """
     _check_pp_model(model)
     params = _pp_params(model, copy=False)
+    if hasattr(mesh, "size") and mesh.size("tp") > 1:
+        params = tp_shuffle_llama_params(params, model.cfg, mesh.size("tp"))
     return _pp_loss_and_grads(model.cfg, len(model.model.layers), mesh,
                               params, input_ids, labels, num_microbatches,
                               batch_axes)
@@ -356,7 +361,7 @@ def _pp_params(model, copy: bool):
     if copy:
         params = {k: jax.tree_util.tree_map(jnp.copy, v) if k != "layers"
                   else v for k, v in params.items()}
-    return params
+    return PpParams.make(params, 1)
 
 
 def make_llama_pp_train_step(model: "LlamaForCausalLM", mesh, optimizer,
@@ -405,8 +410,41 @@ def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
                               max_position_embeddings=cfg.max_position_embeddings)
     eps = cfg.rms_norm_eps
 
-    def layer_call(lyr, h):
-        return lyr(h, cos, sin, None)
+    tp = mesh.size("tp") if hasattr(mesh, "size") else 1
+    stage_specs = None
+    if tp > 1:
+        # manual tensor parallelism inside the pipeline: weights must be in
+        # the tp-interleaved layout (tp_shuffle_llama_params) so each shard
+        # holds matched q/k/v (gate/up) slices
+        assert (cfg.num_attention_heads % tp == 0
+                and cfg.num_key_value_heads % tp == 0
+                and cfg.intermediate_size % tp == 0), \
+            f"tp={tp} must divide heads/kv-heads/intermediate"
+        layout = getattr(params, "tp_layout", None)
+        if layout != tp:
+            raise ValueError(
+                f"params are in tp_layout={layout!r} but the mesh has "
+                f"tp={tp}; build them with init_llama_pp_state(model, opt, "
+                "mesh) / tp_shuffle_llama_params so the fused projections "
+                "are interleaved for this tp degree (wrong-layout weights "
+                "would silently split the wrong q/k/v columns)")
+        if cfg.fp8:
+            raise NotImplementedError(
+                "the manual-tp pipeline layer bypasses fp8_matmul; train "
+                "fp8 with tp=1 pipelines (or GSPMD tp) for now")
+        from paddle_tpu.quantization import QuantizedWeight
+        if any(isinstance(l, QuantizedWeight)
+               for l in jax.tree_util.tree_leaves(
+                   params["layers"], is_leaf=lambda x: isinstance(
+                       x, QuantizedWeight))):
+            raise NotImplementedError(
+                "weight-only quantized layers are inference-path only; the "
+                "manual-tp pipeline trains full-precision weights")
+        layer_call = make_tp_layer_call(cos, sin)
+        stage_specs = llama_tp_stage_specs(params["layers"])
+    else:
+        def layer_call(lyr, h):
+            return lyr(h, cos, sin, None)
 
     def embed_fn(emb_w, ids):
         return jnp.take(emb_w, ids, axis=0)
@@ -426,18 +464,165 @@ def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
         head_loss_fn=head_loss,
         head_params=(params["norm_weight"], params["lm_head"]),
         embed_fn=embed_fn, embed_params=params["embed_tokens"],
-        batch_axes=batch_axes)
-    grads = dict(layers=dstage, embed_tokens=dembed,
-                 norm_weight=dhead[0], lm_head=dhead[1])
+        batch_axes=batch_axes, stage_specs=stage_specs)
+    grads = PpParams.make(
+        dict(layers=dstage, embed_tokens=dembed,
+             norm_weight=dhead[0], lm_head=dhead[1]),
+        getattr(params, "tp_layout", 1))
     return loss, grads
 
 
-def init_llama_pp_state(model: "LlamaForCausalLM", optimizer):
+class PpParams(dict):
+    """The canonical pp param tree with a STATIC layout tag: ``tp_layout``
+    records which tp degree the fused projections are interleaved for
+    (1 = canonical [Q|K|V]/[gate|up] order). The tag rides the pytree aux
+    data, so it survives jit/donation/optimizer tree_maps — and the tp
+    pipeline path can refuse weights in the wrong layout instead of
+    silently splitting wrong columns."""
+
+    tp_layout: int = 1
+
+    @staticmethod
+    def make(d: dict, tp_layout: int = 1) -> "PpParams":
+        p = PpParams(d)
+        p.tp_layout = tp_layout
+        return p
+
+
+jax.tree_util.register_pytree_with_keys(
+    PpParams,
+    lambda p: ([(jax.tree_util.DictKey(k), p[k]) for k in sorted(p)],
+               (tuple(sorted(p)), p.tp_layout)),
+    lambda aux, vals: PpParams.make(dict(zip(aux[0], vals)), aux[1]),
+)
+
+
+def _tp_interleave_perm(n_blocks_per_group: list[int], block: int, tp: int):
+    """Column permutation turning globally-grouped fused projections (e.g.
+    [Q|K|V] or [gate|up]) into per-tp-shard groups ([q0|k0|v0 | q1|k1|v1]).
+
+    Contiguous tp column-sharding of a fused projection would otherwise
+    hand shard 0 only Q (or only gate) columns — the standard Megatron
+    trick is to pre-permute so every shard holds matched slices.
+    ``n_blocks_per_group``: #blocks (of ``block`` columns) per fused group;
+    each group's blocks are dealt round-robin-contiguously to shards."""
+    import numpy as np
+    offs = np.cumsum([0] + [n * block for n in n_blocks_per_group])
+    perm = []
+    for i in range(tp):
+        for g, n in enumerate(n_blocks_per_group):
+            per = n // tp
+            start = offs[g] + i * per * block
+            perm.extend(range(start, start + per * block))
+    return np.asarray(perm)
+
+
+def tp_shuffle_llama_params(params: dict, cfg: LlamaConfig, tp: int,
+                            inverse: bool = False):
+    """(Un)permute the stacked layer params for manual-tp pipeline use:
+    qkv_proj / qkv_bias columns to per-shard [q_i|k_i|v_i], gate_up_proj
+    columns to per-shard [g_i|u_i]. o_proj/down_proj need no permutation
+    (their row order already matches the per-shard slices)."""
+    import numpy as np
+    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.hidden_size // cfg.num_attention_heads)
+    m = cfg.intermediate_size
+    qkv_perm = _tp_interleave_perm([nh, nkv, nkv], hd, tp)
+    gu_perm = _tp_interleave_perm([m, m], 1, tp)
+    if inverse:
+        qkv_perm = np.argsort(qkv_perm)
+        gu_perm = np.argsort(gu_perm)
+    layers = params["layers"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(layers)
+    out = []
+    for path, leaf in flat:
+        from paddle_tpu.core.module import _path_to_str
+        ps = _path_to_str(path)
+        if leaf is None:
+            out.append(leaf)
+        elif ps.endswith("qkv_proj") or ps.endswith("qkv_bias"):
+            out.append(leaf[..., qkv_perm])
+        elif ps.endswith("gate_up_proj"):
+            out.append(leaf[..., gu_perm])
+        else:
+            out.append(leaf)
+    new = {**params, "layers": jax.tree_util.tree_unflatten(treedef, out)}
+    return PpParams.make(new, 1 if inverse else tp)
+
+
+def make_tp_layer_call(cos, sin, tp_axis: str = "tp"):
+    """Decoder-layer call for MANUAL tensor parallelism inside shard_map:
+    local q/k/v head slices attend locally; the row-parallel o_proj and
+    down_proj partial products are psum'd over the tp axis. Expects weights
+    permuted by ``tp_shuffle_llama_params``."""
+    from jax import lax as _lax
+
+    def call(lyr, h):
+        att, mlp = lyr.self_attn, lyr.mlp
+        tp = _lax.axis_size(tp_axis)
+        hd = att.head_dim
+        nh_l = att.num_heads // tp
+        nkv_l = att.num_kv_heads // tp
+
+        x = h
+        hn = lyr.input_layernorm(x)
+        qkv = hn @ att.qkv_proj                      # local columns
+        if att.qkv_bias is not None:
+            qkv = qkv + att.qkv_bias
+        b, s, _ = hn.shape
+        q, k, v = jnp.split(qkv, [nh_l * hd, (nh_l + nkv_l) * hd], axis=-1)
+        q = A.apply_rope(q.reshape(b, s, nh_l, hd), cos, sin)
+        k = A.apply_rope(k.reshape(b, s, nkv_l, hd), cos, sin)
+        v = v.reshape(b, s, nkv_l, hd)
+        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             window=att.window)
+        partial_o = ctx.reshape(b, s, nh_l * hd) @ att.o_proj
+        x = x + _lax.psum(partial_o, tp_axis)        # row-parallel reduce
+
+        hn2 = lyr.post_attention_layernorm(x)
+        gu = hn2 @ mlp.gate_up_proj                  # local [g_i|u_i]
+        gate, up = jnp.split(gu, 2, axis=-1)
+        partial_d = (jax.nn.silu(gate) * up) @ mlp.down_proj
+        return x + _lax.psum(partial_d, tp_axis)
+    return call
+
+
+def llama_tp_stage_specs(stacked, tp_axis: str = "tp"):
+    """Per-leaf specs for the STACKED [L, ...] layer tree:
+    P("pp", *tp_spec) — fused projections column-sharded, o/down
+    row-sharded over tp, everything else replicated over tp."""
+    from paddle_tpu.core.module import _path_to_str
+    flat, treedef = jax.tree_util.tree_flatten_with_path(stacked)
+    specs = []
+    for path, leaf in flat:
+        if leaf is None or not hasattr(leaf, "ndim"):
+            specs.append(None)
+            continue
+        ps = _path_to_str(path)
+        if ps.endswith(("qkv_proj", "gate_up_proj")):
+            dims = (None, tp_axis)
+        elif ps.endswith("qkv_bias"):
+            dims = (tp_axis,)
+        elif ps.endswith(("o_proj", "down_proj")):
+            dims = (tp_axis, None)
+        else:
+            dims = (None,) * (leaf.ndim - 1)  # minus the stacked L dim
+        specs.append(P("pp", *dims))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def init_llama_pp_state(model: "LlamaForCausalLM", optimizer, mesh=None):
     """(params, opt_state) for ``make_llama_pp_train_step``. Every leaf is
     a FRESH buffer (the train step donates its params, and donated aliases
-    of module weights would delete them for later eval/checkpointing)."""
+    of module weights would delete them for later eval/checkpointing).
+
+    With a mesh whose tp > 1 the stacked layer weights are converted to the
+    tp-interleaved layout (training then stays in that layout; convert back
+    for export with ``tp_shuffle_llama_params(..., inverse=True)``)."""
     _check_pp_model(model)
     params = _pp_params(model, copy=True)
+    if mesh is not None and mesh.size("tp") > 1:
+        params = tp_shuffle_llama_params(params, model.cfg, mesh.size("tp"))
     return params, optimizer.init(params)
 
 

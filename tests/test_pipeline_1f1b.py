@@ -181,6 +181,62 @@ def test_1f1b_optimizer_integrated_training_matches_adamw():
     assert pp_losses[-1] < pp_losses[0]
 
 
+@pytest.mark.parametrize("dp", [1, 2])
+def test_1f1b_composes_with_tp(dp):
+    """Full hybrid: tensor parallelism INSIDE the 1F1B pipeline (pp x tp,
+    and pp x tp x dp): Megatron-interleaved fused projections, explicit
+    row-parallel psums, loss+grads == single device."""
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_pipeline_train_step,
+                                         tp_shuffle_llama_params)
+
+    pt.seed(0)
+    pp, tp, M, mb, seq = 2, 2, 2, 2 * dp, 16
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64, tie_word_embeddings=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (M * mb, seq)))
+    labels = jnp.concatenate(
+        [ids[:, 1:], -100 * jnp.ones((M * mb, 1), ids.dtype)], axis=1)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda m: m.loss(ids, labels))(model)
+
+    mesh = HybridMesh(dp=dp, pp=pp, tp=tp,
+                      devices=jax.devices()[:dp * pp * tp])
+    loss, grads = llama_pipeline_train_step(
+        model, mesh, ids, labels, num_microbatches=M,
+        batch_axes=("dp",) if dp > 1 else ())
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+
+    # grads come back in the tp-interleaved layout — invert it
+    grads = tp_shuffle_llama_params(grads, cfg, tp, inverse=True)
+    from paddle_tpu.distributed.pipeline import stack_layers
+    ref_stacked = stack_layers(ref_grads.model.layers)
+    for g, r in zip(jax.tree_util.tree_leaves(grads["layers"]),
+                    jax.tree_util.tree_leaves(ref_stacked)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-3, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(grads["embed_tokens"]),
+                               np.asarray(ref_grads.model.embed_tokens),
+                               rtol=1e-3, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(grads["lm_head"]),
+                               np.asarray(ref_grads.lm_head),
+                               rtol=1e-3, atol=2e-5)
+    # final-norm grad exercises the auto-psum-of-replicated-partials path
+    np.testing.assert_allclose(np.asarray(grads["norm_weight"]),
+                               np.asarray(ref_grads.model.norm.weight),
+                               rtol=1e-3, atol=2e-5)
+    # wrong-layout params must be REJECTED, not silently mis-split
+    from paddle_tpu.models.llama import _pp_params, _pp_loss_and_grads
+    bad = _pp_params(model, copy=False)  # canonical layout, tp_layout=1
+    with pytest.raises(ValueError):
+        _pp_loss_and_grads(cfg, 2, mesh, bad, ids, labels, M,
+                           ("dp",) if dp > 1 else ())
+
+
 def test_1f1b_llama_stages_match_model_loss():
     """Full LLaMA under the pipeline: loss equals model.loss, grads match."""
     from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
