@@ -443,6 +443,14 @@ def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
         layer_call = make_tp_layer_call(cos, sin)
         stage_specs = llama_tp_stage_specs(params["layers"])
     else:
+        layout = getattr(params, "tp_layout", 1)
+        if layout not in (None, 1):
+            raise ValueError(
+                f"params are tp-interleaved for tp={layout} but the mesh "
+                "has tp=1; convert back with tp_shuffle_llama_params(..., "
+                "inverse=True) first (the plain layer path would split the "
+                "wrong q/k/v columns)")
+
         def layer_call(lyr, h):
             return lyr(h, cos, sin, None)
 
@@ -524,6 +532,14 @@ def tp_shuffle_llama_params(params: dict, cfg: LlamaConfig, tp: int,
     columns to per-shard [g_i|u_i]. o_proj/down_proj need no permutation
     (their row order already matches the per-shard slices)."""
     import numpy as np
+    cur = getattr(params, "tp_layout", 1) or 1
+    want_cur = tp if inverse else 1
+    if cur != want_cur:
+        raise ValueError(
+            f"tp_shuffle_llama_params: params are in tp_layout={cur}, "
+            f"expected {want_cur} for {'inverse ' if inverse else ''}"
+            f"shuffle to tp={tp} — double-(un)shuffling would scramble "
+            "the fused projection columns")
     nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.hidden_size // cfg.num_attention_heads)
     m = cfg.intermediate_size

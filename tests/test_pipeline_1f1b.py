@@ -237,6 +237,74 @@ def test_1f1b_composes_with_tp(dp):
                            ("dp",) if dp > 1 else ())
 
 
+def test_1f1b_tp_jitted_optimizer_loop_with_qkv_bias():
+    """The machinery PpParams exists for: the layout tag must survive jit
+    tracing, donation, and optimizer tree_maps in the tp>1 TRAINING loop —
+    with attention_bias=True so the qkv_bias permutation/spec/local-add
+    path runs too. Trajectory matches plain AdamW."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         init_llama_pp_state,
+                                         make_llama_pp_train_step)
+    from paddle_tpu.train import make_train_step
+    from paddle_tpu.train.step import init_state
+
+    pt.seed(0)
+    pp, tp, M, mb, seq = 2, 2, 2, 2, 16
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64, tie_word_embeddings=False,
+                           attention_bias=True)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(1)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (M * mb, seq)))
+    labels = jnp.concatenate(
+        [ids[:, 1:], -100 * jnp.ones((M * mb, 1), ids.dtype)], axis=1)
+
+    mesh = HybridMesh(pp=pp, tp=tp, devices=jax.devices()[:pp * tp])
+    params, opt_state = init_llama_pp_state(
+        model, opt.AdamW(learning_rate=1e-3), mesh=mesh)
+
+    optimizer = opt.AdamW(learning_rate=1e-3)
+    ref_state = init_state(model, optimizer)
+    ref_step = make_train_step(lambda m, i, l: m.loss(i, l), optimizer)
+    ref_losses = []
+    for _ in range(3):
+        ref_state, l = ref_step(ref_state, ids, labels)
+        ref_losses.append(float(l))
+
+    step = make_llama_pp_train_step(model, mesh, opt.AdamW(learning_rate=1e-3),
+                                    num_microbatches=M)
+    pp_losses = []
+    for _ in range(3):
+        params, opt_state, l = step(params, opt_state, ids, labels)
+        pp_losses.append(float(l))
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=1e-3, atol=1e-4)
+
+
+def test_tp_shuffle_layout_guards():
+    """Double-shuffling or wrong-direction unshuffling must raise."""
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         _pp_params, tp_shuffle_llama_params)
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64, tie_word_embeddings=False)
+    model = LlamaForCausalLM(cfg)
+    canonical = _pp_params(model, copy=False)
+    shuffled = tp_shuffle_llama_params(canonical, cfg, 2)
+    assert shuffled.tp_layout == 2
+    with pytest.raises(ValueError):
+        tp_shuffle_llama_params(shuffled, cfg, 2)          # double shuffle
+    with pytest.raises(ValueError):
+        tp_shuffle_llama_params(canonical, cfg, 2, inverse=True)
+    back = tp_shuffle_llama_params(shuffled, cfg, 2, inverse=True)
+    assert back.tp_layout == 1
+    for a, b in zip(jax.tree_util.tree_leaves(back["layers"]),
+                    jax.tree_util.tree_leaves(canonical["layers"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_1f1b_llama_stages_match_model_loss():
     """Full LLaMA under the pipeline: loss equals model.loss, grads match."""
     from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
