@@ -1,0 +1,11 @@
+#!/bin/bash
+# Probes the axon TPU tunnel every 10 min; logs to .tpu_probe.log
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 90 python -c "import jax; ds=jax.devices(); print(ds[0].platform, len(ds))" 2>&1 | tail -1)
+  echo "$ts $out" >> /root/repo/.tpu_probe.log
+  if echo "$out" | grep -qiE '^(tpu|axon)'; then
+    echo "$ts TUNNEL_UP" >> /root/repo/.tpu_probe.log
+  fi
+  sleep 600
+done

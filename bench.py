@@ -35,7 +35,10 @@ PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
 WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT", "1800"))
 
 CLEAN_ENV = {
-    "PATH": "/opt/venv/bin:/usr/bin:/bin",
+    # lead with this interpreter's bin dir so the clean-env fallback works
+    # on any venv layout, not just /opt/venv
+    "PATH": os.pathsep.join([os.path.dirname(os.path.abspath(sys.executable)),
+                             "/usr/bin", "/bin"]),
     "HOME": os.environ.get("HOME", "/root"),
     "JAX_PLATFORMS": "cpu",
 }

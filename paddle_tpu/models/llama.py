@@ -412,6 +412,17 @@ def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
 
     tp = mesh.size("tp") if hasattr(mesh, "size") else 1
     stage_specs = None
+    if cfg.fp8:
+        # fp8 amax-history leaves would travel through the schedule's
+        # masked-sum dstage accumulator and come out scaled by 1/M (and
+        # dp-meaned) — the optimizer's overwrite-with-gradient splice would
+        # then install a mean of rolled histories instead of the step amax,
+        # under-estimating amax and over-scaling into e4m3 clipping
+        raise NotImplementedError(
+            "fp8 delayed scaling is not supported inside the 1F1B pipeline "
+            "(amax histories need max/last-write combining across "
+            "microbatches, not the schedule's mean); train fp8 with GSPMD "
+            "dp/tp/fsdp instead")
     if tp > 1:
         # manual tensor parallelism inside the pipeline: weights must be in
         # the tp-interleaved layout (tp_shuffle_llama_params) so each shard
@@ -428,10 +439,6 @@ def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
                 "mesh) / tp_shuffle_llama_params so the fused projections "
                 "are interleaved for this tp degree (wrong-layout weights "
                 "would silently split the wrong q/k/v columns)")
-        if cfg.fp8:
-            raise NotImplementedError(
-                "the manual-tp pipeline layer bypasses fp8_matmul; train "
-                "fp8 with tp=1 pipelines (or GSPMD tp) for now")
         from paddle_tpu.quantization import QuantizedWeight
         if any(isinstance(l, QuantizedWeight)
                for l in jax.tree_util.tree_leaves(

@@ -446,8 +446,11 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     ``kv_lens``: [B] int32 valid key lengths — the padded-varlen path (ref
     ``flash_attn_varlen`` capability): keys >= the row's length are masked
     in-kernel and fully-padded key blocks are skipped, with no O(S^2) mask
-    tensor. Queries in the padding produce zero output rows and zero grads
-    (callers mask the loss)."""
+    tensor. NOTE query rows in the padding are NOT masked q-side: under
+    causal+kv_lens a padded query row still attends every key < its row's
+    klen, so its output is unspecified garbage — callers MUST mask those
+    rows out of the loss (zero upstream cotangent), which is also what
+    makes their grads exactly zero."""
     b, s, h, d = q.shape
     sk = k.shape[1]
     h_kv = k.shape[2]

@@ -297,7 +297,7 @@ def weight_quantize(w, algo: str = "weight_only_int8"):
     scale = jnp.maximum(jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0,
                                 keepdims=True), 1e-8) / qmax
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
-                 -qmax - 1, qmax).astype(jnp.int8)
+                 -qmax, qmax).astype(jnp.int8)
     return QuantizedWeight(_pack(q, bits), scale, bits, k)
 
 
@@ -359,7 +359,7 @@ def gptq_quantize(w, calib_x, bits: int = 4, percdamp: float = 0.01):
     Q = np.zeros_like(W)
     for j in range(k):
         wc = W[:, j]
-        qc = np.clip(np.round(wc / scale[:, 0]), -qmax - 1, qmax)
+        qc = np.clip(np.round(wc / scale[:, 0]), -qmax, qmax)
         Q[:, j] = qc
         err = (wc - qc * scale[:, 0]) / U[j, j]
         if j + 1 < k:
