@@ -8,6 +8,8 @@ reference's cost-model planner is XLA's sharding propagation pass.
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -44,13 +46,22 @@ class Replicate(Placement):
 
 
 class Partial(Placement):
-    """Pending-reduction placement; materialised by the next collective."""
+    """Pending-reduction placement. GSPMD has no top-level representation
+    for "this array holds unreduced partial sums" — partial state only
+    exists INSIDE ``shard_map``, where the program ``lax.psum``s it
+    explicitly. ``shard_tensor``/``reshard`` therefore treat Partial as
+    Replicate and warn (see _placements_to_spec)."""
 
 
 def _placements_to_spec(ndim, mesh: ProcessMesh, placements):
     spec = [None] * ndim
     for mesh_dim, placement in enumerate(placements):
-        if isinstance(placement, Shard):
+        if isinstance(placement, Partial):
+            warnings.warn(
+                "Partial placement has no top-level GSPMD representation; "
+                "treating as Replicate. Inside shard_map, lax.psum the "
+                "value over the mesh axis instead", stacklevel=3)
+        elif isinstance(placement, Shard):
             axis = mesh.dim_names[mesh_dim]
             if spec[placement.dim] is None:
                 spec[placement.dim] = axis

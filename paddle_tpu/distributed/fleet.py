@@ -13,6 +13,7 @@ reference training scripts translate line-for-line:
 """
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -24,7 +25,14 @@ from paddle_tpu.distributed.mesh import HybridMesh
 @dataclass
 class DistributedStrategy:
     hybrid_configs: dict = field(default_factory=dict)
-    # reference knobs kept for parity; consumed where meaningful
+    # reference knobs, each mapped onto the real mechanism:
+    #   amp            -> amp.decorate(model, "O2") + multi_precision master
+    #                     weights when pure (use_pure_fp16/bf16 or level O2);
+    #                     plain O1 autocast is the framework default (bf16
+    #                     compute) so it needs no transformation
+    #   recompute      -> model's remat flag (per-layer jax.checkpoint)
+    #   sharding       -> distributed_model's ZeRO stage placement
+    #   gradient_merge -> optimizer.GradientMerge(k_steps, avg) wrapper
     amp: bool = False
     amp_configs: dict = field(default_factory=dict)
     recompute: bool = False
@@ -32,6 +40,17 @@ class DistributedStrategy:
     sharding_configs: dict = field(default_factory=dict)
     gradient_merge: bool = False
     gradient_merge_configs: dict = field(default_factory=dict)
+
+    def _amp_pure(self) -> bool:
+        c = self.amp_configs or {}
+        if c.get("use_pure_fp16"):
+            warnings.warn(
+                "DistributedStrategy.amp_configs use_pure_fp16: TPU's "
+                "native reduced precision is bfloat16 — params are cast to "
+                "bf16, not fp16 (no loss scaling needed)", stacklevel=3)
+            return True
+        return bool(c.get("use_pure_bf16")
+                    or str(c.get("level", "O1")).upper() == "O2")
 
 
 _STATE: dict = {"mesh": None, "strategy": None}
@@ -64,12 +83,31 @@ def get_hybrid_communicate_group() -> Optional[HybridMesh]:
 
 def distributed_model(model, min_size: int = 2 ** 16):
     """Ref: fleet.distributed_model — places params on the mesh (ZeRO-3 layout
-    honouring tp pspecs). Sharding stage comes from strategy.sharding_configs."""
+    honouring tp pspecs). Sharding stage comes from strategy.sharding_configs.
+
+    Strategy knobs applied here: ``amp`` (pure level: amp.decorate casts the
+    params to bf16; O1 is the framework's native default and needs nothing),
+    ``recompute`` (sets the model's remat flag when it has one — per-layer
+    jax.checkpoint — else warns that it is ignored)."""
     from paddle_tpu.distributed.sharded import shard_module
+    strategy = _STATE["strategy"]
+    if strategy is not None:
+        if strategy.recompute:
+            cfg = getattr(model, "cfg", None)
+            if cfg is not None and hasattr(cfg, "remat"):
+                cfg.remat = True
+            else:
+                warnings.warn(
+                    "DistributedStrategy.recompute: this model has no remat "
+                    "flag; the knob is IGNORED — wrap the forward with "
+                    "fleet.utils.recompute / paddle_tpu.distributed."
+                    "recompute (jax.checkpoint) instead", stacklevel=2)
+        if strategy.amp and strategy._amp_pure():
+            from paddle_tpu import amp as _amp
+            model = _amp.decorate(model, level="O2")
     mesh = _STATE["mesh"]
     if mesh is None:
         return model
-    strategy = _STATE["strategy"]
     stage = 3
     if strategy and strategy.sharding_configs:
         stage = int(strategy.sharding_configs.get("stage", 3))
@@ -86,9 +124,41 @@ def worker_num() -> int:
 
 def distributed_optimizer(optimizer, strategy=None):
     """Ref fleet.distributed_optimizer. Under GSPMD the optimizer needs no
-    wrapping — its state pytree mirrors the (sharded) param pytree, so
-    ZeRO-style partitioning falls out of init_state(model, optimizer, mesh).
-    Returned unchanged for API parity."""
+    DISTRIBUTION wrapping — its state pytree mirrors the (sharded) param
+    pytree, so ZeRO-style partitioning falls out of init_state(model,
+    optimizer, mesh). Strategy knobs DO act here:
+
+    * ``amp`` (pure level) -> ``multi_precision=True`` (fp32 master weights,
+      the reference's O2 recipe); plain O1 needs no optimizer change.
+    * ``gradient_merge`` -> wrapped in ``optimizer.GradientMerge`` with
+      ``k_steps``/``avg`` from gradient_merge_configs.
+    """
+    strategy = strategy or _STATE["strategy"]
+    if strategy is None:
+        return optimizer
+    if strategy.amp and strategy._amp_pure():
+        if hasattr(optimizer, "multi_precision"):
+            optimizer.multi_precision = True
+        else:
+            warnings.warn(
+                "DistributedStrategy.amp (pure): optimizer has no "
+                "multi_precision attribute; the knob is IGNORED for it",
+                stacklevel=2)
+    if strategy.gradient_merge:
+        from paddle_tpu.optimizer import GradientMerge, Optimizer
+        cfgs = strategy.gradient_merge_configs or {}
+        k_steps = int(cfgs.get("k_steps", 1))
+        if isinstance(optimizer, GradientMerge):
+            pass  # idempotent: nested wrapping would compound k/avg
+        elif isinstance(optimizer, Optimizer):
+            if k_steps > 1:  # k=1 would be a no-op carrying fp32 accum HBM
+                optimizer = GradientMerge(optimizer, k_steps=k_steps,
+                                          avg=bool(cfgs.get("avg", True)))
+        else:
+            warnings.warn(
+                "DistributedStrategy.gradient_merge: not a paddle_tpu "
+                "Optimizer; the knob is IGNORED — wrap it in "
+                "paddle_tpu.optimizer.GradientMerge yourself", stacklevel=2)
     return optimizer
 
 

@@ -935,6 +935,88 @@ class LookAhead(Optimizer):
                 inner_state["master"], new_slow)}
         return out, {"step": la_step, "inner": inner_state, "slow": new_slow}
 
+    # see GradientMerge: lr state lives in the inner optimizer
+    def set_lr(self, value, state=None):
+        if state is not None:
+            return {**state, "inner": self.inner.set_lr(value,
+                                                        state["inner"])}
+        self.inner.set_lr(value)
+        self.learning_rate = self.inner.learning_rate
+        return None
+
+    def get_lr(self, state=None):
+        return self.inner.get_lr(state["inner"] if state is not None
+                                 else None)
+
+
+class GradientMerge(Optimizer):
+    """Ref: fleet ``DistributedStrategy.gradient_merge`` /
+    ``paddle.incubate.optimizer.GradientMergeOptimizer`` — accumulate grads
+    for ``k_steps`` calls and apply the inner optimizer once with the
+    (averaged, when ``avg``) merged gradient. Pure/jit-safe: the inner step
+    runs every call and a traced predicate selects whether its result or
+    the unchanged params are kept, so the step has a single static shape."""
+
+    def __init__(self, inner: Optimizer, k_steps: int = 1, avg: bool = True):
+        super().__init__(learning_rate=inner.learning_rate)
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self.inner, self.k_steps, self.avg = inner, int(k_steps), bool(avg)
+
+    def init(self, params):
+        if self._owg_mask(params) is not None:
+            raise NotImplementedError(
+                "GradientMerge cannot accumulate fp8 amax-history "
+                "(overwrite-with-gradient) leaves — their 'gradient' is a "
+                "value, not a summand; train fp8 without gradient_merge")
+        # fp32 accumulators ONLY for float params (None elsewhere — a
+        # passthrough leaf would alias the param buffer and break donation)
+        return {"step": jnp.zeros((), jnp.int32),
+                "inner": self.inner.init(params),
+                "accum": _tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32)
+                    if (p is not None and hasattr(p, "dtype")
+                        and jnp.issubdtype(p.dtype, jnp.floating))
+                    else None, params)}
+
+    def step(self, params, grads, state):
+        gm_step = state["step"] + 1
+        apply_now = (gm_step % self.k_steps == 0)
+        accum = _tree_map(
+            lambda a, g: a if a is None or g is None
+            else a + g.astype(jnp.float32), state["accum"], grads)
+        scale = (1.0 / self.k_steps) if self.avg else 1.0
+        merged = _tree_map(
+            lambda a, g: g if a is None or g is None
+            else (a * scale).astype(g.dtype), accum, grads)
+        cand_params, cand_inner = self.inner.step(params, merged,
+                                                 state["inner"])
+        sel = lambda new, old: _tree_map(
+            lambda n, o: n if n is None or o is None
+            or not hasattr(n, "dtype") else jnp.where(apply_now, n, o),
+            new, old)
+        out_params = sel(cand_params, params)
+        out_inner = sel(cand_inner, state["inner"])
+        new_accum = _tree_map(
+            lambda a: None if a is None
+            else jnp.where(apply_now, jnp.zeros_like(a), a), accum)
+        return out_params, {"step": gm_step, "inner": out_inner,
+                            "accum": new_accum}
+
+    # lr lives in the INNER optimizer's state — route there, or set_lr on
+    # the wrapper would write a top-level "lr" nothing reads
+    def set_lr(self, value, state=None):
+        if state is not None:
+            return {**state, "inner": self.inner.set_lr(value,
+                                                        state["inner"])}
+        self.inner.set_lr(value)
+        self.learning_rate = self.inner.learning_rate
+        return None
+
+    def get_lr(self, state=None):
+        return self.inner.get_lr(state["inner"] if state is not None
+                                 else None)
+
 
 class ExponentialMovingAverage:
     """Ref: paddle.incubate.ExponentialMovingAverage (functional flavour).

@@ -1,0 +1,163 @@
+"""DistributedStrategy knobs act (or warn) — VERDICT r2 item 8.
+
+Each reference knob maps onto the real mechanism:
+  amp (pure)     -> amp.decorate O2 param cast + optimizer multi_precision
+  recompute      -> model cfg.remat (per-layer jax.checkpoint)
+  gradient_merge -> optimizer.GradientMerge(k_steps, avg)
+  auto_parallel.Partial -> explicit warning (no top-level GSPMD partial)
+Ref: python/paddle/distributed/fleet/base/distributed_strategy.py.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn import Linear
+from paddle_tpu.optimizer import SGD, AdamW, GradientMerge
+
+
+@pytest.fixture
+def fleet_state():
+    """Isolate fleet's module-global state per test."""
+    saved = dict(fleet._STATE)
+    yield fleet._STATE
+    fleet._STATE.clear()
+    fleet._STATE.update(saved)
+
+
+# ---------------------------------------------------------------- GradientMerge
+
+def test_gradient_merge_equals_merged_step():
+    pt.seed(0)
+    w0 = jnp.asarray(np.random.RandomState(0).randn(4, 3), jnp.float32)
+    g1 = jnp.asarray(np.random.RandomState(1).randn(4, 3), jnp.float32)
+    g2 = jnp.asarray(np.random.RandomState(2).randn(4, 3), jnp.float32)
+
+    gm = GradientMerge(SGD(learning_rate=0.1), k_steps=2, avg=True)
+    state = gm.init({"w": w0})
+    p1, state = gm.step({"w": w0}, {"w": g1}, state)
+    # first call accumulates only — params untouched
+    np.testing.assert_array_equal(np.asarray(p1["w"]), np.asarray(w0))
+    p2, state = gm.step(p1, {"w": g2}, state)
+
+    ref = SGD(learning_rate=0.1)
+    rstate = ref.init({"w": w0})
+    pref, _ = ref.step({"w": w0}, {"w": (g1 + g2) / 2.0}, rstate)
+    np.testing.assert_allclose(np.asarray(p2["w"]), np.asarray(pref["w"]),
+                               rtol=1e-6)
+    # accumulator reset after the apply step
+    np.testing.assert_array_equal(np.asarray(state["accum"]["w"]), 0.0)
+
+
+def test_gradient_merge_sum_mode_and_jit():
+    w0 = jnp.ones((3,), jnp.float32)
+    gm = GradientMerge(SGD(learning_rate=0.5), k_steps=2, avg=False)
+    step = jax.jit(gm.step)
+    state = gm.init({"w": w0})
+    p, state = step({"w": w0}, {"w": jnp.ones((3,))}, state)
+    p, state = step(p, {"w": jnp.ones((3,))}, state)
+    # sum mode: effective grad = 2.0, lr 0.5 -> w - 1.0
+    np.testing.assert_allclose(np.asarray(p["w"]), 0.0, atol=1e-6)
+
+
+def test_gradient_merge_inner_state_frozen_between_applies():
+    gm = GradientMerge(AdamW(learning_rate=1e-2), k_steps=3)
+    w = {"w": jnp.ones((2, 2), jnp.float32)}
+    state = gm.init(w)
+    g = {"w": jnp.full((2, 2), 0.5)}
+    p, state = gm.step(w, g, state)
+    # inner Adam step count must not advance on accumulate-only calls
+    assert int(state["inner"]["step"]) == 0
+    p, state = gm.step(p, g, state)
+    p, state = gm.step(p, g, state)
+    assert int(state["inner"]["step"]) == 1
+    assert not np.allclose(np.asarray(p["w"]), 1.0)
+
+
+def test_gradient_merge_set_lr_routes_to_inner():
+    gm = GradientMerge(SGD(learning_rate=0.1), k_steps=1)
+    w = {"w": jnp.ones((2,), jnp.float32)}
+    state = gm.init(w)
+    state = gm.set_lr(0.5, state)
+    assert gm.get_lr(state) == pytest.approx(0.5)
+    p, _ = gm.step(w, {"w": jnp.ones((2,))}, state)
+    np.testing.assert_allclose(np.asarray(p["w"]), 0.5, atol=1e-6)
+
+
+# ---------------------------------------------------------------- fleet knobs
+
+def test_fleet_gradient_merge_knob(fleet_state):
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 4, "avg": False}
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(SGD(learning_rate=0.1))
+    assert isinstance(opt, GradientMerge)
+    assert opt.k_steps == 4 and opt.avg is False
+    # idempotent: a second call must not nest wrappers
+    opt2 = fleet.distributed_optimizer(opt)
+    assert opt2 is opt and not isinstance(opt2.inner, GradientMerge)
+
+    with pytest.warns(UserWarning, match="gradient_merge.*IGNORED"):
+        assert fleet.distributed_optimizer("opt") == "opt"
+
+
+def test_fleet_amp_pure_knob(fleet_state):
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {"use_pure_bf16": True}
+    mesh = fleet.init(is_collective=True, strategy=strategy)
+
+    opt = fleet.distributed_optimizer(AdamW(learning_rate=1e-3))
+    assert opt.multi_precision is True
+
+    pt.seed(0)
+    with mesh:
+        m = fleet.distributed_model(Linear(8, 8), min_size=1)
+    assert m.weight.dtype == jnp.bfloat16
+
+
+def test_fleet_amp_o1_is_native_noop(fleet_state):
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True  # O1: bf16 compute is the framework default
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(AdamW(learning_rate=1e-3))
+    assert opt.multi_precision is False
+    assert not isinstance(opt, GradientMerge)
+
+
+def test_fleet_recompute_knob(fleet_state):
+    strategy = fleet.DistributedStrategy()
+    strategy.recompute = True
+    mesh = fleet.init(is_collective=True, strategy=strategy)
+    pt.seed(0)
+    cfg = LlamaConfig.tiny()  # tiny() sets remat=False
+    assert cfg.remat is False
+    m = LlamaForCausalLM(cfg)
+    with mesh:
+        fleet.distributed_model(m, min_size=1)
+    assert cfg.remat is True
+
+    with pytest.warns(UserWarning, match="recompute.*no remat"):
+        with mesh:
+            fleet.distributed_model(Linear(4, 4), min_size=1)
+
+
+# ---------------------------------------------------------------- Partial
+
+def test_auto_parallel_partial_warns():
+    from paddle_tpu.distributed.auto_parallel import (Partial, ProcessMesh,
+                                                      Replicate, shard_tensor)
+    pm = ProcessMesh(np.arange(8), dim_names=["x"])
+    x = jnp.ones((4, 4))
+    with pytest.warns(UserWarning, match="Partial placement"):
+        y = shard_tensor(x, pm, [Partial()])
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        shard_tensor(x, pm, [Replicate()])
