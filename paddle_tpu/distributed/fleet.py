@@ -137,8 +137,13 @@ def distributed_optimizer(optimizer, strategy=None):
     if strategy is None:
         return optimizer
     if strategy.amp and strategy._amp_pure():
-        if hasattr(optimizer, "multi_precision"):
-            optimizer.multi_precision = True
+        # walk wrapper chains (GradientMerge/LookAhead): the flag must land
+        # on the optimizer whose step actually applies updates
+        target = optimizer
+        while hasattr(target, "inner"):
+            target = target.inner
+        if hasattr(target, "multi_precision"):
+            target.multi_precision = True
         else:
             warnings.warn(
                 "DistributedStrategy.amp (pure): optimizer has no "

@@ -22,22 +22,28 @@ AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
 
 
 class HybridMesh:
-    """dp × fsdp × pp × tp × sp × ep over the device grid.
+    """dp × fsdp × ep × pp × tp × sp over the device grid.
 
-    ep is folded over (dp, fsdp) at use-time by the MoE layer (experts live
-    across the data axes), so the physical mesh has the five axes below;
-    `ep_size` is recorded for the MoE dispatcher.
+    ``ep`` is a first-class expert-parallel axis: MoE expert weights carry
+    ``P("ep", ...)`` and the MoE dispatcher's ``lax.all_to_all`` runs over
+    it (ref: the MoE NCCL group's ``c_alltoall``). Tokens/batch are sharded
+    over (dp, fsdp, ep) — experts ride chips that also carry data, the
+    reference's "ep on dp" layout, but with an explicit named axis.
     """
 
     def __init__(self, dp: int = 1, fsdp: int = 1, pp: int = 1, tp: int = 1,
-                 sp: int = 1, devices: Optional[Sequence] = None):
+                 sp: int = 1, ep: int = 1,
+                 devices: Optional[Sequence] = None):
         devices = list(devices if devices is not None else jax.devices())
-        n = dp * fsdp * pp * tp * sp
+        n = dp * fsdp * ep * pp * tp * sp
         if n != len(devices):
-            raise ValueError(f"mesh {dp}x{fsdp}x{pp}x{tp}x{sp}={n} != {len(devices)} devices")
-        grid = np.array(devices).reshape(dp, fsdp, pp, tp, sp)
-        self.mesh = Mesh(grid, ("dp", "fsdp", "pp", "tp", "sp"))
+            raise ValueError(
+                f"mesh {dp}x{fsdp}x{ep}x{pp}x{tp}x{sp}={n} != "
+                f"{len(devices)} devices")
+        grid = np.array(devices).reshape(dp, fsdp, ep, pp, tp, sp)
+        self.mesh = Mesh(grid, ("dp", "fsdp", "ep", "pp", "tp", "sp"))
         self.dp, self.fsdp, self.pp, self.tp, self.sp = dp, fsdp, pp, tp, sp
+        self.ep = ep
 
     # -- reference-style queries (HybridCommunicateGroup API) ---------------
     def get_data_parallel_world_size(self):
@@ -58,10 +64,10 @@ class HybridMesh:
 
     def batch_sharding(self) -> NamedSharding:
         """Global-batch sharding over all data axes."""
-        return NamedSharding(self.mesh, P(("dp", "fsdp"),))
+        return NamedSharding(self.mesh, P(("dp", "fsdp", "ep"),))
 
     def batch_spec(self) -> P:
-        return P(("dp", "fsdp"),)
+        return P(("dp", "fsdp", "ep"),)
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
@@ -96,5 +102,6 @@ def single_device_mesh() -> HybridMesh:
 
 def make_mesh(shape: dict, devices=None) -> HybridMesh:
     """shape e.g. {"dp":2, "tp":4} — unspecified axes default 1."""
-    kw = {a: int(shape.get(a, 1)) for a in ("dp", "fsdp", "pp", "tp", "sp")}
+    kw = {a: int(shape.get(a, 1))
+          for a in ("dp", "fsdp", "pp", "tp", "sp", "ep")}
     return HybridMesh(**kw, devices=devices)

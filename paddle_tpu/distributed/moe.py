@@ -2,12 +2,32 @@
 incubate/distributed/models/moe/`` — MoELayer, gate, dispatcher using
 ``c_alltoall`` over the expert-parallel NCCL group).
 
-TPU-native: GShard/Switch dense-dispatch formulation. Tokens are combined
-with a capacity-limited one-hot dispatch tensor via einsum; expert weights
-carry a leading expert axis sharded on the data axes (experts ride the same
-chips as data parallelism, the reference's ``ep on dp`` layout). Under
-GSPMD the dispatch/combine einsums lower to the SAME all_to_all pattern the
-reference hand-codes — but fused and overlapped by XLA.
+TPU-native design, two layers:
+
+* **Sort-based routing** (``top_k_route``): tokens are argsort-grouped by
+  expert id and capacity is enforced by position-within-group — the
+  megablox-style O(T·k) formulation. No ``[T, E, C]`` one-hot
+  dispatch/combine tensor is ever materialised (the GShard dense einsum
+  form is O(T·E·C) memory and unusable at E=64, T=16k); dispatch is a
+  scatter-add into ``[E·C, H]`` slots, combine a gather +
+  scatter-add-by-token. Slot priority is (choice j, token t) — exactly the
+  classic GShard queue order, so routing decisions (who is kept, who is
+  dropped) are identical to the dense reference formulation
+  (``top_k_gate`` below, kept as the executable spec).
+
+* **Explicit expert-parallel dispatch** (``MoELayer`` under a mesh with an
+  ``ep`` axis): a ``shard_map`` over ``ep`` where each shard routes its
+  local tokens with LOCAL capacity (the reference's per-rank capacity
+  semantics), builds an ``[E, C_local, H]`` send buffer, and a
+  ``lax.all_to_all`` exchanges expert slices — the literal ``c_alltoall``
+  the reference hand-codes, here riding ICI. Experts then run on
+  ``[E_local, ep·C_local, H]`` and a reverse all_to_all returns results.
+  Token results are invariant to slot order, so with no drops this equals
+  the single-device layer exactly.
+
+The gate also reports a **drop rate** (fraction of routing choices that
+overflowed capacity) so saturation is observable (the reference exposes
+drop behaviour through its gate counters).
 """
 from __future__ import annotations
 
@@ -20,17 +40,37 @@ from paddle_tpu.core.module import Module
 from paddle_tpu.nn import initializer as I
 
 
-def top_k_gate(logits, k: int, capacity: int, *, jitter_rng=None):
-    """Top-k gating with capacity (ref gate/naive_gate.py + GShard aux loss).
-
-    logits: [T, E]. Returns (dispatch [T, E, C] bool, combine [T, E, C] float,
-    aux_loss scalar).
-    """
-    t, e = logits.shape
+def _gate_probs(logits, k):
+    """softmax -> top-k -> renormalised gates. Returns ([T,k] vals, idx, probs)."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
-    # renormalise the k gates
-    gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+    return gate_vals, gate_idx, probs
+
+
+def _aux_parts(probs, gate_idx):
+    """Switch load-balance loss ingredients: (mean prob/expert, frac top-1
+    tokens/expert). aux = E * sum(me * ce); kept split so an ep shard_map
+    can pmean the parts for the exact global loss."""
+    e = probs.shape[-1]
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32),
+                  axis=0)
+    return me, ce
+
+
+def top_k_gate(logits, k: int, capacity: int, *, jitter_rng=None):
+    """DENSE top-k gating with capacity — the executable GShard spec
+    (ref gate/naive_gate.py). O(T·E·C) memory; kept as the reference
+    semantics that ``top_k_route`` is tested against. Production paths use
+    the sort-based route below.
+
+    logits: [T, E]. Returns (dispatch [T, E, C] bool, combine [T, E, C]
+    float, aux_loss scalar).
+    """
+    t, e = logits.shape
+    gate_vals, gate_idx, probs = _gate_probs(logits, k)
 
     # GShard position computation: queue slot per token per choice
     dispatch = jnp.zeros((t, e, capacity), bool)
@@ -47,11 +87,73 @@ def top_k_gate(logits, k: int, capacity: int, *, jitter_rng=None):
         combine = combine + d_j.astype(jnp.float32) * gate_vals[:, j][:, None, None]
         offset = offset + jnp.sum(choice, axis=0)
 
-    # load-balancing aux loss (Switch): E * sum_e (frac_tokens_e * mean_prob_e)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0)
+    me, ce = _aux_parts(probs, gate_idx)
     aux = e * jnp.sum(me * ce)
     return dispatch, combine, aux
+
+
+def top_k_route(logits, k: int, capacity: int):
+    """Sort-based top-k routing — O(T·k log) compute, O(T·k) memory.
+
+    logits: [T, E]. Returns ``(route, aux, drop_rate)`` where ``route`` is a
+    dict of [N = T·k] arrays in expert-sorted order:
+
+      tok   int32  source token index
+      expert int32 destination expert
+      pos   int32  slot within the expert's queue (GShard (j, t) priority)
+      keep  bool   pos < capacity (False = dropped)
+      gate  f32    renormalised combine weight
+
+    Identical keep/drop decisions to ``top_k_gate`` by construction: the
+    flat assignment list is laid out choice-major (all j=0 entries before
+    j=1) and the stable argsort preserves that order within each expert.
+    """
+    t, e = logits.shape
+    n = t * k
+    gate_vals, gate_idx, probs = _gate_probs(logits, k)
+
+    flat_e = gate_idx.T.reshape(n)                 # choice-major [k*T]
+    flat_gate = gate_vals.T.reshape(n)
+    flat_tok = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+
+    order = jnp.argsort(flat_e, stable=True)
+    se = flat_e[order]
+    counts = jnp.zeros((e,), jnp.int32).at[flat_e].add(1)
+    starts = jnp.cumsum(counts) - counts           # exclusive prefix
+    pos = jnp.arange(n, dtype=jnp.int32) - starts[se]
+    keep = pos < capacity
+
+    me, ce = _aux_parts(probs, gate_idx)
+    # me/ce ride along so a distributed caller can pmean them for the
+    # exact global aux loss without recomputing the gate
+    route = dict(tok=flat_tok[order], expert=se, pos=pos, keep=keep,
+                 gate=flat_gate[order], me=me, ce=ce)
+    aux = e * jnp.sum(me * ce)
+    drop_rate = 1.0 - jnp.mean(keep.astype(jnp.float32))
+    return route, aux, drop_rate
+
+
+def sparse_dispatch(xt, route, num_experts: int, capacity: int):
+    """Scatter tokens into expert slots: [T, H] -> [E, C, H]. Dropped
+    assignments scatter out of bounds and are discarded (mode='drop')."""
+    t, h = xt.shape
+    dest = jnp.where(route["keep"],
+                     route["expert"] * capacity + route["pos"],
+                     num_experts * capacity)        # OOB sentinel
+    x_e = jnp.zeros((num_experts * capacity, h), xt.dtype)
+    x_e = x_e.at[dest].add(xt[route["tok"]], mode="drop")
+    return x_e.reshape(num_experts, capacity, h), dest
+
+
+def sparse_combine(y_e, route, dest, num_tokens: int):
+    """Gather expert outputs back to tokens with gate weights:
+    [E, C, H] -> [T, H]. Dropped assignments contribute zero."""
+    e, c, h = y_e.shape
+    y_flat = y_e.reshape(e * c, h)
+    gathered = y_flat.at[dest].get(mode="fill", fill_value=0)
+    gathered = gathered * route["gate"][:, None].astype(y_flat.dtype)
+    yt = jnp.zeros((num_tokens, h), y_e.dtype)
+    return yt.at[route["tok"]].add(gathered, mode="drop")
 
 
 class ExpertMLP(Module):
@@ -63,21 +165,30 @@ class ExpertMLP(Module):
         init = I.Normal(0.0, 0.02)
         self.gate_up = init((num_experts, hidden, 2 * intermediate), dtype)
         self.down = init((num_experts, intermediate, hidden), dtype)
-        # experts across the data axes = expert parallelism on (dp, fsdp)
-        self.set_pspec("gate_up", P(("dp", "fsdp"), None, None))
-        self.set_pspec("down", P(("dp", "fsdp"), None, None))
+        # experts over the dedicated ep mesh axis (expert parallelism)
+        self.set_pspec("gate_up", P("ep", None, None))
+        self.set_pspec("down", P("ep", None, None))
 
     def __call__(self, x_e):
         """x_e: [E, C, H] — per-expert token slots."""
-        gu = jnp.einsum("ech,ehm->ecm", x_e, self.gate_up)
-        gate, up = jnp.split(gu, 2, axis=-1)
-        act = jax.nn.silu(gate) * up
-        return jnp.einsum("ecm,emh->ech", act, self.down)
+        return expert_mlp_apply(x_e, self.gate_up, self.down)
+
+
+def expert_mlp_apply(x_e, gate_up, down):
+    """Row-independent SwiGLU over expert slots (also used with LOCAL
+    weight shards inside the ep shard_map)."""
+    gu = jnp.einsum("ech,ehm->ecm", x_e, gate_up)
+    gate, up = jnp.split(gu, 2, axis=-1)
+    act = jax.nn.silu(gate) * up
+    return jnp.einsum("ecm,emh->ech", act, down)
 
 
 class MoELayer(Module):
-    """Drop-in MLP replacement (ref MoELayer). combine/dispatch einsums are
-    the all_to_all; aux loss is returned for the trainer to add."""
+    """Drop-in MLP replacement (ref MoELayer). Sort-based routing
+    everywhere; under a mesh with ep > 1 the forward is a shard_map whose
+    ``lax.all_to_all`` over the ep axis is the reference's ``c_alltoall``.
+    The aux loss is returned for the trainer to add; the last drop rate is
+    exposed via ``return_metrics=True``."""
 
     def __init__(self, hidden, intermediate, num_experts, k=2,
                  capacity_factor=1.25, dtype=None):
@@ -87,19 +198,93 @@ class MoELayer(Module):
         self.experts = ExpertMLP(num_experts, hidden, intermediate, dtype)
         self.num_experts, self.k, self.capacity_factor = num_experts, k, capacity_factor
 
-    def __call__(self, x, return_aux=True):
+    def _capacity(self, tokens: int) -> int:
+        cap = int(self.capacity_factor * self.k * tokens / self.num_experts
+                  + 0.999)
+        return max(cap, 4)
+
+    def __call__(self, x, return_aux=True, return_metrics=False):
+        from paddle_tpu.distributed.mesh import current_mesh
+        mesh = current_mesh()
+        ep = mesh.size("ep") if mesh is not None else 1
+        if ep > 1:
+            y, aux, drop = self._forward_ep(x, mesh, ep)
+        else:
+            y, aux, drop = self._forward_local(x)
+        if return_metrics:
+            return y, aux, {"drop_rate": drop}
+        return (y, aux) if return_aux else y
+
+    # -- single-shard (or pure-GSPMD) path ----------------------------------
+    def _forward_local(self, x):
         b, s, h = x.shape
         t = b * s
         e = self.num_experts
-        cap = int(self.capacity_factor * self.k * t / e + 0.999)
-        cap = max(cap, 4)
+        cap = self._capacity(t)
         xt = x.reshape(t, h)
         logits = xt.astype(jnp.float32) @ self.gate_w
-        dispatch, combine, aux = top_k_gate(logits, self.k, cap)
-        # dispatch: [T,E,C] — route tokens to expert slots (≙ all_to_all)
-        x_e = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+        route, aux, drop = top_k_route(logits, self.k, cap)
+        x_e, dest = sparse_dispatch(xt, route, e, cap)
         y_e = self.experts(x_e)
-        # combine back (≙ reverse all_to_all)
-        yt = jnp.einsum("tec,ech->th", combine.astype(x.dtype), y_e)
-        y = yt.reshape(b, s, h)
-        return (y, aux) if return_aux else y
+        yt = sparse_combine(y_e, route, dest, t)
+        return yt.reshape(b, s, h), aux, drop
+
+    # -- expert-parallel path: shard_map + all_to_all over the ep axis ------
+    def _forward_ep(self, x, mesh, ep):
+        from jax import shard_map
+
+        e = self.num_experts
+        if e % ep != 0:
+            raise ValueError(f"num_experts={e} not divisible by ep={ep}")
+        b, s, h = x.shape
+        # tokens are sharded over ALL data axes, not just ep
+        data_shards = mesh.dp * mesh.fsdp * ep
+        if b % data_shards != 0:
+            raise ValueError(
+                f"batch {b} not divisible by dp*fsdp*ep={data_shards} "
+                "(tokens are sharded over the data axes)")
+        # LOCAL capacity — the reference's per-rank semantics: each rank may
+        # fill at most C_local slots of each (global) expert
+        cap = self._capacity((b // data_shards) * s)
+        k = self.k
+
+        batch_axes = ("dp", "fsdp", "ep")
+        xspec = P(batch_axes, None, None)
+
+        def local(xl, gate_w, gate_up, down):
+            bl, sl, hl = xl.shape
+            tl = bl * sl
+            xt = xl.reshape(tl, hl)
+            logits = xt.astype(jnp.float32) @ gate_w
+            route, _, _ = top_k_route(logits, k, cap)
+            # exact global aux loss: pmean the gate's ingredients
+            me = jax.lax.pmean(route["me"], batch_axes)
+            ce = jax.lax.pmean(route["ce"], batch_axes)
+            aux = e * jnp.sum(me * ce)
+            drop = 1.0 - jax.lax.pmean(
+                jnp.mean(route["keep"].astype(jnp.float32)), batch_axes)
+
+            # send buffer: my tokens in every expert's queue -> [E, C, H]
+            x_send, dest = sparse_dispatch(xt, route, e, cap)
+            # [E, C, H] -> [ep, E_loc, C, H]; a2a: recv[s] = shard s's slots
+            # for MY experts (the c_alltoall)
+            x_send = x_send.reshape(ep, e // ep, cap, hl)
+            x_recv = jax.lax.all_to_all(x_send, "ep", split_axis=0,
+                                        concat_axis=0)
+            # experts are row-independent: fold senders into the slot dim
+            x_loc = jnp.swapaxes(x_recv, 0, 1).reshape(e // ep, ep * cap, hl)
+            y_loc = expert_mlp_apply(x_loc, gate_up, down)
+            # reverse exchange back to the senders
+            y_back = jnp.swapaxes(
+                y_loc.reshape(e // ep, ep, cap, hl), 0, 1)
+            y_recv = jax.lax.all_to_all(y_back, "ep", split_axis=0,
+                                        concat_axis=0)
+            y_e = y_recv.reshape(e, cap, hl)
+            yt = sparse_combine(y_e, route, dest, tl)
+            return yt.reshape(bl, sl, hl), aux, drop
+
+        fn = shard_map(
+            local, mesh=mesh.mesh,
+            in_specs=(xspec, P(), P("ep", None, None), P("ep", None, None)),
+            out_specs=(xspec, P(), P()))
+        return fn(x, self.gate_w, self.experts.gate_up, self.experts.down)

@@ -115,6 +115,10 @@ def test_fleet_amp_pure_knob(fleet_state):
 
     opt = fleet.distributed_optimizer(AdamW(learning_rate=1e-3))
     assert opt.multi_precision is True
+    # through a wrapper chain the flag lands on the stepping inner optimizer
+    wrapped = fleet.distributed_optimizer(
+        GradientMerge(AdamW(learning_rate=1e-3), k_steps=2))
+    assert wrapped.inner.multi_precision is True
 
     pt.seed(0)
     with mesh:

@@ -1,0 +1,203 @@
+"""Sort-based MoE dispatch (VERDICT r2 item 4).
+
+* sparse route == dense GShard gate, including under saturation (same
+  keep/drop decisions, same outputs)
+* drop-rate counter observable; zero with ample capacity
+* E=64 / T=16k dispatch traces without materialising any [T, E, C]-sized
+  intermediate
+* explicit shard_map all_to_all over the real ep mesh axis == single
+  device, forward AND grads; composes with tp (MoE LLM loss equality)
+Ref: python/paddle/incubate/distributed/models/moe/ (c_alltoall dispatch).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.mesh import HybridMesh
+from paddle_tpu.distributed.moe import (MoELayer, expert_mlp_apply,
+                                        sparse_combine, sparse_dispatch,
+                                        top_k_gate, top_k_route)
+
+
+def _dense_reference(moe, x):
+    """The O(T·E·C) GShard einsum formulation as executable spec."""
+    b, s, h = x.shape
+    t = b * s
+    cap = moe._capacity(t)
+    xt = x.reshape(t, h)
+    logits = xt.astype(jnp.float32) @ moe.gate_w
+    dispatch, combine, aux = top_k_gate(logits, moe.k, cap)
+    x_e = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+    y_e = expert_mlp_apply(x_e, moe.experts.gate_up, moe.experts.down)
+    yt = jnp.einsum("tec,ech->th", combine.astype(x.dtype), y_e)
+    return yt.reshape(b, s, h), aux
+
+
+@pytest.mark.parametrize("capacity_factor", [1.25, 0.4])
+def test_sparse_equals_dense(capacity_factor):
+    """Same outputs as the dense GShard spec — ample AND saturated."""
+    pt.seed(0)
+    moe = MoELayer(hidden=16, intermediate=32, num_experts=8, k=2,
+                   capacity_factor=capacity_factor, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 32, 16), jnp.float32)
+    ref, aux_ref = _dense_reference(moe, x)
+    got, aux, metrics = moe(x, return_metrics=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+    if capacity_factor < 1.0:
+        assert float(metrics["drop_rate"]) > 0.0
+
+
+def test_route_matches_gate_decisions():
+    """keep/drop and slot positions identical to the dense gate."""
+    rs = np.random.RandomState(1)
+    logits = jnp.asarray(rs.randn(64, 8), jnp.float32)
+    cap = 10  # saturating for 64*2/8 = 16 mean load
+    dispatch, combine, _ = top_k_gate(logits, 2, cap)
+    route, _, drop = top_k_route(logits, 2, cap)
+
+    dense = np.asarray(dispatch)  # [T, E, C]
+    r_tok = np.asarray(route["tok"])
+    r_e = np.asarray(route["expert"])
+    r_pos = np.asarray(route["pos"])
+    r_keep = np.asarray(route["keep"])
+    for i in range(len(r_tok)):
+        if r_keep[i]:
+            assert dense[r_tok[i], r_e[i], r_pos[i]]
+    assert dense.sum() == r_keep.sum()
+    assert float(drop) == pytest.approx(1.0 - r_keep.mean())
+
+
+def test_drop_rate_zero_with_ample_capacity():
+    pt.seed(0)
+    moe = MoELayer(hidden=8, intermediate=16, num_experts=4, k=2,
+                   capacity_factor=8.0, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 8), jnp.float32)
+    _, _, metrics = moe(x, return_metrics=True)
+    assert float(metrics["drop_rate"]) == 0.0
+
+
+def test_no_dense_tec_intermediate_at_scale():
+    """E=64, T=16k: the trace must not contain any [T,E,C]-sized buffer."""
+    pt.seed(0)
+    e, h, t = 64, 32, 16384
+    moe = MoELayer(hidden=h, intermediate=2 * h, num_experts=e, k=2,
+                   dtype=jnp.float32)
+    x = jnp.zeros((8, t // 8, h), jnp.float32)
+    jaxpr = jax.make_jaxpr(lambda m, v: m(v)[0])(moe, x)
+    cap = moe._capacity(t)
+    dense_size = t * e * cap
+    biggest = 0
+    for eqn in jaxpr.jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if hasattr(v, "aval") and hasattr(v.aval, "size"):
+                biggest = max(biggest, int(v.aval.size))
+    # sparse path peak: [E*C, H] dispatch buffer / [N, H] gathers — orders
+    # of magnitude under the dense [T, E, C] tensor
+    assert biggest < dense_size / 100, (biggest, dense_size)
+
+
+def test_ep_alltoall_matches_single_device():
+    """shard_map all_to_all over the real ep axis == single device (fwd+bwd,
+    no drops)."""
+    pt.seed(0)
+    moe = MoELayer(hidden=16, intermediate=32, num_experts=8, k=2,
+                   capacity_factor=8.0, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 8, 16), jnp.float32)
+
+    ref, aux_ref = moe(x)
+
+    def loss(m, v):
+        y, aux = m(v)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    ref_loss, ref_grads = pt.value_and_grad(loss)(moe, x)
+
+    mesh = HybridMesh(ep=8)
+    with mesh:
+        xs = jax.device_put(x, mesh.batch_sharding())
+        out, aux = jax.jit(lambda m, v: m(v))(moe, xs)
+        got_loss, got_grads = jax.jit(pt.value_and_grad(loss))(moe, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_grads),
+                    jax.tree_util.tree_leaves(got_grads)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_dp_times_ep_matches_single_device():
+    """Tokens shard over dp AND ep; per-rank capacity accounts for both."""
+    pt.seed(0)
+    moe = MoELayer(hidden=16, intermediate=32, num_experts=4, k=2,
+                   capacity_factor=8.0, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(3).randn(8, 8, 16), jnp.float32)
+    ref, aux_ref = moe(x)
+    mesh = HybridMesh(dp=2, ep=2, devices=jax.devices()[:4])
+    with mesh:
+        xs = jax.device_put(x, mesh.batch_sharding())
+        out, aux = jax.jit(lambda m, v: m(v))(moe, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+
+
+def test_ep_saturation_reports_drops():
+    """Per-rank local capacity saturates -> drop_rate > 0 and finite out."""
+    pt.seed(0)
+    moe = MoELayer(hidden=8, intermediate=16, num_experts=4, k=2,
+                   capacity_factor=0.3, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16, 8), jnp.float32)
+    mesh = HybridMesh(ep=4, devices=jax.devices()[:4])
+    with mesh:
+        xs = jax.device_put(x, mesh.batch_sharding())
+        y, _, metrics = jax.jit(
+            lambda m, v: m(v, return_metrics=True))(moe, xs)
+    assert float(metrics["drop_rate"]) > 0.0
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_moe_llm_ep_times_tp_loss_equality():
+    """The full MoE LLM trains under ep x tp with loss EQUAL to single
+    device (attention tp-sharded, experts over the ep all_to_all)."""
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.moe_llm import MoEConfig, MoEForCausalLM
+
+    pt.seed(0)
+    cfg = MoEConfig(base=LlamaConfig.tiny(), num_experts=4, top_k=2,
+                    capacity_factor=8.0, moe_every=2)
+    model = MoEForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 64, (4, 16)))
+    labels = jnp.concatenate(
+        [ids[:, 1:], -100 * jnp.ones((4, 1), ids.dtype)], axis=1)
+
+    ref = float(model.loss(ids, labels))
+
+    from paddle_tpu.distributed import shard_module
+    mesh = HybridMesh(ep=2, tp=2, devices=jax.devices()[:4])
+    with mesh:
+        ms = shard_module(model, mesh, min_size=1)
+        ids_s = jax.device_put(ids, mesh.batch_sharding())
+        labels_s = jax.device_put(labels, mesh.batch_sharding())
+        got = float(jax.jit(lambda m, i, l: m.loss(i, l))(ms, ids_s, labels_s))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_sparse_dispatch_combine_roundtrip():
+    """identity experts -> combine(dispatch(x)) == sum_k gate * x = x."""
+    rs = np.random.RandomState(2)
+    t, h, e, cap = 32, 4, 4, 32
+    xt = jnp.asarray(rs.randn(t, h), jnp.float32)
+    logits = jnp.asarray(rs.randn(t, e), jnp.float32)
+    route, _, drop = top_k_route(logits, 2, cap)
+    assert float(drop) == 0.0
+    x_e, dest = sparse_dispatch(xt, route, e, cap)
+    yt = sparse_combine(x_e, route, dest, t)
+    np.testing.assert_allclose(np.asarray(yt), np.asarray(xt),
+                               rtol=1e-5, atol=1e-6)
