@@ -21,10 +21,12 @@ from jax import lax
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, scale, mask):
+def _block_attend(q, k, v, scale, mask, bias=None):
     """Scores for one (q_block, kv_block) pair in fp32.
     q: [B,Sq,H,D] k,v: [B,Sk,Hkv,D]; mask: bool, broadcastable to
     [B,H,Sq,Sk] (e.g. [1,1,Sq,Sk] causal or [B,1,Sq,Sk] varlen), or None.
+    ``bias``: ADDITIVE float scores (T5 relative bias / ALiBi),
+    broadcastable to [B,H,Sq,Sk]; applied after scaling, before the mask.
     GQA (Hkv < H) runs as a grouped einsum — repeated K/V is never
     materialised, so the ring rotates 1/rep the bytes."""
     b, sq, hq, d = q.shape
@@ -38,6 +40,8 @@ def _block_attend(q, k, v, scale, mask):
     else:
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # [B,H,Sq]
@@ -59,7 +63,7 @@ def _block_attend(q, k, v, scale, mask):
 
 def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
                    scale: float | None = None, window: int | None = None,
-                   kv_lens=None, attn_mask=None):
+                   kv_lens=None, attn_mask=None, attn_bias=None):
     """Blockwise ring attention with online-softmax accumulation.
 
     Equals full attention over the gathered sequence (see
@@ -73,6 +77,11 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     ``attn_mask``: [B, S_loc, S_global] bool — this rank's query rows vs
     ALL global key columns (the O(S^2/sp)-per-device general-mask path);
     each ring step slices the arriving block's column range.
+    ``attn_bias``: [B|1, H|1, S_loc, S_global] float ADDITIVE scores (T5
+    relative bias, ALiBi) — same row/column layout as ``attn_mask``, with
+    a broadcastable head dim; sliced per ring step like the mask. Must be
+    finite (use ``attn_mask`` to fully block positions). Differentiable —
+    d(bias) flows back through the per-step slices.
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
@@ -127,7 +136,12 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
                                             axis=2)  # [B, Sq, Sk]
             cols = cols[:, None]  # [B,1,Sq,Sk]
             block_mask = cols if block_mask is None else block_mask & cols
-        o_b, m_b, l_b = _block_attend(q, k_blk, v_blk, scale, block_mask)
+        bias_blk = None
+        if attn_bias is not None:
+            bias_blk = lax.dynamic_slice_in_dim(attn_bias, src * s_loc,
+                                                s_loc, axis=3)
+        o_b, m_b, l_b = _block_attend(q, k_blk, v_blk, scale, block_mask,
+                                      bias_blk)
         if causal:
             o_b = jnp.where(allowed, o_b, 0.0)
             m_b = jnp.where(allowed, m_b, _NEG_INF)
@@ -147,8 +161,19 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     return out.astype(q.dtype)
 
 
+def bias_spec(bias_shape, head_spec, batch_axes=("dp", "fsdp"),
+              rows_axis="sp"):
+    """PartitionSpec for a [B|1, H|1, Sq, Sk] additive bias: shard only the
+    non-broadcast dims (a size-1 batch/head dim must stay replicated)."""
+    from jax.sharding import PartitionSpec as P
+    b_ax = batch_axes if bias_shape[0] > 1 else None
+    h_ax = head_spec if bias_shape[1] > 1 else None
+    return P(b_ax, h_ax, rows_axis, None)
+
+
 def make_ring_attention(mesh, causal=True, head_spec=None, window=None,
-                        varlen=False, masked=False):
+                        varlen=False, masked=False, bias_shape=None,
+                        scale=None):
     """shard_map-wrapped ring attention: global [B, S, H, D] with S sharded
     over sp; drop-in replacement for full attention. ``head_spec="tp"``
     composes with tensor parallelism (heads stay tp-sharded through the
@@ -156,7 +181,10 @@ def make_ring_attention(mesh, causal=True, head_spec=None, window=None,
     applies a global causal sliding window (Mistral).
     ``varlen=True``: attend(q, k, v, kv_lens) with [B] global key lengths.
     ``masked=True``: attend(..., attn_mask) with a [B, S, S] bool mask
-    (sharded on q rows); combine with varlen by passing both in order."""
+    (sharded on q rows); combine with varlen by passing both in order.
+    ``bias_shape``: pass the [B|1, H|1, S, S] shape of an ADDITIVE float
+    bias (T5 relative bias, ALiBi) to accept it as the last argument —
+    q rows sharded over sp, head dim over ``head_spec`` when per-head."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -167,6 +195,8 @@ def make_ring_attention(mesh, causal=True, head_spec=None, window=None,
     if masked:
         # [B, S, S_global]: q rows sharded over sp, key columns replicated
         in_specs.append(P(("dp", "fsdp"), "sp", None))
+    if bias_shape is not None:
+        in_specs.append(bias_spec(bias_shape, head_spec))
 
     @functools.partial(shard_map, mesh=mesh.mesh,
                        in_specs=tuple(in_specs), out_specs=spec)
@@ -174,8 +204,10 @@ def make_ring_attention(mesh, causal=True, head_spec=None, window=None,
         it = iter(extra)
         lens = next(it) if varlen else None
         mask = next(it) if masked else None
+        bias = next(it) if bias_shape is not None else None
         return ring_attention(q, k, v, axis_name="sp", causal=causal,
-                              window=window, kv_lens=lens, attn_mask=mask)
+                              scale=scale, window=window, kv_lens=lens,
+                              attn_mask=mask, attn_bias=bias)
 
     return attend
 

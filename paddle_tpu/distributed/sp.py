@@ -1,0 +1,36 @@
+"""One-call sequence-parallel attention dispatch shared by the models.
+
+Both LLaMA-family attention and T5 route their sp path through here so the
+ring/Ulysses selection, the optional-(mask, bias) argument assembly, and
+future dispatch-contract changes live in ONE place (models keep only their
+own mask normalisation).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def sp_attention(mesh, mode: str, q, k, v, *, causal=True, scale=None,
+                 window=None, head_spec=None, attn_mask=None,
+                 attn_bias=None):
+    """Run [B, S, H, D] attention with S sharded over sp via ``mode``
+    ("ring" | "ulysses"). ``attn_mask``: [B, S, S] bool over global
+    positions; ``attn_bias``: [B|1, H|1, S, S] float additive scores."""
+    kwargs = dict(causal=causal, scale=scale, window=window,
+                  head_spec=head_spec, masked=attn_mask is not None,
+                  bias_shape=None if attn_bias is None else attn_bias.shape)
+    if mode == "ring":
+        from paddle_tpu.distributed.ring_attention import (
+            make_ring_attention as make)
+    elif mode == "ulysses":
+        from paddle_tpu.distributed.ulysses import (
+            make_ulysses_attention as make)
+    else:
+        raise ValueError(f"unknown sequence_parallel mode {mode!r}")
+    attend = make(mesh, **kwargs)
+    args = (q, k, v)
+    if attn_mask is not None:
+        args += (attn_mask,)
+    if attn_bias is not None:
+        args += (attn_bias.astype(jnp.float32),)
+    return attend(*args)

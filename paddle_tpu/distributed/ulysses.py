@@ -23,7 +23,8 @@ from paddle_tpu.ops import attention as A
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
-                      scale=None, window=None, kv_lens=None, attn_mask=None):
+                      scale=None, window=None, kv_lens=None, attn_mask=None,
+                      attn_bias=None):
     """Attention over the full sequence with inputs sequence-sharded on
     ``axis_name``. [B, S_local, H, D] in and out; H must divide by the axis
     size. Call inside shard_map.
@@ -32,7 +33,11 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     the inner attention after the head-scatter, so the fused kernel's
     varlen path still runs. ``attn_mask``: [B, S, S] bool over GLOBAL
     positions, replicated (after the all_to_all every member holds the full
-    sequence for its head slice, so the full mask is needed anyway)."""
+    sequence for its head slice, so the full mask is needed anyway).
+    ``attn_bias``: [B|1, H_local|1, S, S] float ADDITIVE scores (T5
+    relative bias, ALiBi) for THIS member's post-exchange head slice —
+    ``make_ulysses_attention`` shards a global per-head bias over
+    (tp, sp) so the slice lines up with the heads the all_to_all assigns."""
     sp = lax.axis_size(axis_name)
     if q.shape[2] % sp != 0:
         raise ValueError(
@@ -64,6 +69,11 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     mask = attn_mask[:, None] if attn_mask is not None else None  # [B,1,S,S]
+    if attn_bias is not None:
+        # merge additive bias with any bool mask: the XLA attention core
+        # takes ONE attn_mask, so fold blocks into the bias as -inf
+        bias = attn_bias.astype(jnp.float32)
+        mask = bias if mask is None else jnp.where(mask, bias, -1e30)
     # window works unchanged: after the all_to_all the inner attention sees
     # the FULL sequence (global positions intact), so the sliding window is
     # exactly the single-device banded computation on a head slice
@@ -78,7 +88,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
 def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp",
                            head_spec=None, batch_axes=("dp", "fsdp"),
                            window: int | None = None,
-                           varlen: bool = False, masked: bool = False):
+                           varlen: bool = False, masked: bool = False,
+                           bias_shape=None, scale=None):
     """Bind ulysses_attention onto a HybridMesh via shard_map: takes/returns
     [B, S, H, D] arrays sequence-sharded over ``axis_name``; batch sharded
     over ``batch_axes``; ``head_spec="tp"`` composes with tensor
@@ -86,7 +97,11 @@ def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp",
     local heads must divide by sp * tp).
     ``varlen=True``: attend(q, k, v, kv_lens) with [B] key lengths.
     ``masked=True``: attend(..., attn_mask) with [B, S, S] bool (replicated
-    over sp — the head-sharded inner attention needs the whole mask)."""
+    over sp — the head-sharded inner attention needs the whole mask).
+    ``bias_shape``: shape of a [B|1, H|1, S, S] ADDITIVE float bias passed
+    as the last argument. A per-head bias is sharded over (tp, sp) on the
+    head dim — tp-major, sp-minor, exactly the head range device
+    (tp_j, sp_i) ends up computing after the all_to_all."""
     from jax import shard_map
 
     spec = P(batch_axes, axis_name, head_spec, None)
@@ -95,13 +110,21 @@ def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp",
         in_specs.append(P(batch_axes))
     if masked:
         in_specs.append(P(batch_axes, None, None))
+    if bias_shape is not None:
+        from paddle_tpu.distributed.ring_attention import bias_spec
+        in_specs.append(bias_spec(
+            bias_shape,
+            (head_spec, axis_name) if head_spec else (axis_name,),
+            batch_axes=batch_axes, rows_axis=None))
 
     def fn(q, k, v, *extra):
         it = iter(extra)
         lens = next(it) if varlen else None
         mask = next(it) if masked else None
+        bias = next(it) if bias_shape is not None else None
         return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal,
-                                 window=window, kv_lens=lens, attn_mask=mask)
+                                 scale=scale, window=window, kv_lens=lens,
+                                 attn_mask=mask, attn_bias=bias)
 
     return shard_map(fn, mesh=mesh.mesh, in_specs=tuple(in_specs),
                      out_specs=spec, check_vma=False)

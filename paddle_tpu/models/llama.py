@@ -120,53 +120,46 @@ class LlamaAttention(Module):
             from paddle_tpu.distributed.mesh import current_mesh
             mesh = current_mesh()
             if mesh is not None and mesh.size("sp") > 1:
-                # normalise attn_mask to [B, S, S] bool over global
-                # positions (both sp paths consume that form); a [B, S]
-                # or [B,1,1,S] key-padding mask broadcasts to rows. The sp
-                # paths are BOOLEAN-mask only: a float additive mask may be
-                # a soft bias (ALiBi-style), which cannot ride them without
-                # silently hardening — raise rather than diverge from the
-                # non-sp path; per-head masks have no [B,S,S] form either.
+                # normalise attn_mask into one of the two sp-path forms:
+                #   mask3: [B, S, S] bool over global positions (boolean
+                #     masks; [B, S] / [B,1,1,S] key padding broadcasts)
+                #   bias4: [B|1, H|1, S, S] float ADDITIVE scores — soft
+                #     biases (ALiBi/T5 relative bias) AND per-head bool
+                #     masks (folded to 0/-inf), which have no [B,S,S] form
                 mask3 = None
+                bias4 = None
+                s_full = q.shape[1]
                 if attn_mask is not None:
                     m = attn_mask
-                    if m.ndim == 4 and m.shape[1] > 1:
-                        raise NotImplementedError(
-                            "per-head attn_mask is not supported under "
-                            "sequence_parallel (needs [B,S,S]); use "
-                            "sequence_parallel=None")
-                    if jnp.issubdtype(m.dtype, jnp.floating):
-                        raise NotImplementedError(
-                            "additive float attn_mask under "
-                            "sequence_parallel would be silently hardened "
-                            "to allow/block; pass a BOOLEAN mask, or use "
-                            "sequence_parallel=None for soft biases")
-                    m = m.astype(bool)
-                    s_full = q.shape[1]
-                    if m.ndim == 4:
-                        m = m[:, 0]          # [B,(1|S),S]
-                    elif m.ndim == 2:
-                        m = m[:, None, :]    # key padding -> rows
-                    if m.shape[1] == 1:
-                        m = jnp.broadcast_to(m, (m.shape[0], s_full, s_full))
-                    mask3 = m
+                    per_head = m.ndim == 4 and m.shape[1] > 1
+                    if jnp.issubdtype(m.dtype, jnp.floating) or per_head:
+                        if m.dtype == jnp.bool_:
+                            m = jnp.where(m, 0.0, -1e30)
+                        m = m.astype(jnp.float32)
+                        if m.ndim == 2:
+                            m = m[None, None]      # [S,S] or [1,S] rows
+                        elif m.ndim == 3:
+                            m = m[:, None]         # [B,S,S] -> [B,1,S,S]
+                        if m.shape[2] == 1:        # broadcast rows to S
+                            m = jnp.broadcast_to(
+                                m, m.shape[:2] + (s_full, m.shape[3]))
+                        bias4 = m
+                    else:
+                        m = m.astype(bool)
+                        if m.ndim == 4:
+                            m = m[:, 0]          # [B,(1|S),S]
+                        elif m.ndim == 2:
+                            m = m[:, None, :]    # key padding -> rows
+                        if m.shape[1] == 1:
+                            m = jnp.broadcast_to(
+                                m, (m.shape[0], s_full, s_full))
+                        mask3 = m
+                from paddle_tpu.distributed.sp import sp_attention
                 head_spec = "tp" if mesh.size("tp") > 1 else None
-                if self.sequence_parallel == "ring":
-                    from paddle_tpu.distributed.ring_attention import (
-                        make_ring_attention)
-                    attend = make_ring_attention(mesh, causal=True,
-                                                 head_spec=head_spec,
-                                                 window=self.window,
-                                                 masked=mask3 is not None)
-                else:
-                    from paddle_tpu.distributed.ulysses import (
-                        make_ulysses_attention)
-                    attend = make_ulysses_attention(mesh, causal=True,
-                                                    head_spec=head_spec,
-                                                    window=self.window,
-                                                    masked=mask3 is not None)
-                args = (q, k, v) if mask3 is None else (q, k, v, mask3)
-                return attend(*args)
+                return sp_attention(mesh, self.sequence_parallel, q, k, v,
+                                    causal=True, window=self.window,
+                                    head_spec=head_spec, attn_mask=mask3,
+                                    attn_bias=bias4)
         return F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=True,
             training=self.training, window=self.window)

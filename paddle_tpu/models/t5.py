@@ -38,6 +38,10 @@ class T5Config:
     dtype: object = None
     pad_token_id: int = 0
     decoder_start_token_id: int = 0
+    # "ring" | "ulysses": self-attention over an sp-sharded sequence; the
+    # LEARNED relative position bias rides the sp additive-bias path
+    # (cross-attention stays local — mismatched q/k lengths)
+    sequence_parallel: str | None = None
 
     def __post_init__(self):
         if self.dtype is None:
@@ -106,6 +110,7 @@ class T5Attention(Module):
         self.bidirectional = bidirectional
         self.num_buckets = cfg.relative_attention_num_buckets
         self.max_distance = cfg.relative_attention_max_distance
+        self.sequence_parallel = cfg.sequence_parallel
 
     def position_bias(self, q_len, k_len):
         if self.rel_bias is None:
@@ -125,6 +130,24 @@ class T5Attention(Module):
         q = (x @ self.q).reshape(b, s, h, dkv)
         k = (src @ self.k).reshape(b, sk, h, dkv)
         v = (src @ self.v).reshape(b, sk, h, dkv)
+        # sequence parallelism (self-attention only: cross-attention has
+        # mismatched q/k lengths and stays local) — the relative position
+        # bias rides the sp ADDITIVE-BIAS path, T5's unscaled scores via
+        # scale=1.0
+        if self.sequence_parallel in ("ring", "ulysses") and kv is None:
+            from paddle_tpu.distributed.mesh import current_mesh
+            mesh = current_mesh()
+            if mesh is not None and mesh.size("sp") > 1:
+                from paddle_tpu.distributed.sp import sp_attention
+                mask3 = None
+                if mask is not None:
+                    mask3 = jnp.broadcast_to(
+                        mask.astype(bool)[:, None, :], (b, s, sk))
+                out = sp_attention(mesh, self.sequence_parallel, q, k, v,
+                                   causal=causal, scale=1.0,
+                                   attn_mask=mask3,
+                                   attn_bias=position_bias)
+                return out.reshape(b, s, h * dkv) @ self.o
         # T5: NO 1/sqrt(d) scaling (folded into init)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
         if position_bias is not None:

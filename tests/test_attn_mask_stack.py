@@ -145,10 +145,10 @@ def test_llama_ring_with_attn_mask():
                                rtol=2e-3, atol=2e-4)
 
 
-def test_llama_sp_bool_broadcast_mask_and_float_raises():
-    """A [B,1,1,S] BOOL key-padding mask broadcasts through the sp dispatch;
-    a float additive mask raises (it could be a soft bias, which the
-    boolean sp paths would silently harden — code-review r2)."""
+def test_llama_sp_bool_broadcast_mask_and_float_bias():
+    """A [B,1,1,S] BOOL key-padding mask broadcasts through the sp
+    dispatch; float additive and per-head masks ride the sp BIAS path
+    (VERDICT r2 item 5 — they used to raise) and match the non-sp model."""
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     pt.seed(0)
@@ -177,16 +177,124 @@ def test_llama_sp_bool_broadcast_mask_and_float_raises():
                                np.asarray(ref * valid_q),
                                rtol=2e-3, atol=2e-4)
 
+    # float additive mask: sp bias path == non-sp additive path
     additive = jnp.where(keep, 0.0, -1e9)[:, None, None, :]
+    ref_add = model(ids, attn_mask=additive)
     with mesh:
-        with pytest.raises(NotImplementedError):
-            model_sp(ids, attn_mask=additive)
-    # per-head masks also raise rather than collapsing to head 0
+        got_add = model_sp(ids, attn_mask=additive)
+    np.testing.assert_allclose(np.asarray(got_add * valid_q),
+                               np.asarray(ref_add * valid_q),
+                               rtol=2e-3, atol=2e-4)
+    # per-head bool mask: folded to 0/-inf additive, same result per head
     per_head = jnp.broadcast_to(keep[:, None, None, :],
                                 (b, cfg.num_attention_heads, s, s))
+    ref_ph = model(ids, attn_mask=per_head)
     with mesh:
-        with pytest.raises(NotImplementedError):
-            model_sp(ids, attn_mask=per_head)
+        got_ph = model_sp(ids, attn_mask=per_head)
+    np.testing.assert_allclose(np.asarray(got_ph * valid_q),
+                               np.asarray(ref_ph * valid_q),
+                               rtol=2e-3, atol=2e-4)
+
+
+def _alibi_bias(h, s):
+    """[1, H, S, S] ALiBi: -slope_h * (i - j), the classic per-head bias."""
+    slopes = 2.0 ** (-np.arange(1, h + 1) / 2.0)
+    i = np.arange(s)[:, None]
+    j = np.arange(s)[None, :]
+    return jnp.asarray(-slopes[None, :, None, None]
+                       * (i - j)[None, None], jnp.float32)
+
+
+def test_ring_additive_per_head_bias_fwd_and_grads():
+    """Ring attention with an ALiBi/T5-style additive per-head bias ==
+    full attention; grads (incl. d(bias) — T5's bias is LEARNED) match."""
+    b, s, h, d = 2, 32, 4, 8
+    rs = np.random.RandomState(7)
+    q, k, v = _qkv(rs, b, s, h, d)
+    bias = _alibi_bias(h, s)
+
+    ref = xla_attention(q, k, v, attn_mask=bias, is_causal=True)
+    ref_g = jax.grad(lambda q, k, v, bi: jnp.sum(
+        xla_attention(q, k, v, attn_mask=bi, is_causal=True) ** 2),
+        argnums=(0, 1, 2, 3))(q, k, v, bias)
+
+    mesh = HybridMesh(sp=8)
+    with mesh:
+        attend = make_ring_attention(mesh, causal=True,
+                                     bias_shape=bias.shape)
+        out = attend(q, k, v, bias)
+        got_g = jax.grad(lambda q, k, v, bi: jnp.sum(
+            attend(q, k, v, bi) ** 2), argnums=(0, 1, 2, 3))(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    for r, g in zip(ref_g, got_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ring_bias_composes_with_bool_mask_and_gqa():
+    """Additive bias + dense bool mask + GQA heads through the ring."""
+    b, s, h, d = 2, 16, 4, 4
+    rs = np.random.RandomState(8)
+    q, k, v = _qkv(rs, b, s, h, d, hkv=2)
+    bias = _alibi_bias(h, s)
+    mask = jnp.asarray(rs.rand(b, s, s) > 0.3) | jnp.eye(s, dtype=bool)[None]
+    causal = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    ref_mask = jnp.where(mask[:, None] & causal, bias, -1e30)
+    ref = xla_attention(q, k, v, attn_mask=ref_mask)
+
+    mesh = HybridMesh(sp=8)
+    with mesh:
+        attend = make_ring_attention(mesh, causal=True, masked=True,
+                                     bias_shape=bias.shape)
+        out = attend(q, k, v, mask, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_additive_per_head_bias_fwd_and_grads():
+    """Ulysses with a per-head additive bias: the bias head dim shards
+    over sp to match the post-all_to_all head slice; fwd + grads parity."""
+    b, s, h, d = 2, 32, 8, 4
+    rs = np.random.RandomState(9)
+    q, k, v = _qkv(rs, b, s, h, d)
+    bias = _alibi_bias(h, s)
+
+    ref = xla_attention(q, k, v, attn_mask=bias, is_causal=True)
+    ref_g = jax.grad(lambda q, k, v, bi: jnp.sum(
+        xla_attention(q, k, v, attn_mask=bi, is_causal=True) ** 2),
+        argnums=(0, 1, 2, 3))(q, k, v, bias)
+
+    mesh = HybridMesh(sp=8)
+    with mesh:
+        attend = make_ulysses_attention(mesh, causal=True,
+                                        bias_shape=bias.shape)
+        out = attend(q, k, v, bias)
+        got_g = jax.grad(lambda q, k, v, bi: jnp.sum(
+            attend(q, k, v, bi) ** 2), argnums=(0, 1, 2, 3))(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    for r, g in zip(ref_g, got_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ulysses_per_head_bias_composes_with_tp():
+    """tp x sp: bias heads shard (tp-major, sp-minor) to exactly the head
+    range each device computes after the all_to_all."""
+    b, s, h, d = 2, 16, 8, 4
+    rs = np.random.RandomState(10)
+    q, k, v = _qkv(rs, b, s, h, d)
+    bias = _alibi_bias(h, s)
+    ref = xla_attention(q, k, v, attn_mask=bias, is_causal=True)
+
+    mesh = HybridMesh(tp=2, sp=4)
+    with mesh:
+        attend = make_ulysses_attention(mesh, causal=True, head_spec="tp",
+                                        bias_shape=bias.shape)
+        out = attend(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
 
 
 def test_bert_varlen_matches_dense_mask():

@@ -103,3 +103,35 @@ def test_ulysses_matches_full_attention():
         got = fn(q, k, v)
     assert np.allclose(np.asarray(got), np.asarray(want), atol=2e-5), \
         np.abs(np.asarray(got) - np.asarray(want)).max()
+
+
+@pytest.mark.parametrize("sp_mode,sp", [("ulysses", 4), ("ring", 8)])
+def test_t5_relative_bias_over_sequence_parallel(sp_mode, sp):
+    """Full T5 (encoder + causal decoder self-attn) under sp: the LEARNED
+    relative position bias rides the additive-bias path; loss AND grads
+    (incl. d(rel_bias)) equal the single-device model (VERDICT r2 item 5)."""
+    from paddle_tpu.distributed import HybridMesh
+
+    pt.seed(0)
+    model = T5ForConditionalGeneration(T5Config.tiny())
+    rs = np.random.RandomState(3)
+    src = jnp.asarray(rs.randint(1, 256, (2, 32)))
+    labels = jnp.asarray(rs.randint(1, 256, (2, 32)))
+    amask = jnp.asarray([[1] * 32, [1] * 25 + [0] * 7], jnp.int32)
+
+    def loss_fn(m):
+        return m.loss(src, labels, attention_mask=amask)
+
+    ref_loss, ref_grads = pt.value_and_grad(loss_fn)(model)
+
+    pt.seed(0)
+    model_sp = T5ForConditionalGeneration(
+        T5Config.tiny(sequence_parallel=sp_mode))
+    mesh = HybridMesh(sp=sp, devices=jax.devices()[:sp])
+    with mesh:
+        got_loss, got_grads = jax.jit(pt.value_and_grad(loss_fn))(model_sp)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=2e-5)
+    for r, g in zip(jax.tree_util.tree_leaves(ref_grads),
+                    jax.tree_util.tree_leaves(got_grads)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-3, atol=2e-5)
