@@ -247,6 +247,44 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=None,
     return run(model, input_ids, cache, rng)
 
 
+def beam_select(running_lp, seqs, fin_seqs, fin_scores, logp, i,
+                prompt_len, eos_token_id, length_penalty):
+    """One beam expansion: place token i, split 2K candidates into
+    finished (eos) and running pools. Shapes: running_lp/fin_scores
+    [B, K], seqs/fin_seqs [B, K, L], logp [B, K, V]. Shared by the
+    static-cache beam_search AND the paged beam (models/paged.py) so
+    their selection math can never drift apart."""
+    b, K = running_lp.shape
+    V = logp.shape[-1]
+    NEG = jnp.float32(-1e9)
+    total = running_lp[:, :, None] + logp  # [B, K, V]
+    cand_lp, cand_idx = lax.top_k(total.reshape(b, K * V), 2 * K)
+    beam = cand_idx // V  # [B, 2K]
+    tok = cand_idx % V
+    cand_seqs = jnp.take_along_axis(seqs, beam[:, :, None], axis=1)
+    cand_seqs = cand_seqs.at[:, :, prompt_len + i].set(tok)
+
+    if eos_token_id is not None:
+        is_eos = tok == eos_token_id
+    else:
+        is_eos = jnp.zeros_like(tok, bool)
+    # finished pool: merge newly-finished candidates, keep top K
+    cand_score = cand_lp / ((i + 1.0) ** length_penalty)
+    all_scores = jnp.concatenate(
+        [fin_scores, jnp.where(is_eos, cand_score, NEG)], axis=1)
+    all_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)
+    fin_scores, fin_idx = lax.top_k(all_scores, K)
+    fin_seqs = jnp.take_along_axis(all_seqs, fin_idx[:, :, None], axis=1)
+
+    # running pool: best K non-eos candidates
+    run_lp_cand = jnp.where(is_eos, NEG, cand_lp)
+    running_lp, run_idx = lax.top_k(run_lp_cand, K)
+    seqs = jnp.take_along_axis(cand_seqs, run_idx[:, :, None], axis=1)
+    new_beam = jnp.take_along_axis(beam, run_idx, axis=1)  # [B, K]
+    new_tok = jnp.take_along_axis(tok, run_idx, axis=1)
+    return running_lp, seqs, fin_seqs, fin_scores, new_beam, new_tok
+
+
 def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
                 length_penalty=1.0, eos_token_id=None):
     """Beam search with a beam-gathered KV cache (ref: PaddleNLP
@@ -299,34 +337,9 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
         fin_scores = jnp.full((b, K), NEG)
 
         def select(running_lp, seqs, fin_seqs, fin_scores, logp, i):
-            """One beam expansion: place token i, split candidates into
-            finished (eos) and running pools."""
-            total = running_lp[:, :, None] + logp  # [B, K, V]
-            cand_lp, cand_idx = lax.top_k(total.reshape(b, K * V), 2 * K)
-            beam = cand_idx // V  # [B, 2K]
-            tok = cand_idx % V
-            cand_seqs = jnp.take_along_axis(seqs, beam[:, :, None], axis=1)
-            cand_seqs = cand_seqs.at[:, :, prompt_len + i].set(tok)
-
-            if eos_token_id is not None:
-                is_eos = tok == eos_token_id
-            else:
-                is_eos = jnp.zeros_like(tok, bool)
-            # finished pool: merge newly-finished candidates, keep top K
-            cand_score = cand_lp / ((i + 1.0) ** length_penalty)
-            all_scores = jnp.concatenate(
-                [fin_scores, jnp.where(is_eos, cand_score, NEG)], axis=1)
-            all_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)
-            fin_scores, fin_idx = lax.top_k(all_scores, K)
-            fin_seqs = jnp.take_along_axis(all_seqs, fin_idx[:, :, None], axis=1)
-
-            # running pool: best K non-eos candidates
-            run_lp_cand = jnp.where(is_eos, NEG, cand_lp)
-            running_lp, run_idx = lax.top_k(run_lp_cand, K)
-            seqs = jnp.take_along_axis(cand_seqs, run_idx[:, :, None], axis=1)
-            new_beam = jnp.take_along_axis(beam, run_idx, axis=1)  # [B, K]
-            new_tok = jnp.take_along_axis(tok, run_idx, axis=1)
-            return running_lp, seqs, fin_seqs, fin_scores, new_beam, new_tok
+            return beam_select(running_lp, seqs, fin_seqs, fin_scores,
+                               logp, i, prompt_len, eos_token_id,
+                               length_penalty)
 
         def step(carry, i):
             running_lp, seqs, fin_seqs, fin_scores, cache, logp = carry

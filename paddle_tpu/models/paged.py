@@ -110,6 +110,53 @@ class BlockManager:
         return jnp.asarray(out)
 
 
+class RefBlockManager(BlockManager):
+    """BlockManager + refcounts: beams FORK a sequence by sharing its full
+    (immutable — the pool is append-only) blocks and privately copying only
+    the partial last block. The reference's block-attention serving keeps
+    the same share/copy split for beams (vLLM-style copy-on-write, but
+    append-only KV means ONLY the tail block can ever need the copy)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        super().__init__(num_blocks, block_size)
+        self._rc: dict[int, int] = {}
+
+    def allocate(self, seq_id, n_tokens):
+        before = set(self.tables.get(seq_id, []))
+        table = super().allocate(seq_id, n_tokens)
+        for blk in table:
+            if blk not in before:
+                self._rc[blk] = 1
+        return table
+
+    def fork(self, src_id, dst_id, n_tokens: int):
+        """dst shares src's blocks; if the last block is partial (n_tokens
+        not block-aligned) dst gets a PRIVATE fresh block for it. Returns
+        (src_blk, dst_blk) to copy on device, or None."""
+        src = self.tables[src_id]
+        table = list(src)
+        copy = None
+        partial = n_tokens % self.block_size != 0 and table
+        for blk in (table[:-1] if partial else table):
+            self._rc[blk] += 1
+        if partial:
+            if not self._free:
+                raise MemoryError("paged cache out of blocks for beam fork")
+            fresh = self._free.pop()
+            self._rc[fresh] = 1
+            copy = (table[-1], fresh)
+            table[-1] = fresh
+        self.tables[dst_id] = table
+        return copy
+
+    def free(self, seq_id):
+        for blk in self.tables.pop(seq_id, []):
+            self._rc[blk] -= 1
+            if self._rc[blk] == 0:
+                del self._rc[blk]
+                self._free.append(blk)
+
+
 def _rope_rows(positions, head_dim, base, scaling=None):
     """cos/sin for PER-ROW positions: [B] -> [B, 1, 1, D/2] (ragged decode:
     every sequence sits at a different position). Shares the scaling math
@@ -150,12 +197,21 @@ def _scatter_decode(pool, vals, tables, lens, active, num_blocks, block_size):
     return pool.at[blk, off].set(vals[:, 0], mode="drop")
 
 
-def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache):
+def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
+                        slot_ids=None, table_rows=None):
     """Prefill padded ragged prompts [B, S]; returns (last_logits, cache).
 
     Attention runs the padded-varlen path (kv_lens) — the fused kernel on
     TPU; K/V of every valid position is scattered into the block pool.
-    ``last_logits`` are taken at each row's LAST VALID position."""
+    ``last_logits`` are taken at each row's LAST VALID position.
+
+    MID-FLIGHT ADMISSION (the continuous-batching engine): with
+    ``slot_ids`` [A] + ``table_rows`` [A, max_blocks], the A prompt rows
+    are written into cache SLOTS ``slot_ids`` (their new block-table rows
+    installed on device) while every other slot's pools/tables/lens stay
+    untouched — so prefill of admitted requests interleaves with decode of
+    in-flight ones. Padding rows use slot_id >= num_slots (scatter-drop)
+    and prompt_len 0."""
     cfg = model.cfg
     if getattr(cfg, "fp8", False):
         raise NotImplementedError(
@@ -164,6 +220,16 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache):
             "model with fp8=False weights, or use weight-only quantization")
     b, s = input_ids.shape
     nb, bs = cache.num_blocks, cache.block_size
+    prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    if slot_ids is None:
+        tables = cache.block_tables          # row i == slot i (legacy)
+        new_lens = prompt_lens
+        new_tables = cache.block_tables
+    else:
+        slot_ids = jnp.asarray(slot_ids, jnp.int32)
+        tables = jnp.asarray(table_rows, jnp.int32)   # [A, max_blocks]
+        new_tables = cache.block_tables.at[slot_ids].set(tables, mode="drop")
+        new_lens = cache.lens.at[slot_ids].set(prompt_lens, mode="drop")
     x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
     cos, sin = A.rope_cos_sin(s, d, base=cfg.rope_theta,
@@ -184,21 +250,18 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache):
         out = A.scaled_dot_product_attention(q, k, v, is_causal=True,
                                              kv_lens=prompt_lens,
                                              window=getattr(cfg, "sliding_window", None))
-        k_pools.append(_scatter_prefill(cache.k_pools[li], k,
-                                        cache.block_tables, prompt_lens,
-                                        nb, bs))
-        v_pools.append(_scatter_prefill(cache.v_pools[li], v,
-                                        cache.block_tables, prompt_lens,
-                                        nb, bs))
+        k_pools.append(_scatter_prefill(cache.k_pools[li], k, tables,
+                                        prompt_lens, nb, bs))
+        v_pools.append(_scatter_prefill(cache.v_pools[li], v, tables,
+                                        prompt_lens, nb, bs))
         x = x + _wo(out.reshape(b, s, nh * hd), att.o_proj)
         x = x + lyr.mlp(lyr.post_attention_layernorm(x))
     x = model.model.norm(x)
     logits = model.logits(x)
     last = jnp.take_along_axis(
-        logits, (prompt_lens - 1)[:, None, None].astype(jnp.int32), axis=1
-    )[:, 0]
-    new_cache = PagedKVCache(k_pools, v_pools, cache.block_tables,
-                             prompt_lens.astype(jnp.int32))
+        logits, jnp.maximum(prompt_lens - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    new_cache = PagedKVCache(k_pools, v_pools, new_tables, new_lens)
     return last, new_cache
 
 
@@ -246,10 +309,166 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
                                 new_lens)
 
 
+def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
+                      upd_rows, upd_cols, upd_vals, rng,
+                      temperature=0.0, top_k=None, top_p=None):
+    """ONE fused serving tick: apply incremental block-table updates
+    (``tables[upd_rows[i], upd_cols[i]] = upd_vals[i]``, sentinel rows
+    dropped — no host-side table rebuild/re-upload), run the decode step,
+    and sample the next token ON DEVICE. The only per-tick host traffic is
+    the [B] sampled-token fetch the engine needs for streaming/EOS."""
+    from paddle_tpu.models.decoding import _sample
+    tables = cache.block_tables.at[upd_rows, upd_cols].set(upd_vals,
+                                                           mode="drop")
+    cache = PagedKVCache(cache.k_pools, cache.v_pools, tables, cache.lens)
+    logits, cache = llama_decode_step_paged(model, tokens, cache, active)
+    nxt = _sample(logits.astype(jnp.float32), rng, temperature, top_k, top_p)
+    nxt = jnp.where(active, nxt.astype(jnp.int32), tokens)
+    return nxt, cache
+
+
 # module-level jit wrappers: their compile caches persist across
 # paged_generate calls (a per-call jax.jit would recompile every request)
 _PREFILL_JIT = jax.jit(llama_prefill_paged)
 _DECODE_JIT = jax.jit(llama_decode_step_paged)
+_TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(8, 9, 10),
+                    donate_argnums=(2,))
+
+
+def _beam_cache_update(cache: PagedKVCache, new_tables, copy_src, copy_dst):
+    """Apply a beam reorder to the paged cache: install the forked block
+    tables and copy the (at most one per beam) private partial blocks.
+    copy_src/copy_dst: [K] block ids, sentinel num_blocks = no copy."""
+    k_pools = [p.at[copy_dst].set(p[jnp.clip(copy_src, 0, p.shape[0] - 1)],
+                                  mode="drop") for p in cache.k_pools]
+    v_pools = [p.at[copy_dst].set(p[jnp.clip(copy_src, 0, p.shape[0] - 1)],
+                                  mode="drop") for p in cache.v_pools]
+    return PagedKVCache(k_pools, v_pools, new_tables, cache.lens)
+
+
+def _beam_select(running_lp, seqs, fin_seqs, fin_scores, logp, i,
+                 prompt_len, eos_token_id, length_penalty):
+    """b=1 adapter over decoding.beam_select — ONE shared implementation,
+    so paged beam == static beam exactly by construction."""
+    from paddle_tpu.models.decoding import beam_select
+    out = beam_select(running_lp[None], seqs[None], fin_seqs[None],
+                      fin_scores[None], logp[None], i, prompt_len,
+                      eos_token_id, length_penalty)
+    return tuple(x[0] for x in out)
+
+
+_BEAM_SELECT_JIT = jax.jit(_beam_select, static_argnums=(6, 7, 8))
+_BEAM_UPDATE_JIT = jax.jit(_beam_cache_update, donate_argnums=(0,))
+
+
+def paged_beam_search(model, prompt, max_new_tokens=32, num_beams=4,
+                      length_penalty=1.0, eos_token_id=None,
+                      block_size=16, num_blocks=None):
+    """Beam search IN THE PAGED PATH (single prompt, K beams as cache
+    slots). Prompt blocks are SHARED across beams via refcounts
+    (RefBlockManager); each reorder forks the parents' tables and copies
+    only the private partial tail block — the append-only-pool
+    copy-on-write. Selection math mirrors ``decoding.beam_search`` so the
+    result equals the static-cache beam exactly.
+
+    Returns (best_sequence [prompt+max_new], best_score).
+    """
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    s = len(prompt)
+    cfg = model.cfg
+    K = num_beams
+    max_len = s + max_new_tokens
+    max_blocks = -(-max_len // block_size)
+    if num_blocks is None:
+        num_blocks = K * max_blocks
+    mgr = RefBlockManager(num_blocks, block_size)
+    cache = PagedKVCache.init(cfg.num_hidden_layers, num_blocks, block_size,
+                              cfg.num_key_value_heads,
+                              cfg.hidden_size // cfg.num_attention_heads,
+                              K, max_blocks, cfg.dtype)
+
+    # prefill once into beam 0's blocks, then fork the other beams
+    sid = {j: j for j in range(K)}          # beam j -> mgr sequence id
+    next_sid = K
+    mgr.allocate(0, s)
+    rows = np.full((K, max_blocks), num_blocks, np.int32)
+    copy_src = np.full(K, num_blocks, np.int32)
+    copy_dst = np.full(K, num_blocks, np.int32)
+    for j in range(1, K):
+        pair = mgr.fork(0, j, s)
+        if pair is not None:
+            copy_src[j], copy_dst[j] = pair
+    for j in range(K):
+        t = mgr.tables[j]
+        rows[j, :len(t)] = t
+
+    logits, cache = _PREFILL_JIT(
+        model, jnp.asarray(prompt[None, :]), jnp.asarray([s], jnp.int32),
+        cache, jnp.asarray([0], jnp.int32),
+        jnp.asarray(rows[:1]))
+    cache = PagedKVCache(cache.k_pools, cache.v_pools,
+                         jnp.asarray(rows),
+                         jnp.full((K,), s, jnp.int32))
+    cache = _BEAM_UPDATE_JIT(cache, jnp.asarray(rows),
+                             jnp.asarray(copy_src), jnp.asarray(copy_dst))
+
+    NEG = jnp.float32(-1e9)
+    logp0 = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+    logp = jnp.broadcast_to(logp0[None], (K, cfg.vocab_size))
+    running_lp = jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32)
+    seqs = jnp.zeros((K, max_len), jnp.int32).at[:, :s].set(
+        jnp.asarray(prompt)[None])
+    fin_seqs = jnp.zeros_like(seqs)
+    fin_scores = jnp.full((K,), NEG)
+
+    for i in range(max_new_tokens):
+        running_lp, seqs, fin_seqs, fin_scores, new_beam, new_tok = \
+            _BEAM_SELECT_JIT(running_lp, seqs, fin_seqs, fin_scores, logp,
+                             jnp.int32(i), s, eos_token_id,
+                             float(length_penalty))
+        if i == max_new_tokens - 1:
+            break                      # pure selection, no forward after
+        parents = np.asarray(new_beam)
+        cur = s + i                    # tokens stored per beam so far
+        # fork: new beam j adopts parent p's blocks; ensure room for the
+        # write at position cur, privately per beam
+        new_rows = np.full((K, max_blocks), num_blocks, np.int32)
+        copy_src = np.full(K, num_blocks, np.int32)
+        copy_dst = np.full(K, num_blocks, np.int32)
+        new_sid_map = {}
+        for j in range(K):
+            dst = next_sid
+            next_sid += 1
+            pair = mgr.fork(sid[int(parents[j])], dst, cur)
+            if pair is not None:
+                copy_src[j], copy_dst[j] = pair
+            new_sid_map[j] = dst
+        for j in range(K):
+            mgr.free(sid[j])
+        sid = new_sid_map
+        for j in range(K):
+            t = mgr.allocate(sid[j], cur + 1)    # grow for this write
+            new_rows[j, :len(t)] = t
+        cache = _BEAM_UPDATE_JIT(cache, jnp.asarray(new_rows),
+                                 jnp.asarray(copy_src),
+                                 jnp.asarray(copy_dst))
+        logits, cache = _DECODE_JIT(model, new_tok.astype(jnp.int32), cache,
+                                    jnp.ones((K,), bool))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    run_score = running_lp / (float(max_new_tokens) ** length_penalty)
+    all_scores = jnp.concatenate([fin_scores, run_score])
+    all_seqs = jnp.concatenate([fin_seqs, seqs], axis=0)
+    best = int(jnp.argmax(all_scores))
+    best_seq = all_seqs[best]
+    best_score = all_scores[best]
+    if eos_token_id is not None:
+        gen = best_seq[s:]
+        seen = jnp.cumsum(gen == eos_token_id)
+        after = jnp.concatenate([jnp.zeros((1,), bool), (seen > 0)[:-1]])
+        best_seq = best_seq.at[s:].set(
+            jnp.where(after, eos_token_id, gen))
+    return best_seq, best_score
 
 
 def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,

@@ -26,6 +26,68 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.models.decoding import KVCache, llama_forward_with_cache
+from paddle_tpu.ops import attention as A
+from paddle_tpu.quantization import wo_matmul as _wo
+
+
+def _forward_rows(model, input_ids, cache: KVCache, row_pos):
+    """Chunk forward with PER-ROW positions: row b's tokens occupy cache
+    positions ``row_pos[b] .. row_pos[b]+C-1`` (rope, cache writes, and
+    causal visibility all per-row). This is what makes speculation
+    batchable: after the first round every row sits at a different
+    position (different acceptance counts), so the scalar-``pos`` forward
+    no longer fits. Stale cache entries beyond a row's frontier are never
+    visible (key j attends iff j <= row_pos[b]+i) and are overwritten by
+    the row's next chunk."""
+    cfg = model.cfg
+    if getattr(cfg, "sliding_window", None):
+        raise NotImplementedError("speculative rows-forward: no window")
+    b, c = input_ids.shape
+    x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
+    d = cfg.hidden_size // cfg.num_attention_heads
+    positions = row_pos[:, None] + jnp.arange(c, dtype=jnp.int32)  # [B, C]
+    base, pos_div = A.resolve_rope_scaling(
+        cfg.rope_theta, d, getattr(cfg, "rope_scaling", None),
+        allow_dynamic=False)
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, jnp.float32) / d))
+    f = (positions.astype(jnp.float32) / pos_div)[:, :, None] * inv
+    cos, sin = jnp.cos(f)[:, :, None, :], jnp.sin(f)[:, :, None, :]
+
+    def rope(t):
+        d2 = t.shape[-1] // 2
+        t1, t2 = t[..., :d2], t[..., d2:]
+        return jnp.concatenate([t1 * cos - t2 * sin, t2 * cos + t1 * sin],
+                               axis=-1).astype(t.dtype)
+
+    row = jnp.arange(b)[:, None]
+    cache_len = cache.k[0].shape[1]
+    vis = (jnp.arange(cache_len)[None, None, :]
+           <= positions[:, :, None])[:, None]            # [B,1,C,L]
+    new_k, new_v = [], []
+    for li, lyr in enumerate(model.model.layers):
+        h = lyr.input_layernorm(x)
+        att = lyr.self_attn
+        qkv = _wo(h, att.qkv_proj)
+        if getattr(att, "qkv_bias", None) is not None:
+            qkv = qkv + att.qkv_bias
+        nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = rope(q.reshape(b, c, nh, hd))
+        k = rope(k.reshape(b, c, nkv, hd))
+        v = v.reshape(b, c, nkv, hd)
+        k_c = cache.k[li].at[row, positions].set(k)
+        v_c = cache.v[li].at[row, positions].set(v)
+        new_k.append(k_c)
+        new_v.append(v_c)
+        out = A.xla_attention(q, k_c, v_c, attn_mask=vis)
+        x = x + _wo(out.reshape(b, c, nh * hd), att.o_proj)
+        x = x + lyr.mlp(lyr.post_attention_layernorm(x))
+    x = model.model.norm(x)
+    return model.logits(x), KVCache(new_k, new_v, cache.length,
+                                    cache.slot_pos)
+
+
+_FWD_ROWS_JIT = jax.jit(_forward_rows)
 
 
 def _greedy(logits):
@@ -125,3 +187,123 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 32,
              "accepted": accepted_total,
              "acceptance_rate": accepted_total / max(rounds * gamma, 1)}
     return jnp.asarray(out[None]), stats
+
+
+def speculative_generate_batched(target, draft, input_ids, prompt_lens=None,
+                                 max_new_tokens: int = 32, gamma: int = 4,
+                                 eos_token_id=None):
+    """BATCHED greedy speculative decoding (ref: the serving predictor's
+    draft-model decode, batch>1). input_ids: [B, S] right-padded ragged
+    prompts with ``prompt_lens`` [B] (defaults to S for every row).
+
+    Rows advance at their own acceptance rates — after round one every row
+    sits at a different position — so all chunk forwards run through
+    ``_forward_rows`` (per-row rope/writes/visibility). Every row's output
+    is EXACTLY its solo greedy decode; rows that finish early are frozen
+    (their re-verifications rewrite identical KV, a no-op).
+
+    Returns (tokens [B, S + max_new_tokens], stats). Per-row semantics
+    match ``speculative_generate``: positions past a row's first EOS stay
+    zero."""
+    ids_np = np.asarray(input_ids)
+    b, s = ids_np.shape
+    if prompt_lens is None:
+        prompt_lens = np.full((b,), s, np.int64)
+    lens_np = np.asarray(prompt_lens, np.int64)
+    max_len = int(lens_np.max()) + max_new_tokens + gamma + 2
+
+    def make_cache(cfg):
+        return KVCache.init(cfg.num_hidden_layers, b, max_len,
+                            cfg.num_key_value_heads,
+                            cfg.hidden_size // cfg.num_attention_heads,
+                            cfg.dtype)
+
+    for cfg in (target.cfg, draft.cfg):
+        if getattr(cfg, "sliding_window", None):
+            raise NotImplementedError(
+                "speculative decoding needs the full (un-windowed) cache")
+
+    cache_t, cache_d = make_cache(target.cfg), make_cache(draft.cfg)
+    zero = jnp.zeros((b,), jnp.int32)
+    ids = jnp.asarray(ids_np, jnp.int32)
+    # ragged prefill: every row at position 0; per-row last-valid logit
+    logits_t, cache_t = _FWD_ROWS_JIT(target, ids, cache_t, zero)
+    _, cache_d = _FWD_ROWS_JIT(draft, ids, cache_d, zero)
+    last = np.asarray(jnp.argmax(
+        jnp.take_along_axis(
+            logits_t, jnp.asarray(lens_np - 1)[:, None, None].astype(
+                jnp.int32), axis=1)[:, 0].astype(jnp.float32), axis=-1))
+
+    committed = [[int(last[r])] for r in range(b)]
+    c = last.astype(np.int64)              # last committed token per row
+    pos = lens_np.copy()                   # target frontier per row
+    draft_pos = lens_np.copy()
+    done = np.zeros((b,), bool)
+    rounds = 0
+    accepted_total = 0
+    proposed_total = 0
+
+    def row_done(r):
+        return (len(committed[r]) >= max_new_tokens
+                or (eos_token_id is not None
+                    and eos_token_id in committed[r]))
+
+    while not all(row_done(r) for r in range(b)):
+        rounds += 1
+        proposed_total += gamma * int((~done).sum())
+        # ---- draft catches up on each row's pending committed suffix ----
+        pend = [committed[r][int(draft_pos[r] - lens_np[r]):] if not done[r]
+                else [int(c[r])] for r in range(b)]
+        pmax = max(len(p) for p in pend)
+        chunk = np.zeros((b, pmax), np.int32)
+        for r in range(b):
+            chunk[r, :len(pend[r])] = pend[r]
+        dl, cache_d = _FWD_ROWS_JIT(draft, jnp.asarray(chunk), cache_d,
+                                    jnp.asarray(draft_pos, jnp.int32))
+        plen = np.asarray([len(p) for p in pend], np.int64)
+        draft_pos = np.where(done, draft_pos, draft_pos + plen)
+        dlast = jnp.take_along_axis(
+            dl, jnp.asarray(plen - 1)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        props = [np.asarray(jnp.argmax(dlast.astype(jnp.float32), -1))]
+        for _ in range(gamma - 1):
+            dl, cache_d = _FWD_ROWS_JIT(
+                draft, jnp.asarray(props[-1][:, None], jnp.int32), cache_d,
+                jnp.asarray(draft_pos, jnp.int32))
+            draft_pos = np.where(done, draft_pos, draft_pos + 1)
+            props.append(np.asarray(
+                jnp.argmax(dl[:, 0].astype(jnp.float32), -1)))
+        props = np.stack(props, axis=1)            # [B, gamma]
+
+        # ---- target verifies every row's chunk in one forward -----------
+        chunk_t = np.concatenate([c[:, None], props], axis=1).astype(np.int32)
+        tl, cache_t = _FWD_ROWS_JIT(target, jnp.asarray(chunk_t), cache_t,
+                                    jnp.asarray(pos, jnp.int32))
+        vs = np.asarray(jnp.argmax(tl.astype(jnp.float32), axis=-1))
+
+        match = np.cumprod(vs[:, :gamma] == props, axis=1).astype(bool)
+        n_acc = match.sum(axis=1)                  # [B]
+        for r in range(b):                         # per ROUND, not per token
+            if done[r]:
+                continue
+            na = int(n_acc[r])
+            new = list(props[r, :na]) + [int(vs[r, na])]
+            committed[r].extend(int(t) for t in new)
+            accepted_total += na
+            pos[r] += na + 1
+            c[r] = committed[r][-1]
+            draft_pos[r] = min(int(draft_pos[r]), int(pos[r]))
+            done[r] = row_done(r)
+
+    out = np.zeros((b, s + max_new_tokens), ids_np.dtype)
+    for r in range(b):
+        toks = committed[r][:max_new_tokens]
+        if eos_token_id is not None and eos_token_id in toks:
+            toks = toks[: toks.index(eos_token_id) + 1]
+        out[r, : lens_np[r]] = ids_np[r, : lens_np[r]]
+        out[r, lens_np[r]: lens_np[r] + len(toks)] = toks
+    stats = {"rounds": rounds,
+             "proposed": proposed_total,
+             "accepted": accepted_total,
+             "acceptance_rate": accepted_total / max(proposed_total, 1)}
+    return jnp.asarray(out), stats

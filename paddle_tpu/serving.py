@@ -1,0 +1,264 @@
+"""Continuous-batching LLM serving engine.
+
+Ref capability: PaddleNLP ``llm/predict/predictor.py`` block-attention
+serving (request queue + block KV cache + ``fused_multi_transformer``'s
+block cache ops). TPU-native split:
+
+  * DEVICE — two fixed-shape jitted programs from ``models/paged.py``:
+    slot-aware prefill (admitted prompts written into their cache slots
+    while other slots keep decoding state) and the fused decode tick
+    (incremental block-table update + paged attention + on-device
+    sampling). Shapes never change across ticks, so nothing recompiles.
+  * HOST — this module: FCFS request queue, slot assignment, block
+    reservation/allocation (BlockManager), streaming outputs. All per-tick
+    bookkeeping is vectorised numpy; the only per-tick device→host
+    traffic is the [num_slots] sampled-token fetch.
+
+Capacity discipline: a request is admitted only when the pool can cover
+its WHOLE worst case (prompt + max_new_tokens) net of other in-flight
+reservations — blocks are still allocated lazily (pool usage ≈ Σ live
+lengths), but an admitted request can never hit an out-of-blocks
+condition mid-decode (there is no preemption to recover with).
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.decoding import _sample
+from paddle_tpu.models.paged import (BlockManager, PagedKVCache,
+                                     _PREFILL_JIT, _TICK_JIT)
+
+# module-level so its compile cache persists across admissions
+_SAMPLE_JIT = jax.jit(_sample, static_argnums=(2, 3, 4))
+
+
+@dataclass
+class Request:
+    """One generation request. ``stream`` (optional) is called as
+    ``stream(request, token)`` the tick each new token is sampled."""
+    prompt: object                       # 1-D int tokens
+    max_new_tokens: int = 32
+    req_id: int = None
+    stream: object = None
+    # filled by the engine:
+    tokens: list = field(default_factory=list)   # generated tokens
+    done: bool = False
+    finish_reason: str = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+
+
+class LLMEngine:
+    """Continuous-batching engine over a shared paged KV pool.
+
+    ``num_slots`` concurrent sequences; queued requests are admitted
+    MID-FLIGHT into slots freed by finished ones (prefill interleaves with
+    decode ticks). ``step()`` is one engine tick; ``run()`` drains
+    everything and returns {req_id: full token list}.
+    """
+
+    def __init__(self, model, *, num_slots=8, block_size=16,
+                 max_prompt_len=128, max_seq_len=None, num_blocks=None,
+                 eos_token_id=None, temperature=0.0, top_k=None, top_p=None,
+                 seed=0):
+        cfg = model.cfg
+        self.model = model
+        self.num_slots = num_slots
+        self.block_size = block_size
+        self.max_prompt_len = max_prompt_len
+        self.max_seq_len = max_seq_len or (max_prompt_len + 256)
+        self.max_blocks_per_seq = -(-self.max_seq_len // block_size)
+        if num_blocks is None:
+            num_blocks = num_slots * self.max_blocks_per_seq
+        self.mgr = BlockManager(num_blocks, block_size)
+        self.eos_token_id = eos_token_id
+        self.sampling = (float(temperature), top_k, top_p)
+        self.rng = jax.random.PRNGKey(seed)
+
+        self.cache = PagedKVCache.init(
+            cfg.num_hidden_layers, num_blocks, block_size,
+            cfg.num_key_value_heads,
+            cfg.hidden_size // cfg.num_attention_heads,
+            num_slots, self.max_blocks_per_seq, cfg.dtype)
+
+        # host mirrors (vectorised bookkeeping — no per-token python loops)
+        self.slot_req = np.full(num_slots, -1, np.int64)   # req_id or -1
+        self.active = np.zeros(num_slots, bool)
+        self.cur = np.zeros(num_slots, np.int64)     # tokens stored in cache
+        self.gen = np.zeros(num_slots, np.int64)     # tokens generated
+        self.max_gen = np.zeros(num_slots, np.int64)
+        self.table_len = np.zeros(num_slots, np.int64)
+        self.last_tok = np.zeros(num_slots, np.int32)
+
+        self.queue: deque[Request] = deque()
+        self.requests: dict[int, Request] = {}
+        self._ids = itertools.count()
+        self._reserved = 0           # blocks promised to in-flight requests
+        self._resv: dict[int, int] = {}    # req_id -> outstanding reserve
+        # host-vs-device split of decode ticks (admission ticks excluded):
+        # stats["host_s"] is scheduling/bookkeeping, stats["device_s"] the
+        # jitted tick incl. the [num_slots] token fetch
+        self.stats = {"host_s": 0.0, "device_s": 0.0, "ticks": 0}
+
+    # ------------------------------------------------------------- intake
+    def add_request(self, req: Request) -> int:
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the prefill "
+                             "itself produces the first token)")
+        if len(req.prompt) > self.max_prompt_len:
+            raise ValueError(f"prompt length {len(req.prompt)} exceeds "
+                             f"max_prompt_len={self.max_prompt_len}")
+        if len(req.prompt) + req.max_new_tokens > self.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        if self.mgr.blocks_needed(len(req.prompt) + req.max_new_tokens) \
+                > self.mgr.num_blocks:
+            raise ValueError(
+                "request worst case exceeds the WHOLE block pool — it "
+                "could never be admitted (raise num_blocks)")
+        if req.req_id is None:
+            req.req_id = next(self._ids)
+        self.requests[req.req_id] = req
+        self.queue.append(req)
+        return req.req_id
+
+    def generate(self, prompt, **kw) -> int:
+        return self.add_request(Request(prompt, **kw))
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self.active.any())
+
+    # ---------------------------------------------------------- admission
+    def _admit(self):
+        """FCFS: move queued requests into free slots while the pool can
+        cover their worst case; returns the admitted (slot, req) pairs."""
+        free_slots = np.nonzero(self.slot_req < 0)[0]
+        admits = []
+        for slot in free_slots:
+            if not self.queue:
+                break
+            req = self.queue[0]
+            need = self.mgr.blocks_needed(
+                len(req.prompt) + req.max_new_tokens)
+            if need > self.mgr.free_blocks - self._reserved:
+                break                      # FCFS: do not starve the head
+            self.queue.popleft()
+            used_now = self.mgr.blocks_needed(len(req.prompt))
+            self.mgr.allocate(req.req_id, len(req.prompt))
+            self._resv[req.req_id] = need - used_now
+            self._reserved += need - used_now
+            admits.append((int(slot), req))
+        return admits
+
+    def _prefill(self, admits):
+        a_cap = self.num_slots           # one compiled admission shape
+        ids = np.zeros((a_cap, self.max_prompt_len), np.int32)
+        lens = np.zeros(a_cap, np.int32)
+        slots = np.full(a_cap, self.num_slots, np.int32)   # sentinel = drop
+        rows = np.full((a_cap, self.max_blocks_per_seq),
+                       self.mgr.num_blocks, np.int32)
+        for i, (slot, req) in enumerate(admits):
+            ids[i, :len(req.prompt)] = req.prompt
+            lens[i] = len(req.prompt)
+            slots[i] = slot
+            t = self.mgr.tables[req.req_id]
+            rows[i, :len(t)] = t
+            self.slot_req[slot] = req.req_id
+            self.active[slot] = True
+            self.cur[slot] = len(req.prompt)
+            self.gen[slot] = 0
+            self.max_gen[slot] = req.max_new_tokens
+            self.table_len[slot] = len(t)
+        logits, self.cache = _PREFILL_JIT(
+            self.model, jnp.asarray(ids), jnp.asarray(lens),
+            self.cache, jnp.asarray(slots), jnp.asarray(rows))
+        self.rng, sub = jax.random.split(self.rng)
+        first = np.asarray(_SAMPLE_JIT(logits.astype(jnp.float32), sub,
+                                       *self.sampling))
+        emitted = []
+        for i, (slot, req) in enumerate(admits):
+            emitted += self._emit(slot, int(first[i]))
+        return emitted
+
+    # ------------------------------------------------------------- decode
+    def _grow_tables(self):
+        """At most one new block per slot per tick; returns the incremental
+        (rows, cols, vals) update triple (sentinel-padded, fixed shape)."""
+        rows = np.full(self.num_slots, self.num_slots, np.int32)
+        cols = np.zeros(self.num_slots, np.int32)
+        vals = np.zeros(self.num_slots, np.int32)
+        crossing = self.active & (self.cur // self.block_size
+                                  >= self.table_len)
+        for slot in np.nonzero(crossing)[0]:     # ≤ once per bs ticks/slot
+            rid = int(self.slot_req[slot])
+            t = self.mgr.allocate(rid, int(self.cur[slot]) + 1)
+            self._resv[rid] -= 1
+            self._reserved -= 1
+            rows[slot] = slot
+            cols[slot] = len(t) - 1
+            vals[slot] = t[-1]
+            self.table_len[slot] = len(t)
+        return rows, cols, vals
+
+    def _emit(self, slot: int, token: int):
+        """Record one sampled token for the request in ``slot``; finish on
+        EOS or length. Returns [(req_id, token)]."""
+        rid = int(self.slot_req[slot])
+        req = self.requests[rid]
+        req.tokens.append(token)
+        if req.stream is not None:
+            req.stream(req, token)
+        self.last_tok[slot] = token
+        self.gen[slot] += 1
+        eos = self.eos_token_id is not None and token == self.eos_token_id
+        if eos or self.gen[slot] >= self.max_gen[slot]:
+            req.done = True
+            req.finish_reason = "eos" if eos else "length"
+            self.mgr.free(rid)
+            self._reserved -= self._resv.pop(rid, 0)
+            self.active[slot] = False
+            self.slot_req[slot] = -1
+        return [(rid, token)]
+
+    def step(self):
+        """One engine tick: admit waiting requests into free slots (their
+        prefill runs now, interleaved with decode), then one decode tick
+        for every active slot. Returns [(req_id, new_token), ...]."""
+        from time import perf_counter
+        emitted = []
+        admits = self._admit()
+        if admits:
+            emitted += self._prefill(admits)
+        if not self.active.any():
+            return emitted
+        t0 = perf_counter()
+        rows, cols, vals = self._grow_tables()
+        self.rng, sub = jax.random.split(self.rng)
+        t1 = perf_counter()
+        nxt, self.cache = _TICK_JIT(
+            self.model, jnp.asarray(self.last_tok), self.cache,
+            jnp.asarray(self.active), jnp.asarray(rows), jnp.asarray(cols),
+            jnp.asarray(vals), sub, *self.sampling)
+        was_active = self.active.copy()
+        nxt = np.asarray(nxt)                 # the one per-tick host fetch
+        t2 = perf_counter()
+        self.cur += was_active                # vectorised mirrors
+        for slot in np.nonzero(was_active)[0]:
+            emitted += self._emit(slot, int(nxt[slot]))
+        t3 = perf_counter()
+        self.stats["host_s"] += (t1 - t0) + (t3 - t2)
+        self.stats["device_s"] += t2 - t1
+        self.stats["ticks"] += 1
+        return emitted
+
+    def run(self) -> dict:
+        """Drain queue + slots; returns {req_id: generated token list}."""
+        while self.has_work():
+            self.step()
+        return {rid: r.tokens for rid, r in self.requests.items()}
