@@ -112,6 +112,9 @@ class LLMEngine:
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "itself produces the first token)")
+        if len(req.prompt) < 1:
+            raise ValueError("prompt must contain at least one token "
+                             "(an empty row has no logit to sample from)")
         if len(req.prompt) > self.max_prompt_len:
             raise ValueError(f"prompt length {len(req.prompt)} exceeds "
                              f"max_prompt_len={self.max_prompt_len}")
@@ -124,9 +127,26 @@ class LLMEngine:
                 "could never be admitted (raise num_blocks)")
         if req.req_id is None:
             req.req_id = next(self._ids)
+        else:
+            if req.req_id in self.requests:
+                # a duplicate id would alias the BlockManager table AND
+                # the reservation ledger of the in-flight request
+                raise ValueError(f"req_id {req.req_id} already exists")
+            # keep auto ids from ever colliding with explicit ones
+            self._ids = itertools.count(
+                max(req.req_id + 1, next(self._ids)))
         self.requests[req.req_id] = req
         self.queue.append(req)
         return req.req_id
+
+    def pop_finished(self) -> dict:
+        """Remove and return completed requests ({req_id: Request}) — call
+        periodically from a long-running serve loop so the engine does not
+        retain every finished request's token list forever."""
+        done = {rid: r for rid, r in self.requests.items() if r.done}
+        for rid in done:
+            del self.requests[rid]
+        return done
 
     def generate(self, prompt, **kw) -> int:
         return self.add_request(Request(prompt, **kw))

@@ -163,6 +163,25 @@ def test_engine_sampling_seeded(model):
     assert a == b
 
 
+def test_engine_request_validation_and_eviction(model):
+    eng = LLMEngine(model, num_slots=1, block_size=4, max_prompt_len=8,
+                    max_seq_len=16)
+    with pytest.raises(ValueError, match="at least one token"):
+        eng.generate([])
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.generate([1, 2], max_new_tokens=0)
+    rid = eng.add_request(Request([1, 2, 3], max_new_tokens=2, req_id=5))
+    assert rid == 5
+    with pytest.raises(ValueError, match="already exists"):
+        eng.add_request(Request([4], max_new_tokens=2, req_id=5))
+    auto = eng.generate([7, 8], max_new_tokens=2)
+    assert auto > 5                      # auto ids skip explicit ones
+    eng.run()
+    done = eng.pop_finished()
+    assert set(done) == {5, auto} and all(r.done for r in done.values())
+    assert eng.requests == {}            # evicted — no unbounded growth
+
+
 # ------------------------------------------------------------------- beam
 
 def test_paged_beam_matches_static_beam(model):
