@@ -122,6 +122,19 @@ def flash_decode(sk=1024, sq=128):
     _close(got, ref)
 
 
+@probe("flash fused alibi_slopes (in-tile bias)")
+def flash_alibi(s=1024):
+    from paddle_tpu.ops.attention import xla_attention
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    rs = np.random.RandomState(8)
+    q, k, v = _qkv(rs, 2, s, 8, 128, hkv=2)   # with GQA
+    slopes = jnp.asarray(2.0 ** (-np.arange(1, 9)), jnp.float32)
+    ref = xla_attention(q, k, v, is_causal=True, alibi_slopes=slopes)
+    got = flash_attention(q, k, v, causal=True, alibi_slopes=slopes,
+                          interpret=False)
+    _close(got, ref)
+
+
 @probe("flash varlen kv_lens (padded batch)")
 def flash_varlen(s=1024):
     from paddle_tpu.ops.attention import xla_attention
@@ -184,6 +197,7 @@ def main():
     flash_gqa(512 if quick else 1024)
     flash_decode(*((512, 128) if quick else (1024, 128)))
     flash_varlen(512 if quick else 1024)
+    flash_alibi(512 if quick else 1024)
     paged_kernel()
     fused_small()
     print(f"\n{len(FAILURES)} failure(s)" + (f": {FAILURES}" if FAILURES

@@ -77,6 +77,7 @@ class BlockManager:
         self.block_size = block_size
         self._free = list(range(num_blocks - 1, -1, -1))
         self.tables: dict[int, list[int]] = {}
+        self._prefix_done: dict[int, int] = {}  # free_prefix resume index
 
     @property
     def free_blocks(self):
@@ -98,15 +99,43 @@ class BlockManager:
         return table
 
     def free(self, seq_id: int):
-        self._free.extend(reversed(self.tables.pop(seq_id, [])))
+        self._free.extend(b for b in reversed(self.tables.pop(seq_id, []))
+                          if b is not None)
+        self._prefix_done.pop(seq_id, None)
+
+    def free_prefix(self, seq_id: int, n_blocks: int):
+        """Release the first ``n_blocks`` table entries (sliding-window
+        recycling: positions below ``cur - window`` are never attended
+        again — ref block-attention's window cache bound). Table POSITIONS
+        are kept as ``None`` placeholders so later block indices stay
+        aligned; returns the freed (position, block) pairs. Scans resume
+        from the last freed index, so each block is visited once over the
+        sequence's whole lifetime (not O(length^2) re-walks)."""
+        table = self.tables.get(seq_id, [])
+        upto = min(n_blocks, len(table))
+        start = self._prefix_done.get(seq_id, 0)
+        freed = []
+        for idx in range(start, upto):
+            if table[idx] is not None:
+                freed.append((idx, table[idx]))
+                self._release(table[idx])
+                table[idx] = None
+        if upto > start:
+            self._prefix_done[seq_id] = upto
+        return freed
+
+    def _release(self, blk: int):
+        """Return one block to the free list (refcount hook point)."""
+        self._free.append(blk)
 
     def table_array(self, seq_ids, max_blocks):
         """[B, max_blocks] int32; unused slots = num_blocks (OOB sentinel,
         dropped by scatter, clamped-masked by the kernel contract)."""
         out = np.full((len(seq_ids), max_blocks), self.num_blocks, np.int32)
         for row, sid in enumerate(seq_ids):
-            t = self.tables.get(sid, [])
-            out[row, :len(t)] = t
+            for idx, b in enumerate(self.tables.get(sid, [])):
+                if b is not None:
+                    out[row, idx] = b
         return jnp.asarray(out)
 
 
@@ -136,8 +165,11 @@ class RefBlockManager(BlockManager):
         src = self.tables[src_id]
         table = list(src)
         copy = None
-        partial = n_tokens % self.block_size != 0 and table
+        partial = (n_tokens % self.block_size != 0 and table
+                   and table[-1] is not None)
         for blk in (table[:-1] if partial else table):
+            if blk is None:   # window-recycled placeholder: nothing shared
+                continue
             self._rc[blk] += 1
         if partial:
             if not self._free:
@@ -151,10 +183,19 @@ class RefBlockManager(BlockManager):
 
     def free(self, seq_id):
         for blk in self.tables.pop(seq_id, []):
-            self._rc[blk] -= 1
-            if self._rc[blk] == 0:
-                del self._rc[blk]
-                self._free.append(blk)
+            if blk is None:
+                continue
+            self._release(blk)
+        self._prefix_done.pop(seq_id, None)
+
+    def _release(self, blk):
+        """Refcounted release: the block returns to the free list only at
+        rc == 0 (free_prefix routes through here too, so windowed
+        recycling can never double-free a beam-shared block)."""
+        self._rc[blk] -= 1
+        if self._rc[blk] == 0:
+            del self._rc[blk]
+            self._free.append(blk)
 
 
 def _rope_rows(positions, head_dim, base, scaling=None):

@@ -30,10 +30,13 @@ def _use_pallas(q) -> bool:
 
 def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None,
                   dropout_p=0.0, training=True, rng=None, window=None,
-                  kv_lens=None):
+                  kv_lens=None, alibi_slopes=None):
     """Reference-semantics attention in pure XLA. [B,S,H,D]. ``window``:
     causal sliding window (token i sees [i-window+1, i]), Mistral-style.
-    ``kv_lens``: [B] valid key lengths (padded-varlen batches)."""
+    ``kv_lens``: [B] valid key lengths (padded-varlen batches).
+    ``alibi_slopes``: [H] or [B, H] positive slopes m — adds
+    ``-m * (q_pos - k_pos)`` to the scores (this path materialises the
+    bias; the Pallas kernel computes it in-tile)."""
     if window is not None and not is_causal:
         raise ValueError("window requires is_causal=True")
     b, sq, h, d = query.shape
@@ -59,15 +62,32 @@ def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None
     v = jnp.swapaxes(value, 1, 2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    # query positions, shared by ALiBi and the causal/window masks: aligned
+    # to the END of the key axis (KV-cache decode); with kv_lens AND
+    # sq < sk (decode against a PADDED cache, flash-attn's cache_seqlens
+    # form) the END is each row's valid length, so the result equals a
+    # trimmed-cache solo call
+    if kv_lens is not None and sq < sk:
+        q_pos = (jnp.asarray(kv_lens, jnp.int32)[:, None] - sq
+                 + jnp.arange(sq)[None, :])            # [B, Sq]
+    else:
+        q_pos = jnp.broadcast_to(jnp.arange(sq) + (sk - sq), (1, sq))
+    k_pos = jnp.arange(sk)
+    if alibi_slopes is not None:
+        # fixed head geometry, not learned — matches the Pallas kernel's
+        # zero-cotangent contract on every backend
+        m_sl = jax.lax.stop_gradient(
+            jnp.asarray(alibi_slopes, jnp.float32)).reshape(-1, h)  # [1|B,H]
+        dist = (q_pos[:, :, None] - k_pos[None, None, :]).astype(jnp.float32)
+        if not is_causal:
+            dist = jnp.abs(dist)   # bidirectional ALiBi: symmetric decay
+        scores = scores - m_sl[:, :, None, None] * dist[:, None]
     if is_causal or window is not None:
-        # align query positions to the END of the key axis (KV-cache decode)
-        q_pos = jnp.arange(sq) + (sk - sq)
-        k_pos = jnp.arange(sk)
-        keep = (q_pos[:, None] >= k_pos[None, :]) if is_causal else \
-            jnp.ones((sq, sk), bool)
+        keep = (q_pos[:, :, None] >= k_pos[None, None, :]) if is_causal \
+            else jnp.ones((1, sq, sk), bool)
         if window is not None:
-            keep &= (q_pos[:, None] - k_pos[None, :]) < window
-        scores = jnp.where(keep, scores, _NEG_INF)
+            keep &= (q_pos[:, :, None] - k_pos[None, None, :]) < window
+        scores = jnp.where(keep[:, None], scores, _NEG_INF)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
             scores = jnp.where(attn_mask, scores, _NEG_INF)
@@ -87,12 +107,13 @@ def xla_attention(query, key, value, attn_mask=None, is_causal=False, scale=None
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, rng=None, scale=None,
-                                 window=None, kv_lens=None):
-    """Dispatch: Pallas flash (incl. the padded-varlen ``kv_lens`` path) →
-    XLA. An ARBITRARY ``attn_mask`` always takes the XLA path: a dense
-    [.., Sq, Sk] mask has already materialised O(S^2) memory, so flash's
-    advantage is gone — express padding as ``kv_lens`` to keep the fused
-    kernel (ref: flash_attn's varlen/padded variants)."""
+                                 window=None, kv_lens=None, alibi_slopes=None):
+    """Dispatch: Pallas flash (incl. the padded-varlen ``kv_lens`` path and
+    in-tile ``alibi_slopes``) → XLA. An ARBITRARY ``attn_mask`` always
+    takes the XLA path: a dense [.., Sq, Sk] mask has already materialised
+    O(S^2) memory, so flash's advantage is gone — express padding as
+    ``kv_lens`` and ALiBi as ``alibi_slopes`` to keep the fused kernel
+    (ref: flash_attn's varlen/padded + alibi_slopes variants)."""
     h, kv = query.shape[2], key.shape[2]
     if (attn_mask is None and (dropout_p == 0.0 or not training)
             and _use_pallas(query)
@@ -102,12 +123,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             # GQA handled inside the kernel (kv row = q row // rep) — no
             # materialised K/V repeat
             return flash_attention(query, key, value, causal=is_causal, scale=scale,
-                                   window=window, kv_lens=kv_lens)
+                                   window=window, kv_lens=kv_lens,
+                                   alibi_slopes=alibi_slopes)
         except Exception:
             pass
     return xla_attention(query, key, value, attn_mask=attn_mask, is_causal=is_causal,
                          scale=scale, dropout_p=dropout_p, training=training, rng=rng,
-                         window=window, kv_lens=kv_lens)
+                         window=window, kv_lens=kv_lens,
+                         alibi_slopes=alibi_slopes)
 
 
 flash_attention = scaled_dot_product_attention

@@ -35,13 +35,21 @@ _NEG_INF = -1e30
 _float0 = jax.dtypes.float0
 
 
-def _band_mask(s, i, j, block_q, block_k, causal, window, q_off, klen=None):
+def _band_mask(s, i, j, block_q, block_k, causal, window, q_off, klen=None,
+               sk=None):
     """Apply causal/sliding-window banding and (padded-varlen) key-length
     masking to a score tile. ``q_off`` (= sk - sq) aligns query positions to
     the END of the key axis so a short query block (KV-cache decode) sees
     the whole prefix. ``klen`` (traced scalar) masks keys >= the row's valid
-    length — the reference's padded/varlen flash_attn capability."""
-    q_idx = q_off + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    length — the reference's padded/varlen flash_attn capability. With
+    klen AND q_off > 0 (decode against a PADDED cache, flash-attn's
+    cache_seqlens form) query positions end-align to the row's valid
+    length: position of query i is ``klen - sq + i``, so the whole
+    computation equals a solo call against the trimmed cache."""
+    off = q_off
+    if klen is not None and q_off != 0 and sk is not None:
+        off = q_off + klen - sk
+    q_idx = off + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     keep = q_idx >= k_idx if causal else (q_idx == q_idx)
     if window is not None:
@@ -65,6 +73,22 @@ def _block_live(i, j, block_q, block_k, causal, window, q_off, klen=None):
     return live
 
 
+def _alibi_add(s, slope, i, j, block_q, block_k, a_off, causal):
+    """Fused ALiBi, computed from iota IN-KERNEL — the O(S^2) bias tensor
+    the XLA path materialises never exists here (the flash-attn CUDA
+    kernel's alibi_slopes capability, TPU-style). Causal: the standard
+    ``-slope * (q_pos - k_pos)`` decay; non-causal: symmetric
+    ``-slope * |q_pos - k_pos|`` (flash-attn's bidirectional form).
+    ``a_off`` aligns query positions: ``sk - sq`` for decode against an
+    un-padded cache, ``klen - sq`` (traced) when ``kv_lens`` marks the
+    valid cache length."""
+    q_idx = a_off + i * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                           s.shape, 0)
+    k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    d = (k_idx - q_idx).astype(jnp.float32)
+    return s + slope * (d if causal else -jnp.abs(d))
+
+
 def _kv_row_index(kv_rep):
     """Index map factory for K/V block specs: q row b reads kv row
     b // kv_rep (identity when there is no GQA — keeps the non-GQA path
@@ -85,14 +109,13 @@ def _band_i_start(j, block_q, block_k, q_off):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
-                scale, causal, window, q_off, block_q, block_k, nk,
-                banded, nsteps, has_lens):
-    if has_lens:
-        lens_ref, o_ref, lse_ref, acc, m_sc, l_sc = rest
-        klen = lens_ref[0, 0]
-    else:
-        o_ref, lse_ref, acc, m_sc, l_sc = rest
-        klen = None
+                scale, causal, window, q_off, sk, block_q, block_k, nk,
+                banded, nsteps, has_lens, has_slopes):
+    rest = list(rest)
+    lens_ref = rest.pop(0) if has_lens else None
+    slopes_ref = rest.pop(0) if has_slopes else None
+    o_ref, lse_ref, acc, m_sc, l_sc = rest
+    klen = lens_ref[0, 0] if has_lens else None
     i, jl = pl.program_id(1), pl.program_id(2)
     # banded grid: the j-axis is a window-relative offset from the first
     # live k block of this q block; full grid: jl IS the k block index
@@ -109,9 +132,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         k = k_ref[0]  # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_slopes:
+            # varlen decode (q_off > 0 with kv_lens): real query positions
+            # end-align to the row's VALID length, not the padded buffer
+            a_off = (q_off if (not has_lens or q_off == 0)
+                     else q_off + klen - sk)
+            s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
+                           a_off, causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
-                           klen)
+                           klen, sk)
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         corr = jnp.exp(m_prev - m_new)
@@ -149,12 +179,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         lse_ref[0] = lse.astype(lse_ref.dtype)
 
 
-def _flash_fwd(q, k, v, lens, *, scale, causal, window, kv_rep, block_q,
-               block_k, interpret):
+def _flash_fwd(q, k, v, lens, slopes, *, scale, causal, window, kv_rep,
+               block_q, block_k, interpret):
     bh, s, d = q.shape
     sk = k.shape[1]
     q_off = sk - s  # align queries to the end of the key axis (decode)
     has_lens = lens is not None
+    has_slopes = slopes is not None
     # GQA: k/v carry bh/kv_rep batch-head rows; q row b reads kv row
     # b // kv_rep via the index map — no repeated K/V is ever materialised
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
@@ -172,9 +203,11 @@ def _flash_fwd(q, k, v, lens, *, scale, causal, window, kv_rep, block_q,
         kv_index = _kv_row_index(kv_rep)
     grid = (bh, nq, nsteps)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               window=window, q_off=q_off, block_q=block_q,
+                               window=window, q_off=q_off, sk=sk,
+                               block_q=block_q,
                                block_k=block_k, nk=nk, banded=banded,
-                               nsteps=nsteps, has_lens=has_lens)
+                               nsteps=nsteps, has_lens=has_lens,
+                               has_slopes=has_slopes)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), kv_index),
@@ -184,6 +217,9 @@ def _flash_fwd(q, k, v, lens, *, scale, causal, window, kv_rep, block_q,
     if has_lens:
         in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
         args.append(lens)
+    if has_slopes:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        args.append(slopes)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -207,14 +243,13 @@ def _flash_fwd(q, k, v, lens, *, scale, causal, window, kv_rep, block_q,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-               scale, causal, window, q_off, block_q, block_k, nk,
-               banded, nsteps, has_lens):
-    if has_lens:
-        lens_ref, dq_ref, dq_acc = rest
-        klen = lens_ref[0, 0]
-    else:
-        dq_ref, dq_acc = rest
-        klen = None
+               scale, causal, window, q_off, sk, block_q, block_k, nk,
+               banded, nsteps, has_lens, has_slopes):
+    rest = list(rest)
+    lens_ref = rest.pop(0) if has_lens else None
+    slopes_ref = rest.pop(0) if has_slopes else None
+    dq_ref, dq_acc = rest
+    klen = lens_ref[0, 0] if has_lens else None
     i, jl = pl.program_id(1), pl.program_id(2)
     j = _band_j_start(i, block_q, block_k, window, q_off) + jl if banded else jl
 
@@ -229,9 +264,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_slopes:
+            # varlen decode (q_off > 0 with kv_lens): real query positions
+            # end-align to the row's VALID length, not the padded buffer
+            a_off = (q_off if (not has_lens or q_off == 0)
+                     else q_off + klen - sk)
+            s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
+                           a_off, causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
-                           klen)
+                           klen, sk)
         p = jnp.exp(s - lse_ref[0])  # lse_ref[0]: [Bq, 1]
         dp = jax.lax.dot_general(do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -254,14 +296,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                scale, causal, window, q_off, block_q,
-                block_k, nq, banded, nsteps, has_lens):
-    if has_lens:
-        lens_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
-        klen = lens_ref[0, 0]
-    else:
-        dk_ref, dv_ref, dk_acc, dv_acc = rest
-        klen = None
+                scale, causal, window, q_off, sk, block_q,
+                block_k, nq, banded, nsteps, has_lens, has_slopes):
+    rest = list(rest)
+    lens_ref = rest.pop(0) if has_lens else None
+    slopes_ref = rest.pop(0) if has_slopes else None
+    dk_ref, dv_ref, dk_acc, dv_acc = rest
+    klen = lens_ref[0, 0] if has_lens else None
     j, il = pl.program_id(1), pl.program_id(2)  # kv-major: q iterated fastest
     i = _band_i_start(j, block_q, block_k, q_off) + il if banded else il
 
@@ -277,9 +318,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         do = do_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_slopes:
+            # varlen decode (q_off > 0 with kv_lens): real query positions
+            # end-align to the row's VALID length, not the padded buffer
+            a_off = (q_off if (not has_lens or q_off == 0)
+                     else q_off + klen - sk)
+            s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
+                           a_off, causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
-                           klen)
+                           klen, sk)
         p = jnp.exp(s - lse_ref[0])  # [Bq, Bk]; lse_ref[0]: [Bq, 1]
         dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -308,12 +356,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
                interpret):
-    q, k, v, lens, out, lse = res
+    q, k, v, lens, slopes, out, lse = res
     bh, s, d = q.shape
     sk = k.shape[1]
     bh_kv = k.shape[0]
     q_off = sk - s
     has_lens = lens is not None
+    has_slopes = slopes is not None
     nq, nk = pl.cdiv(s, block_q), pl.cdiv(sk, block_k)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, S, 1] to match lse layout
@@ -350,11 +399,16 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     if has_lens:
         dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
         dq_args.append(lens)
+    if has_slopes:
+        dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        dq_args.append(slopes)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          window=window, q_off=q_off, block_q=block_q,
+                          window=window, q_off=q_off, sk=sk,
+                          block_q=block_q,
                           block_k=block_k, nk=nk, banded=banded,
-                          nsteps=nk_steps, has_lens=has_lens),
+                          nsteps=nk_steps, has_lens=has_lens,
+                          has_slopes=has_slopes),
         grid=(bh, nq, nk_steps),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -375,11 +429,16 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     if has_lens:
         dkv_in_specs.append(pl.BlockSpec((1, 1), lambda b, j, i: (b, 0)))
         dkv_args.append(lens)
+    if has_slopes:
+        dkv_in_specs.append(pl.BlockSpec((1, 1), lambda b, j, i: (b, 0)))
+        dkv_args.append(slopes)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          window=window, q_off=q_off, block_q=block_q,
+                          window=window, q_off=q_off, sk=sk,
+                          block_q=block_q,
                           block_k=block_k, nq=nq, banded=banded,
-                          nsteps=nq_steps, has_lens=has_lens),
+                          nsteps=nq_steps, has_lens=has_lens,
+                          has_slopes=has_slopes),
         grid=(bh, nk, nq_steps),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -403,21 +462,21 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, lens, scale, causal, window, kv_rep, block_q, block_k,
-           interpret):
-    out, _ = _flash_fwd(q, k, v, lens, scale=scale, causal=causal,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, lens, slopes, scale, causal, window, kv_rep, block_q,
+           block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, lens, slopes, scale=scale, causal=causal,
                         window=window, kv_rep=kv_rep, block_q=block_q,
                         block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, lens, scale, causal, window, kv_rep, block_q,
-                   block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, lens, scale=scale, causal=causal,
+def _flash_vjp_fwd(q, k, v, lens, slopes, scale, causal, window, kv_rep,
+                   block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, lens, slopes, scale=scale, causal=causal,
                           window=window, kv_rep=kv_rep, block_q=block_q,
                           block_k=block_k, interpret=interpret)
-    return out, (q, k, v, lens, out, lse)
+    return out, (q, k, v, lens, slopes, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, window, kv_rep, block_q, block_k, interpret,
@@ -425,9 +484,12 @@ def _flash_vjp_bwd(scale, causal, window, kv_rep, block_q, block_k, interpret,
     dq, dk, dv = _flash_bwd(res, g, scale=scale, causal=causal, window=window,
                             kv_rep=kv_rep, block_q=block_q, block_k=block_k,
                             interpret=interpret)
-    lens = res[3]
+    lens, slopes = res[3], res[4]
     dlens = None if lens is None else np.zeros(lens.shape, _float0)
-    return dq, dk, dv, dlens
+    # ALiBi slopes are a fixed head geometry, not learned (flash-attn's
+    # alibi_slopes contract) — zero cotangent
+    dslopes = None if slopes is None else jnp.zeros_like(slopes)
+    return dq, dk, dv, dlens, dslopes
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -435,6 +497,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     window: int | None = None, kv_lens=None,
+                    alibi_slopes=None,
                     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool | None = None):
     """q,k,v: [B, S, H, D] (reference flash_attention layout). GQA supported
@@ -450,7 +513,12 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     causal+kv_lens a padded query row still attends every key < its row's
     klen, so its output is unspecified garbage — callers MUST mask those
     rows out of the loss (zero upstream cotangent), which is also what
-    makes their grads exactly zero."""
+    makes their grads exactly zero.
+    ``alibi_slopes``: [H] (or [B, H]) positive ALiBi slopes m — the kernel
+    adds ``-m * (q_pos - k_pos)`` to the scores, computed from iota IN the
+    tile (the flash-attn ``alibi_slopes`` capability): no O(S^2) bias
+    tensor exists, unlike the XLA additive-mask path. Slopes are fixed
+    head geometry (not learned): their cotangent is zero."""
     b, s, h, d = q.shape
     sk = k.shape[1]
     h_kv = k.shape[2]
@@ -459,6 +527,14 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     kv_rep = h // h_kv
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    if window is not None and kv_lens is not None and s != sk:
+        # the banded grid's block-liveness pruning is computed from the
+        # buffer-end offset; under klen-aligned decode positions it could
+        # skip live tiles — refuse rather than silently drop attention
+        raise NotImplementedError(
+            "window + kv_lens with sq != sk (windowed decode against a "
+            "padded cache) is not supported; trim the cache or use the "
+            "paged decode kernel")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else d ** -0.5
@@ -472,6 +548,12 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     if kv_lens is not None:
         # [B] -> [B*H, 1]: one scalar per q batch-head row
         lens = jnp.repeat(jnp.asarray(kv_lens, jnp.int32), h)[:, None]
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), lens, scale, causal, window,
-                 kv_rep, bq, bk, interpret)
+    slopes = None
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        # [H] or [B, H] -> [B*H, 1]: one scalar per q batch-head row
+        slopes = jnp.broadcast_to(slopes.reshape(-1, h), (b, h)
+                                  ).reshape(-1)[:, None]
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), lens, slopes, scale, causal,
+                 window, kv_rep, bq, bk, interpret)
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

@@ -81,6 +81,11 @@ class LLMEngine:
         self.eos_token_id = eos_token_id
         self.sampling = (float(temperature), top_k, top_p)
         self.rng = jax.random.PRNGKey(seed)
+        # sliding-window models: blocks entirely below cur - window are
+        # never attended again (the paged kernel masks positions
+        # >= lens - window and tolerates sentinel entries) — recycle them,
+        # bounding live blocks per sequence by O(window), not O(length)
+        self.window = getattr(cfg, "sliding_window", None)
 
         self.cache = PagedKVCache.init(
             cfg.num_hidden_layers, num_blocks, block_size,
@@ -102,6 +107,7 @@ class LLMEngine:
         self._ids = itertools.count()
         self._reserved = 0           # blocks promised to in-flight requests
         self._resv: dict[int, int] = {}    # req_id -> outstanding reserve
+        self._need: dict[int, int] = {}    # req_id -> worst-case blocks
         # host-vs-device split of decode ticks (admission ticks excluded):
         # stats["host_s"] is scheduling/bookkeeping, stats["device_s"] the
         # jitted tick incl. the [num_slots] token fetch
@@ -120,8 +126,7 @@ class LLMEngine:
                              f"max_prompt_len={self.max_prompt_len}")
         if len(req.prompt) + req.max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
-        if self.mgr.blocks_needed(len(req.prompt) + req.max_new_tokens) \
-                > self.mgr.num_blocks:
+        if self._worst_case_blocks(req) > self.mgr.num_blocks:
             raise ValueError(
                 "request worst case exceeds the WHOLE block pool — it "
                 "could never be admitted (raise num_blocks)")
@@ -154,6 +159,18 @@ class LLMEngine:
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())
 
+    def _worst_case_blocks(self, req) -> int:
+        """Blocks a request can ever hold at once. Windowed models recycle
+        below-window blocks, so the live span is bounded by the window
+        (plus the write-frontier block) — but prefill scatters the WHOLE
+        prompt before any recycling, so that is a floor."""
+        total = len(req.prompt) + req.max_new_tokens
+        if self.window is None:
+            return self.mgr.blocks_needed(total)
+        live = self.mgr.blocks_needed(
+            min(total, self.window + 2 * self.block_size))
+        return max(self.mgr.blocks_needed(len(req.prompt)), live)
+
     # ---------------------------------------------------------- admission
     def _admit(self):
         """FCFS: move queued requests into free slots while the pool can
@@ -164,17 +181,38 @@ class LLMEngine:
             if not self.queue:
                 break
             req = self.queue[0]
-            need = self.mgr.blocks_needed(
-                len(req.prompt) + req.max_new_tokens)
+            need = self._worst_case_blocks(req)
             if need > self.mgr.free_blocks - self._reserved:
                 break                      # FCFS: do not starve the head
             self.queue.popleft()
-            used_now = self.mgr.blocks_needed(len(req.prompt))
             self.mgr.allocate(req.req_id, len(req.prompt))
-            self._resv[req.req_id] = need - used_now
-            self._reserved += need - used_now
+            self._need[req.req_id] = need
+            self._resv[req.req_id] = 0
+            self._update_resv(req.req_id)
             admits.append((int(slot), req))
         return admits
+
+    def _live_blocks(self, rid: int) -> int:
+        return sum(b is not None for b in self.mgr.tables.get(rid, []))
+
+    def _update_resv(self, rid: int):
+        """Outstanding reserve = worst case minus blocks currently held
+        (recycling under a sliding window RETURNS headroom)."""
+        new = max(0, self._need[rid] - self._live_blocks(rid))
+        self._reserved += new - self._resv[rid]
+        self._resv[rid] = new
+
+    def _recycle_window(self, slots):
+        """Free blocks entirely below cur - window for the given slots —
+        live blocks per sequence stay O(window). Host-only: the paged
+        kernel masks positions >= lens - window, so stale table entries
+        pointing at recycled (even reused) blocks are never read."""
+        for slot in slots:
+            rid = int(self.slot_req[slot])
+            dead = int(max(0, self.cur[slot] - self.window)
+                       ) // self.block_size
+            if dead > 0 and self.mgr.free_prefix(rid, dead):
+                self._update_resv(rid)
 
     def _prefill(self, admits):
         a_cap = self.num_slots           # one compiled admission shape
@@ -201,6 +239,10 @@ class LLMEngine:
         self.rng, sub = jax.random.split(self.rng)
         first = np.asarray(_SAMPLE_JIT(logits.astype(jnp.float32), sub,
                                        *self.sampling))
+        if self.window is not None:
+            # a long prompt's below-window blocks die the moment prefill
+            # has scattered them
+            self._recycle_window([slot for slot, _ in admits])
         emitted = []
         for i, (slot, req) in enumerate(admits):
             emitted += self._emit(slot, int(first[i]))
@@ -218,12 +260,13 @@ class LLMEngine:
         for slot in np.nonzero(crossing)[0]:     # ≤ once per bs ticks/slot
             rid = int(self.slot_req[slot])
             t = self.mgr.allocate(rid, int(self.cur[slot]) + 1)
-            self._resv[rid] -= 1
-            self._reserved -= 1
+            self._update_resv(rid)
             rows[slot] = slot
             cols[slot] = len(t) - 1
             vals[slot] = t[-1]
             self.table_len[slot] = len(t)
+        if self.window is not None:
+            self._recycle_window(np.nonzero(self.active)[0])
         return rows, cols, vals
 
     def _emit(self, slot: int, token: int):
@@ -242,6 +285,7 @@ class LLMEngine:
             req.finish_reason = "eos" if eos else "length"
             self.mgr.free(rid)
             self._reserved -= self._resv.pop(rid, 0)
+            self._need.pop(rid, None)
             self.active[slot] = False
             self.slot_req[slot] = -1
         return [(rid, token)]

@@ -147,3 +147,100 @@ def test_fused_rope_matches_reference():
     ref = apply_rope(x, cos, sin)
     got = fused_rope(x, cos, sin, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_alibi_matches_xla(causal):
+    """In-tile ALiBi (iota-computed, no O(S^2) bias tensor) == the XLA
+    path's materialised additive bias, fwd + grads."""
+    rs = np.random.RandomState(5)
+    h = 4
+    q, k, v = (jnp.asarray(rs.randn(2, 128, h, 32).astype(np.float32))
+               for _ in range(3))
+    slopes = jnp.asarray(2.0 ** (-np.arange(1, h + 1)), jnp.float32)
+
+    ref = xla_attention(q, k, v, is_causal=causal, alibi_slopes=slopes)
+    got = flash_attention(q, k, v, causal=causal, alibi_slopes=slopes,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    ref_g = jax.grad(lambda *a: jnp.sum(xla_attention(
+        *a, is_causal=causal, alibi_slopes=slopes) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    got_g = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, causal=causal, alibi_slopes=slopes, interpret=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for r, g in zip(ref_g, got_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_alibi_explicit_bias_reference():
+    """The slope convention is exactly bias = -m * (q_pos - k_pos)."""
+    rs = np.random.RandomState(6)
+    h, s = 2, 128
+    q, k, v = (jnp.asarray(rs.randn(1, s, h, 32).astype(np.float32))
+               for _ in range(3))
+    slopes = jnp.asarray([0.5, 0.25], jnp.float32)
+    i = np.arange(s)[:, None]
+    j = np.arange(s)[None, :]
+    bias = jnp.asarray(-np.asarray(slopes)[None, :, None, None]
+                       * (i - j)[None, None], jnp.float32)
+    ref = xla_attention(q, k, v, attn_mask=bias, is_causal=True)
+    got = flash_attention(q, k, v, causal=True, alibi_slopes=slopes,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_alibi_gqa_decode_and_window():
+    """ALiBi composes with GQA, end-aligned decode queries, a sliding
+    window, and per-batch [B, H] slopes."""
+    rs = np.random.RandomState(7)
+    b, sk, h, hkv, d = 2, 256, 4, 2, 32
+    k = jnp.asarray(rs.randn(b, sk, hkv, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, sk, hkv, d).astype(np.float32))
+    slopes = jnp.asarray(rs.rand(b, h).astype(np.float32))
+
+    # decode: 128 queries aligned to the end of the key axis
+    q = jnp.asarray(rs.randn(b, 128, h, d).astype(np.float32))
+    ref = xla_attention(q, k, v, is_causal=True, alibi_slopes=slopes)
+    got = flash_attention(q, k, v, causal=True, alibi_slopes=slopes,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # banded sliding window
+    qf = jnp.asarray(rs.randn(b, sk, h, d).astype(np.float32))
+    ref_w = xla_attention(qf, k, v, is_causal=True, window=64,
+                          alibi_slopes=slopes)
+    got_w = flash_attention(qf, k, v, causal=True, window=64,
+                            alibi_slopes=slopes, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_w), np.asarray(ref_w),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_alibi_varlen_decode_alignment():
+    """ALiBi + kv_lens + sq < sk: query positions end-align to each row's
+    VALID cache length, not the padded buffer — kernel == per-row solo."""
+    rs = np.random.RandomState(9)
+    b, sk, sq, h, d = 2, 256, 128, 2, 32
+    q = jnp.asarray(rs.randn(b, sq, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, sk, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, sk, h, d).astype(np.float32))
+    lens = jnp.asarray([256, 170], jnp.int32)
+    slopes = jnp.asarray([0.5, 0.125], jnp.float32)
+
+    got = flash_attention(q, k, v, causal=True, kv_lens=lens,
+                          alibi_slopes=slopes, interpret=True)
+    ref = xla_attention(q, k, v, is_causal=True, kv_lens=lens,
+                        alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # row 1 must equal a solo call against its TRIMMED cache (the ground
+    # truth both paths claim to implement)
+    solo = xla_attention(q[1:], k[1:, :170], v[1:, :170], is_causal=True,
+                         alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(solo[0]),
+                               rtol=1e-5, atol=1e-5)
