@@ -4,6 +4,7 @@ from paddle_tpu.models.bert import (
     BertForSequenceClassification,
     BertModel,
 )
+from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, LlamaModel
 from paddle_tpu.models.moe_llm import MoEConfig, MoEForCausalLM

@@ -1,0 +1,137 @@
+"""BLOOM decoder LM (ref capability: PaddleNLP ``bloom`` model family /
+``paddlenlp.transformers.BloomForCausalLM``).
+
+The ALiBi-positioned member of the model zoo: no rotary/learned positions —
+attention carries per-head linear distance penalties. On TPU the slopes
+feed ``scaled_dot_product_attention(alibi_slopes=...)``, whose Pallas path
+computes the bias from iota IN-KERNEL (ops/pallas/flash_attention.py): the
+O(S^2) bias tensor HF materialises (``build_alibi_tensor``) never exists.
+HF's form (``m * k_pos``) differs from ours (``-m * (q_pos - k_pos)``) by a
+per-row constant, which softmax cancels — logits parity is asserted in
+tests/test_convert.py.
+
+Architecture (HF ``BloomModel``): word embeddings + embedding LayerNorm,
+blocks of [LN -> fused-QKV attention (head-interleaved in HF, re-laid out
+at load) -> dense] and [LN -> h->4h gelu(tanh) -> 4h->h], final LN, tied
+lm head.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm
+from paddle_tpu.ops import attention as A
+
+
+def alibi_slopes(n_heads: int):
+    """The ALiBi slope schedule (HF build_alibi_tensor's head geometry):
+    powers of ``2^(-8/n)`` for the closest power-of-two head count,
+    interleaved extras when n is not a power of two."""
+    p = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(p) - 3)))
+    slopes = [base ** (i + 1) for i in range(p)]
+    if p < n_heads:
+        extra = 2.0 ** (-(2.0 ** -(math.log2(2 * p) - 3)))
+        slopes += [extra ** (2 * i + 1) for i in range(n_heads - p)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+@dataclass
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 1024
+    n_layer: int = 24
+    n_head: int = 16
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+
+    @staticmethod
+    def tiny(**kw):
+        return BloomConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                     n_layer=2, n_head=4, dtype=jnp.float32,
+                                     remat=False), **kw})
+
+
+class BloomBlock(Module):
+    def __init__(self, cfg: BloomConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.input_layernorm = LayerNorm(h, epsilon=cfg.layer_norm_epsilon,
+                                         dtype=cfg.dtype)
+        # our layout: [h, 3h] columns = [q all heads | k | v] (HF's
+        # head-interleaved fused weight is re-laid out at load time)
+        self.qkv = init((h, 3 * h), cfg.dtype)
+        self.qkv_bias = jnp.zeros((3 * h,), cfg.dtype)
+        self.dense = init((h, h), cfg.dtype)
+        self.dense_bias = jnp.zeros((h,), cfg.dtype)
+        self.post_attention_layernorm = LayerNorm(
+            h, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype)
+        self.h_to_4h = init((h, 4 * h), cfg.dtype)
+        self.h_to_4h_bias = jnp.zeros((4 * h,), cfg.dtype)
+        self.four_h_to_h = init((4 * h, h), cfg.dtype)
+        self.four_h_to_h_bias = jnp.zeros((h,), cfg.dtype)
+        self.n_head = cfg.n_head
+        self.head_dim = h // cfg.n_head
+
+    def __call__(self, x, slopes):
+        b, s, hd = x.shape
+        nh, d = self.n_head, self.head_dim
+        h = self.input_layernorm(x)
+        qkv = h @ self.qkv + self.qkv_bias
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        att = A.scaled_dot_product_attention(
+            q.reshape(b, s, nh, d), k.reshape(b, s, nh, d),
+            v.reshape(b, s, nh, d), is_causal=True, alibi_slopes=slopes)
+        x = x + att.reshape(b, s, hd) @ self.dense + self.dense_bias
+        h2 = self.post_attention_layernorm(x)
+        m = jax.nn.gelu(h2 @ self.h_to_4h + self.h_to_4h_bias,
+                        approximate=True)
+        return x + m @ self.four_h_to_h + self.four_h_to_h_bias
+
+
+class BloomForCausalLM(Module):
+    def __init__(self, cfg: BloomConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = init((cfg.vocab_size, cfg.hidden_size),
+                                    cfg.dtype)
+        self.word_embeddings_layernorm = LayerNorm(
+            cfg.hidden_size, epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype)
+        self.h = [BloomBlock(cfg) for _ in range(cfg.n_layer)]
+        self.ln_f = LayerNorm(cfg.hidden_size,
+                              epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype)
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        slopes = alibi_slopes(cfg.n_head)
+        x = jnp.take(self.word_embeddings, input_ids, axis=0)
+        x = self.word_embeddings_layernorm(x)
+        blk = (jax.checkpoint(lambda lyr, h: lyr(h, slopes))
+               if cfg.remat else (lambda lyr, h: lyr(h, slopes)))
+        for lyr in self.h:
+            x = blk(lyr, x)
+        x = self.ln_f(x)
+        return x @ self.word_embeddings.T     # tied head
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

@@ -261,3 +261,46 @@ def load_t5_state_dict(model, state_dict, dtype=None):
             blk.ln2.weight = j(sd[p + f"{ff_idx}.layer_norm.weight"])
         stack.final_norm.weight = j(sd[f"{name}.final_layer_norm.weight"])
     return model
+
+
+def load_bloom_state_dict(model, state_dict, dtype=None):
+    """Populate a ``BloomForCausalLM`` from an HF state_dict. HF fuses QKV
+    head-INTERLEAVED ([nh, 3, d] on the out dim); ours is [q|k|v] blocks,
+    so the fused weight/bias are re-laid out here."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+    nh = cfg.n_head
+    d = cfg.hidden_size // nh
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    model.word_embeddings = j(sd["word_embeddings.weight"])
+    ln(model.word_embeddings_layernorm, "word_embeddings_layernorm")
+    ln(model.ln_f, "ln_f")
+    for i, blk in enumerate(model.h):
+        p = f"h.{i}."
+        ln(blk.input_layernorm, p + "input_layernorm")
+        ln(blk.post_attention_layernorm, p + "post_attention_layernorm")
+        w = sd[p + "self_attention.query_key_value.weight"]  # [3h, h]
+        w = w.reshape(nh, 3, d, cfg.hidden_size)
+        blk.qkv = j(np.concatenate(
+            [w[:, 0].reshape(nh * d, -1), w[:, 1].reshape(nh * d, -1),
+             w[:, 2].reshape(nh * d, -1)], axis=0).T)        # [h, 3h]
+        b = sd[p + "self_attention.query_key_value.bias"].reshape(nh, 3, d)
+        blk.qkv_bias = j(np.concatenate(
+            [b[:, 0].reshape(-1), b[:, 1].reshape(-1),
+             b[:, 2].reshape(-1)]))
+        blk.dense = j(sd[p + "self_attention.dense.weight"].T)
+        blk.dense_bias = j(sd[p + "self_attention.dense.bias"])
+        blk.h_to_4h = j(sd[p + "mlp.dense_h_to_4h.weight"].T)
+        blk.h_to_4h_bias = j(sd[p + "mlp.dense_h_to_4h.bias"])
+        blk.four_h_to_h = j(sd[p + "mlp.dense_4h_to_h.weight"].T)
+        blk.four_h_to_h_bias = j(sd[p + "mlp.dense_4h_to_h.bias"])
+    return model

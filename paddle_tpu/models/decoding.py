@@ -112,11 +112,15 @@ def llama_forward_with_cache(model, input_ids, cache: KVCache, pos):
     x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
     positions = pos + jnp.arange(input_ids.shape[1])
-    # rope scaling: linear/ntk apply; dynamic-NTK needs a per-step global
-    # length the traced decode cannot carry — allow_dynamic=False raises
+    # rope scaling: linear/ntk are static; dynamic-NTK rides the TRACED
+    # current length (pos + chunk), matching HF generation semantics
+    # (earlier cache entries keep the base they were rotated with)
     cos, sin = A.rope_cos_sin(input_ids.shape[1], d, base=cfg.rope_theta,
                               position_ids=positions,
                               scaling=getattr(cfg, "rope_scaling", None),
+                              max_position_embeddings=getattr(
+                                  cfg, "max_position_embeddings", None),
+                              cur_len=pos + input_ids.shape[1],
                               allow_dynamic=False)
     slot_pos = cache.slot_pos
     if slot_pos is not None:  # ring cache: record absolute slot positions

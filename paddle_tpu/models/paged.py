@@ -133,9 +133,13 @@ class BlockManager:
         dropped by scatter, clamped-masked by the kernel contract)."""
         out = np.full((len(seq_ids), max_blocks), self.num_blocks, np.int32)
         for row, sid in enumerate(seq_ids):
-            for idx, b in enumerate(self.tables.get(sid, [])):
-                if b is not None:
-                    out[row, idx] = b
+            t = self.tables.get(sid, [])
+            if self._prefix_done.get(sid, 0) == 0:   # no None placeholders
+                out[row, :len(t)] = t
+            else:
+                for idx, b in enumerate(t):
+                    if b is not None:
+                        out[row, idx] = b
         return jnp.asarray(out)
 
 
@@ -171,6 +175,10 @@ class RefBlockManager(BlockManager):
             if blk is None:   # window-recycled placeholder: nothing shared
                 continue
             self._rc[blk] += 1
+        # the fork inherits the recycled-prefix marker: table_array's fast
+        # path and future free_prefix scans key on it
+        if src_id in self._prefix_done:
+            self._prefix_done[dst_id] = self._prefix_done[src_id]
         if partial:
             if not self._free:
                 raise MemoryError("paged cache out of blocks for beam fork")
@@ -198,14 +206,20 @@ class RefBlockManager(BlockManager):
             self._free.append(blk)
 
 
-def _rope_rows(positions, head_dim, base, scaling=None):
+def _rope_rows(positions, head_dim, base, scaling=None, max_pos=None):
     """cos/sin for PER-ROW positions: [B] -> [B, 1, 1, D/2] (ragged decode:
     every sequence sits at a different position). Shares the scaling math
-    with ops.attention (linear/ntk; dynamic raises — fixed-shape path)."""
-    base, pos_div = A.resolve_rope_scaling(base, head_dim, scaling,
-                                           allow_dynamic=False)
-    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
-    f = (positions.astype(jnp.float32) / pos_div)[:, None] * inv[None, :]
+    with ops.attention; dynamic-NTK uses each ROW's traced current length
+    (positions + 1), so every sequence scales by its own length."""
+    base, pos_div = A.resolve_rope_scaling(
+        base, head_dim, scaling, allow_dynamic=False,
+        max_position_embeddings=max_pos,
+        cur_len=(positions + 1 if (scaling or {}).get("type") == "dynamic"
+                 else None))
+    base = jnp.asarray(base, jnp.float32).reshape(-1, 1)     # [B|1, 1]
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                     jnp.float32)[None, :] / head_dim))
+    f = (positions.astype(jnp.float32) / pos_div)[:, None] * inv
     return (jnp.cos(f)[:, None, None, :], jnp.sin(f)[:, None, None, :])
 
 
@@ -273,9 +287,15 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
         new_lens = cache.lens.at[slot_ids].set(prompt_lens, mode="drop")
     x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
-    cos, sin = A.rope_cos_sin(s, d, base=cfg.rope_theta,
-                              scaling=getattr(cfg, "rope_scaling", None),
-                              allow_dynamic=False)
+    scaling = getattr(cfg, "rope_scaling", None)
+    cos, sin = A.rope_cos_sin(
+        s, d, base=cfg.rope_theta, scaling=scaling,
+        max_position_embeddings=getattr(cfg, "max_position_embeddings",
+                                        None),
+        # dynamic-NTK: each ragged row scales by ITS prompt length
+        cur_len=(prompt_lens if (scaling or {}).get("type") == "dynamic"
+                 else None),
+        allow_dynamic=False)
     k_pools, v_pools = [], []
     for li, lyr in enumerate(model.model.layers):
         h = lyr.input_layernorm(x)
@@ -315,7 +335,8 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
     x = jnp.take(model.model.embed_tokens, tokens[:, None], axis=0)  # [B,1,E]
     d = cfg.hidden_size // cfg.num_attention_heads
     cos, sin = _rope_rows(cache.lens, d, cfg.rope_theta,
-                          getattr(cfg, "rope_scaling", None))
+                          getattr(cfg, "rope_scaling", None),
+                          getattr(cfg, "max_position_embeddings", None))
     window = getattr(cfg, "sliding_window", None)
     k_pools, v_pools = [], []
     new_lens = jnp.where(active, cache.lens + 1, cache.lens)

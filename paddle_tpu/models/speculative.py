@@ -46,10 +46,20 @@ def _forward_rows(model, input_ids, cache: KVCache, row_pos):
     x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
     positions = row_pos[:, None] + jnp.arange(c, dtype=jnp.int32)  # [B, C]
+    scaling = getattr(cfg, "rope_scaling", None)
     base, pos_div = A.resolve_rope_scaling(
-        cfg.rope_theta, d, getattr(cfg, "rope_scaling", None),
-        allow_dynamic=False)
-    inv = 1.0 / (base ** (jnp.arange(0, d, 2, jnp.float32) / d))
+        cfg.rope_theta, d, scaling, allow_dynamic=False,
+        max_position_embeddings=getattr(cfg, "max_position_embeddings",
+                                        None),
+        # dynamic-NTK: each POSITION uses its own traced current length
+        # (positions + 1) — exactly what generate()'s one-token-per-step
+        # decode does, so speculation stays lossless beyond the window
+        cur_len=(positions + 1 if (scaling or {}).get("type") == "dynamic"
+                 else None))
+    base = jnp.asarray(base, jnp.float32)
+    base = base.reshape((1, 1) if base.ndim == 0 else base.shape)  # [B|1,C|1]
+    inv = 1.0 / (base[:, :, None]
+                 ** (jnp.arange(0, d, 2, jnp.float32)[None, None, :] / d))
     f = (positions.astype(jnp.float32) / pos_div)[:, :, None] * inv
     cos, sin = jnp.cos(f)[:, :, None, :], jnp.sin(f)[:, :, None, :]
 
@@ -116,7 +126,18 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 32,
                             cfg.hidden_size // cfg.num_attention_heads,
                             cfg.dtype)
 
-    fwd = jax.jit(llama_forward_with_cache, static_argnums=())
+    dynamic = any((getattr(c, "rope_scaling", None) or {}).get("type")
+                  == "dynamic" for c in (t_cfg, d_cfg))
+    if dynamic:
+        # verify chunks must rotate every position with ITS current length
+        # exactly generate()'s one-token-per-step bases — or the chunk-end
+        # base would silently desync the cache from plain decode; the
+        # rows-forward already does per-position dynamic-NTK
+        def fwd(model, ids, cache, pos):
+            return _FWD_ROWS_JIT(model, jnp.asarray(ids, jnp.int32), cache,
+                                 jnp.full((ids.shape[0],), pos, jnp.int32))
+    else:
+        fwd = jax.jit(llama_forward_with_cache, static_argnums=())
 
     cache_t, cache_d = make_cache(t_cfg), make_cache(d_cfg)
     ids = jnp.asarray(input_ids)

@@ -140,16 +140,20 @@ flash_attention = scaled_dot_product_attention
 
 def resolve_rope_scaling(base, head_dim, scaling, seq_len=None,
                          max_position_embeddings=None, *,
-                         allow_dynamic=True):
+                         allow_dynamic=True, cur_len=None):
     """The ONE place the rope_scaling math lives. Returns
     ``(base, position_divisor)`` for the reference rope_scaling dict
     (PaddleNLP/HF convention):
       {"type": "linear",  "factor": f} — position interpolation (pos / f)
       {"type": "ntk",     "factor": f} — base *= f^(d/(d-2)) (fixed NTK)
-      {"type": "dynamic", "factor": f} — NTK base grows once ``seq_len``
-        exceeds the trained length. Needs a per-call global length, so
-        fixed-shape decode paths pass ``allow_dynamic=False`` and raise
-        instead of silently mis-rotating.
+      {"type": "dynamic", "factor": f} — NTK base grows once the length
+        exceeds the trained window. Fixed-shape decode paths carry the
+        CURRENT length as traced data via ``cur_len`` (scalar or [B]
+        per-row) — the returned base is then traced (per-row: [B] or
+        [B, 1]); a decode path that passes neither raises
+        (``allow_dynamic=False``) instead of silently mis-rotating.
+        Per-step bases match HF generation semantics: earlier cache
+        entries keep the base they were rotated with.
     """
     if not scaling:
         return base, 1.0
@@ -159,11 +163,20 @@ def resolve_rope_scaling(base, head_dim, scaling, seq_len=None,
     if kind == "ntk":
         return base * factor ** (head_dim / (head_dim - 2)), 1.0
     if kind == "dynamic":
+        if cur_len is not None:
+            trained = max_position_embeddings
+            if not trained:
+                raise ValueError(
+                    "dynamic rope_scaling with a traced cur_len needs "
+                    "max_position_embeddings (the trained window)")
+            alpha = jnp.maximum(
+                factor * jnp.asarray(cur_len, jnp.float32) / trained
+                - (factor - 1.0), 1.0)     # <= trained: unscaled (alpha 1)
+            return base * alpha ** (head_dim / (head_dim - 2)), 1.0
         if not allow_dynamic:
             raise NotImplementedError(
-                "dynamic-NTK rope_scaling needs the global sequence length "
-                "each step, which this fixed-shape decode path cannot "
-                "carry; use 'linear' or 'ntk' here")
+                "dynamic-NTK rope_scaling needs the current sequence "
+                "length; pass cur_len (traced) or use 'linear'/'ntk'")
         trained = max_position_embeddings or seq_len
         if seq_len is not None and seq_len > trained:
             alpha = factor * seq_len / trained - (factor - 1)  # HF formula
@@ -174,26 +187,39 @@ def resolve_rope_scaling(base, head_dim, scaling, seq_len=None,
 
 def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32, position_ids=None,
                  scaling=None, max_position_embeddings=None,
-                 allow_dynamic=True):
-    """``scaling``: reference rope_scaling dict — see resolve_rope_scaling."""
+                 allow_dynamic=True, cur_len=None):
+    """``scaling``: reference rope_scaling dict — see resolve_rope_scaling.
+    ``cur_len``: traced current total length for dynamic scaling inside
+    fixed-shape decode (the base becomes traced data, no recompile)."""
     base, pos_div = resolve_rope_scaling(
         base, head_dim, scaling, seq_len=seq_len,
         max_position_embeddings=max_position_embeddings,
-        allow_dynamic=allow_dynamic)
-    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+        allow_dynamic=allow_dynamic, cur_len=cur_len)
+    ar = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
     pos = jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None else position_ids
     if pos_div != 1.0:
         pos = pos / pos_div
-    freqs = jnp.outer(pos, inv_freq)
+    base = jnp.asarray(base, jnp.float32)
+    if base.ndim == 0:
+        freqs = jnp.outer(pos, 1.0 / (base ** ar))          # [S, D/2]
+    else:
+        # per-ROW dynamic base (ragged lengths): [B, S, D/2]
+        inv_freq = 1.0 / (base[:, None] ** ar[None, :])
+        freqs = pos[None, :, None] * inv_freq[:, None, :]
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
 def apply_rope(x, cos, sin):
-    """x: [B,S,H,D]; cos/sin: [S, D/2]. NeoX-style rotate-half (LLaMA)."""
+    """x: [B,S,H,D]; cos/sin: [S, D/2] (shared) or [B, S, D/2] (per-row
+    dynamic base). NeoX-style rotate-half (LLaMA)."""
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if cos.ndim == 3:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 

@@ -46,9 +46,7 @@ def _band_mask(s, i, j, block_q, block_k, causal, window, q_off, klen=None,
     cache_seqlens form) query positions end-align to the row's valid
     length: position of query i is ``klen - sq + i``, so the whole
     computation equals a solo call against the trimmed cache."""
-    off = q_off
-    if klen is not None and q_off != 0 and sk is not None:
-        off = q_off + klen - sk
+    off = _q_offset(q_off, klen, sk) if sk is not None else q_off
     q_idx = off + i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     keep = q_idx >= k_idx if causal else (q_idx == q_idx)
@@ -71,6 +69,17 @@ def _block_live(i, j, block_q, block_k, causal, window, q_off, klen=None):
     if klen is not None:
         live &= j * block_k < klen
     return live
+
+
+def _q_offset(q_off, klen, sk):
+    """Query-position offset shared by the masks and ALiBi: buffer-end
+    alignment (``sk - sq``) normally; with ``kv_lens`` AND a short query
+    block (``q_off > 0``, decode against a PADDED cache) positions
+    end-align to the row's VALID length (``klen - sq``) — ONE rule, so the
+    bias and the masks can never disagree."""
+    if klen is None or q_off == 0:
+        return q_off
+    return q_off + klen - sk
 
 
 def _alibi_add(s, slope, i, j, block_q, block_k, a_off, causal):
@@ -133,12 +142,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if has_slopes:
-            # varlen decode (q_off > 0 with kv_lens): real query positions
-            # end-align to the row's VALID length, not the padded buffer
-            a_off = (q_off if (not has_lens or q_off == 0)
-                     else q_off + klen - sk)
             s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
-                           a_off, causal)
+                           _q_offset(q_off, klen, sk), causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
                            klen, sk)
@@ -265,12 +270,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if has_slopes:
-            # varlen decode (q_off > 0 with kv_lens): real query positions
-            # end-align to the row's VALID length, not the padded buffer
-            a_off = (q_off if (not has_lens or q_off == 0)
-                     else q_off + klen - sk)
             s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
-                           a_off, causal)
+                           _q_offset(q_off, klen, sk), causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
                            klen, sk)
@@ -319,12 +320,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if has_slopes:
-            # varlen decode (q_off > 0 with kv_lens): real query positions
-            # end-align to the row's VALID length, not the padded buffer
-            a_off = (q_off if (not has_lens or q_off == 0)
-                     else q_off + klen - sk)
             s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
-                           a_off, causal)
+                           _q_offset(q_off, klen, sk), causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
                            klen, sk)

@@ -82,8 +82,8 @@ class LLMEngine:
         self.sampling = (float(temperature), top_k, top_p)
         self.rng = jax.random.PRNGKey(seed)
         # sliding-window models: blocks entirely below cur - window are
-        # never attended again (the paged kernel masks positions
-        # >= lens - window and tolerates sentinel entries) — recycle them,
+        # never attended again (the paged kernel KEEPS only positions
+        # >= lens - window, masking everything below) — recycle them,
         # bounding live blocks per sequence by O(window), not O(length)
         self.window = getattr(cfg, "sliding_window", None)
 
@@ -205,8 +205,9 @@ class LLMEngine:
     def _recycle_window(self, slots):
         """Free blocks entirely below cur - window for the given slots —
         live blocks per sequence stay O(window). Host-only: the paged
-        kernel masks positions >= lens - window, so stale table entries
-        pointing at recycled (even reused) blocks are never read."""
+        kernel masks every position BELOW lens - window, so stale table
+        entries pointing at recycled (even reused) blocks are never
+        read."""
         for slot in slots:
             rid = int(self.slot_req[slot])
             dead = int(max(0, self.cur[slot] - self.window)
@@ -241,8 +242,17 @@ class LLMEngine:
                                        *self.sampling))
         if self.window is not None:
             # a long prompt's below-window blocks die the moment prefill
-            # has scattered them
+            # has scattered them — and from here on the sequence can never
+            # hold more than the window live bound, so relax its
+            # reservation too (the prompt-size floor only mattered DURING
+            # prefill)
             self._recycle_window([slot for slot, _ in admits])
+            live_bound = self.mgr.blocks_needed(
+                self.window + 2 * self.block_size)
+            for slot, req in admits:
+                rid = req.req_id
+                self._need[rid] = min(self._need[rid], live_bound)
+                self._update_resv(rid)
         emitted = []
         for i, (slot, req) in enumerate(admits):
             emitted += self._emit(slot, int(first[i]))

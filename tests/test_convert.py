@@ -278,3 +278,57 @@ def test_t5_variant_mismatches_raise():
     # unsupported activation string rejected at config time
     with pytest.raises(ValueError):
         T5Config(feed_forward_proj="gated-silu")
+
+
+def test_bloom_logits_match_transformers():
+    """BLOOM (ALiBi positions, fused head-interleaved QKV re-laid out at
+    load): logits match HF. HF materialises the O(S^2) alibi bias; ours
+    differs per softmax row only by a constant, which softmax cancels."""
+    import torch
+    from transformers import BloomConfig as HFConfig
+    from transformers import BloomForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32, n_layer=2,
+                          n_head=4, use_cache=False)).eval()
+
+    from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
+    from paddle_tpu.models.convert import load_bloom_state_dict
+
+    pt.seed(0)
+    cfg = BloomConfig(vocab_size=96, hidden_size=32, n_layer=2, n_head=4,
+                      dtype=jnp.float32, remat=False)
+    ours = load_bloom_state_dict(BloomForCausalLM(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bloom_non_power_of_two_heads():
+    """The slope schedule's extra-head branch (n_head not a power of 2)."""
+    import torch
+    from transformers import BloomConfig as HFConfig
+    from transformers import BloomForCausalLM as HFModel
+
+    torch.manual_seed(1)
+    hf = HFModel(HFConfig(vocab_size=64, hidden_size=36, n_layer=1,
+                          n_head=6, use_cache=False)).eval()
+
+    from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
+    from paddle_tpu.models.convert import load_bloom_state_dict
+
+    pt.seed(0)
+    cfg = BloomConfig(vocab_size=64, hidden_size=36, n_layer=1, n_head=6,
+                      dtype=jnp.float32, remat=False)
+    ours = load_bloom_state_dict(BloomForCausalLM(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(1)
+    ids = rs.randint(0, 64, (1, 9))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

@@ -214,3 +214,91 @@ def test_speculative_batched_eos_per_row():
         np.testing.assert_array_equal(got[i, s: s + keep], gen[:keep],
                                       err_msg=f"row {i}")
         assert (got[i, s + keep:] == 0).all()
+
+
+def test_dynamic_ntk_decode_matches_generate():
+    """dynamic-NTK now rides fixed-shape decode as TRACED data (it used
+    to raise): paged decode == static-cache generate beyond the trained
+    window, and within the window dynamic == unscaled exactly."""
+    from paddle_tpu.models.decoding import generate
+    from paddle_tpu.models.paged import paged_generate
+
+    mk = dict(num_hidden_layers=2, hidden_size=32, num_attention_heads=4,
+              num_key_value_heads=2, vocab_size=64,
+              max_position_embeddings=8)
+    pt.seed(0)
+    dyn = LlamaForCausalLM(LlamaConfig.tiny(
+        **mk, rope_scaling={"type": "dynamic", "factor": 2.0}))
+    rs = np.random.RandomState(11)
+    b, s, new = 2, 6, 10          # decode runs well past trained=8
+    ids = jnp.asarray(rs.randint(0, 64, (b, s)))
+
+    ref = generate(dyn, ids, max_new_tokens=new)
+    assert np.isfinite(np.asarray(dyn(ids))).all()
+    got, _ = paged_generate(dyn, ids, np.full((b,), s), max_new_tokens=new,
+                            block_size=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    # within the trained window the clamp makes dynamic == unscaled
+    pt.seed(0)
+    plain = LlamaForCausalLM(LlamaConfig.tiny(**mk))
+    pt.seed(0)
+    dyn2 = LlamaForCausalLM(LlamaConfig.tiny(
+        **mk, rope_scaling={"type": "dynamic", "factor": 2.0}))
+    short = generate(plain, ids, max_new_tokens=2)   # total 8 == trained
+    short_d = generate(dyn2, ids, max_new_tokens=2)
+    np.testing.assert_array_equal(np.asarray(short_d), np.asarray(short))
+
+
+def test_dynamic_ntk_chunked_prefill_matches_forward():
+    """Chunked cache prefill (cur_len = L traced) == the full forward's
+    static dynamic-NTK base at the last position."""
+    from paddle_tpu.models.decoding import KVCache, llama_forward_with_cache
+
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64, max_position_embeddings=8,
+                           rope_scaling={"type": "dynamic", "factor": 2.0})
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(12)
+    ids = jnp.asarray(rs.randint(0, 64, (1, 12)))    # past trained=8
+    full = model(ids)
+    cache = KVCache.init(cfg.num_hidden_layers, 1, 16,
+                         cfg.num_key_value_heads,
+                         cfg.hidden_size // cfg.num_attention_heads,
+                         cfg.dtype)
+    dec, _ = llama_forward_with_cache(model, ids, cache, 0)
+    np.testing.assert_allclose(np.asarray(dec[:, -1]),
+                               np.asarray(full[:, -1]),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_speculative_dynamic_ntk_stays_lossless():
+    """Speculative chunk verify under dynamic-NTK rotates each position
+    with ITS current length (like one-at-a-time decode) — output still
+    exactly equals the target's own greedy decode past the window."""
+    from paddle_tpu.models.decoding import generate
+    from paddle_tpu.models.speculative import (speculative_generate,
+                                               speculative_generate_batched)
+
+    dyn = dict(num_hidden_layers=2, hidden_size=32, num_attention_heads=4,
+               num_key_value_heads=2, vocab_size=64,
+               max_position_embeddings=8,
+               rope_scaling={"type": "dynamic", "factor": 2.0})
+    pt.seed(0)
+    target = LlamaForCausalLM(LlamaConfig.tiny(**dyn))
+    pt.seed(1)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(
+        **{**dyn, "num_hidden_layers": 1}))
+    rs = np.random.RandomState(13)
+    ids = jnp.asarray(rs.randint(0, 64, (1, 6)))
+    new = 10                       # well past trained=8
+    ref = generate(target, ids, max_new_tokens=new)
+    got, _ = speculative_generate(target, draft, ids, max_new_tokens=new,
+                                  gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    gotb, _ = speculative_generate_batched(target, draft,
+                                           np.asarray(ids),
+                                           max_new_tokens=new, gamma=3)
+    np.testing.assert_array_equal(np.asarray(gotb), np.asarray(ref))
