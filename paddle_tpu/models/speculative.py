@@ -30,7 +30,8 @@ from paddle_tpu.ops import attention as A
 from paddle_tpu.quantization import wo_matmul as _wo
 
 
-def _forward_rows(model, input_ids, cache: KVCache, row_pos):
+def _forward_rows(model, input_ids, cache: KVCache, row_pos,
+                  chunk_end_len=None):
     """Chunk forward with PER-ROW positions: row b's tokens occupy cache
     positions ``row_pos[b] .. row_pos[b]+C-1`` (rope, cache writes, and
     causal visibility all per-row). This is what makes speculation
@@ -38,7 +39,15 @@ def _forward_rows(model, input_ids, cache: KVCache, row_pos):
     position (different acceptance counts), so the scalar-``pos`` forward
     no longer fits. Stale cache entries beyond a row's frontier are never
     visible (key j attends iff j <= row_pos[b]+i) and are overwritten by
-    the row's next chunk."""
+    the row's next chunk.
+
+    ``chunk_end_len`` ([B] int32, dynamic-NTK only): rotate the WHOLE
+    chunk with the row's chunk-end base alpha(chunk_end_len[b]) — what
+    ``generate()``'s prefill does (decoding.py cur_len = pos + C). Without
+    it each position uses its own base alpha(pos+1), matching the
+    one-token-per-step decode that verify chunks must reproduce. Prefill
+    MUST pass it or long-prompt dynamic-NTK caches desync from plain
+    ``generate()``."""
     cfg = model.cfg
     if getattr(cfg, "sliding_window", None):
         raise NotImplementedError("speculative rows-forward: no window")
@@ -47,15 +56,16 @@ def _forward_rows(model, input_ids, cache: KVCache, row_pos):
     d = cfg.hidden_size // cfg.num_attention_heads
     positions = row_pos[:, None] + jnp.arange(c, dtype=jnp.int32)  # [B, C]
     scaling = getattr(cfg, "rope_scaling", None)
+    if (scaling or {}).get("type") == "dynamic":
+        cur_len = (chunk_end_len[:, None].astype(jnp.int32)  # [B, 1]
+                   if chunk_end_len is not None else positions + 1)
+    else:
+        cur_len = None
     base, pos_div = A.resolve_rope_scaling(
         cfg.rope_theta, d, scaling, allow_dynamic=False,
         max_position_embeddings=getattr(cfg, "max_position_embeddings",
                                         None),
-        # dynamic-NTK: each POSITION uses its own traced current length
-        # (positions + 1) — exactly what generate()'s one-token-per-step
-        # decode does, so speculation stays lossless beyond the window
-        cur_len=(positions + 1 if (scaling or {}).get("type") == "dynamic"
-                 else None))
+        cur_len=cur_len)
     base = jnp.asarray(base, jnp.float32)
     base = base.reshape((1, 1) if base.ndim == 0 else base.shape)  # [B|1,C|1]
     inv = 1.0 / (base[:, :, None]
@@ -132,17 +142,25 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 32,
         # verify chunks must rotate every position with ITS current length
         # exactly generate()'s one-token-per-step bases — or the chunk-end
         # base would silently desync the cache from plain decode; the
-        # rows-forward already does per-position dynamic-NTK
-        def fwd(model, ids, cache, pos):
+        # PREFILL however must use the chunk-end base for the whole prompt
+        # (what generate()'s prefill does), passed via chunk_end
+        def fwd(model, ids, cache, pos, chunk_end=None):
+            ce = (None if chunk_end is None
+                  else jnp.full((ids.shape[0],), chunk_end, jnp.int32))
             return _FWD_ROWS_JIT(model, jnp.asarray(ids, jnp.int32), cache,
-                                 jnp.full((ids.shape[0],), pos, jnp.int32))
+                                 jnp.full((ids.shape[0],), pos, jnp.int32),
+                                 ce)
     else:
-        fwd = jax.jit(llama_forward_with_cache, static_argnums=())
+        _fwd_chunk = jax.jit(llama_forward_with_cache, static_argnums=())
+
+        def fwd(model, ids, cache, pos, chunk_end=None):
+            # llama_forward_with_cache is natively chunk-end based
+            return _fwd_chunk(model, ids, cache, pos)
 
     cache_t, cache_d = make_cache(t_cfg), make_cache(d_cfg)
     ids = jnp.asarray(input_ids)
-    logits_t, cache_t = fwd(target, ids, cache_t, 0)
-    _, cache_d = fwd(draft, ids, cache_d, 0)
+    logits_t, cache_t = fwd(target, ids, cache_t, 0, chunk_end=prompt_len)
+    _, cache_d = fwd(draft, ids, cache_d, 0, chunk_end=prompt_len)
 
     committed: list[int] = []          # tokens at positions prompt_len + i
     c = _greedy(logits_t[:, -1])       # first committed token
@@ -247,9 +265,13 @@ def speculative_generate_batched(target, draft, input_ids, prompt_lens=None,
     cache_t, cache_d = make_cache(target.cfg), make_cache(draft.cfg)
     zero = jnp.zeros((b,), jnp.int32)
     ids = jnp.asarray(ids_np, jnp.int32)
-    # ragged prefill: every row at position 0; per-row last-valid logit
-    logits_t, cache_t = _FWD_ROWS_JIT(target, ids, cache_t, zero)
-    _, cache_d = _FWD_ROWS_JIT(draft, ids, cache_d, zero)
+    # ragged prefill: every row at position 0; per-row last-valid logit.
+    # Dynamic-NTK: each row's prompt rotates with ITS chunk-end base
+    # alpha(prompt_len[r]) — generate()'s prefill semantics (padding
+    # positions past a row's length are stale/overwritten, base moot)
+    lens32 = jnp.asarray(lens_np, jnp.int32)
+    logits_t, cache_t = _FWD_ROWS_JIT(target, ids, cache_t, zero, lens32)
+    _, cache_d = _FWD_ROWS_JIT(draft, ids, cache_d, zero, lens32)
     last = np.asarray(jnp.argmax(
         jnp.take_along_axis(
             logits_t, jnp.asarray(lens_np - 1)[:, None, None].astype(

@@ -124,7 +124,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     lens_ref = rest.pop(0) if has_lens else None
     slopes_ref = rest.pop(0) if has_slopes else None
     o_ref, lse_ref, acc, m_sc, l_sc = rest
-    klen = lens_ref[0, 0] if has_lens else None
+    b = pl.program_id(0)
+    # lens/slopes ride whole-array in SMEM (a [BH, 1] VMEM block would
+    # violate the (8, 128) tile rule); index by the batch-head grid row
+    klen = lens_ref[b, 0] if has_lens else None
     i, jl = pl.program_id(1), pl.program_id(2)
     # banded grid: the j-axis is a window-relative offset from the first
     # live k block of this q block; full grid: jl IS the k block index
@@ -142,7 +145,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if has_slopes:
-            s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
+            s = _alibi_add(s, slopes_ref[b, 0], i, j, block_q, block_k,
                            _q_offset(q_off, klen, sk), causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
@@ -220,10 +223,10 @@ def _flash_fwd(q, k, v, lens, slopes, *, scale, causal, window, kv_rep,
     ]
     args = [q, k, v]
     if has_lens:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(lens)
     if has_slopes:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(slopes)
     out, lse = pl.pallas_call(
         kernel,
@@ -254,7 +257,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     lens_ref = rest.pop(0) if has_lens else None
     slopes_ref = rest.pop(0) if has_slopes else None
     dq_ref, dq_acc = rest
-    klen = lens_ref[0, 0] if has_lens else None
+    b = pl.program_id(0)
+    klen = lens_ref[b, 0] if has_lens else None
     i, jl = pl.program_id(1), pl.program_id(2)
     j = _band_j_start(i, block_q, block_k, window, q_off) + jl if banded else jl
 
@@ -270,7 +274,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if has_slopes:
-            s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
+            s = _alibi_add(s, slopes_ref[b, 0], i, j, block_q, block_k,
                            _q_offset(q_off, klen, sk), causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
@@ -303,7 +307,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     lens_ref = rest.pop(0) if has_lens else None
     slopes_ref = rest.pop(0) if has_slopes else None
     dk_ref, dv_ref, dk_acc, dv_acc = rest
-    klen = lens_ref[0, 0] if has_lens else None
+    b = pl.program_id(0)
+    klen = lens_ref[b, 0] if has_lens else None
     j, il = pl.program_id(1), pl.program_id(2)  # kv-major: q iterated fastest
     i = _band_i_start(j, block_q, block_k, q_off) + il if banded else il
 
@@ -320,7 +325,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if has_slopes:
-            s = _alibi_add(s, slopes_ref[0, 0], i, j, block_q, block_k,
+            s = _alibi_add(s, slopes_ref[b, 0], i, j, block_q, block_k,
                            _q_offset(q_off, klen, sk), causal)
         if causal or window is not None or has_lens:
             s = _band_mask(s, i, j, block_q, block_k, causal, window, q_off,
@@ -394,10 +399,10 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     ]
     dq_args = [q, k, v, g, lse, delta]
     if has_lens:
-        dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dq_args.append(lens)
     if has_slopes:
-        dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dq_args.append(slopes)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -424,10 +429,10 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
     ]
     dkv_args = [q, k, v, g, lse, delta]
     if has_lens:
-        dkv_in_specs.append(pl.BlockSpec((1, 1), lambda b, j, i: (b, 0)))
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dkv_args.append(lens)
     if has_slopes:
-        dkv_in_specs.append(pl.BlockSpec((1, 1), lambda b, j, i: (b, 0)))
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dkv_args.append(slopes)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,

@@ -39,8 +39,8 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
-        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
-        l_sc[:] = jnp.zeros_like(l_sc)
+        m_sc[0, 0] = _NEG_INF
+        l_sc[0, 0] = 0.0
 
     seq_len = lens_ref[bh, 0]
     n_live = pl.cdiv(seq_len, block_size)
@@ -121,8 +121,10 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
         out_specs=pl.BlockSpec((1, 1, d), lambda bh, j, t, l: (bh, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((1, d), jnp.float32),
-            pltpu.VMEM((1, 1), jnp.float32),
-            pltpu.VMEM((1, 1), jnp.float32),
+            # running max / denom are SCALARS: Mosaic rejects scalar stores
+            # to VMEM, so they live in SMEM scratch
+            pltpu.SMEM((1, 1), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
         ],
     )
     kernel = functools.partial(_paged_decode_kernel, block_size=bs,

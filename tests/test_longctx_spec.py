@@ -302,3 +302,26 @@ def test_speculative_dynamic_ntk_stays_lossless():
                                            np.asarray(ids),
                                            max_new_tokens=new, gamma=3)
     np.testing.assert_array_equal(np.asarray(gotb), np.asarray(ref))
+
+    # LONG prompt (12 > trained 8): the dynamic-NTK prefill must use the
+    # chunk-end base alpha(prompt_len) like generate()'s prefill — the
+    # per-position verify bases apply only to post-prompt chunks
+    ids_long = jnp.asarray(rs.randint(0, 64, (1, 12)))
+    ref_l = generate(target, ids_long, max_new_tokens=new)
+    got_l, _ = speculative_generate(target, draft, ids_long,
+                                    max_new_tokens=new, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got_l), np.asarray(ref_l))
+    # batched ragged long prompts: rows prefill with alpha(len[r]) each
+    idsb = np.zeros((2, 12), np.int64)
+    idsb[0] = np.asarray(ids_long)[0]
+    idsb[1, :9] = rs.randint(0, 64, (9,))
+    lens = np.asarray([12, 9])
+    refs = [generate(target, jnp.asarray(idsb[r:r + 1, :lens[r]]),
+                     max_new_tokens=new) for r in range(2)]
+    gotb_l, _ = speculative_generate_batched(target, draft, idsb,
+                                             prompt_lens=lens,
+                                             max_new_tokens=new, gamma=3)
+    gb = np.asarray(gotb_l)
+    for r in range(2):
+        sol = np.asarray(refs[r])[0]
+        np.testing.assert_array_equal(gb[r, :len(sol)], sol)
