@@ -14,7 +14,8 @@ Variants timed (fwd only, S=4096, w=1024, bf16):
 
 Timing notes (see .claude/skills/verify): block_until_ready is a NO-OP
 over the axon tunnel; sync via float() host fetch, amortized over ITERS
-calls. Dispatch RTT is measured with a no-op jit and subtracted.
+calls. A no-op jit's time is printed alongside as the dispatch-overhead
+floor — compare variants against it, it is NOT subtracted.
 """
 from __future__ import annotations
 

@@ -1,0 +1,116 @@
+"""Zero-bubble (ZB-H1 style) pipeline schedule: parity vs sequential and
+vs plain 1F1B, composition with dp, and the structural W-split property.
+
+Ref: Fleet ``meta_parallel/pipeline_parallel.py`` (interleaved/zero-bubble
+schedules); here ``pipeline_train_1f1b(zero_bubble=True)`` — drain-chain
+hops compute dx only, deferred weight grads run in pp-1 tail ticks (see
+``paddle_tpu/distributed/pipeline.py`` module docstring for the DAG cost
+model).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import HybridMesh
+from paddle_tpu.distributed.pipeline import (PipelineLayer,
+                                             pipeline_train_step)
+
+from tests.test_pipeline_1f1b import (_embed, _head_loss, _seq_ref, _setup)
+
+
+@pytest.mark.parametrize("pp,M", [(2, 4), (4, 4), (4, 8)])
+def test_zb1_matches_sequential(pp, M):
+    blocks, emb_w, head_w, tokens, tlabels = _setup(M=M)
+    pipe = PipelineLayer(blocks, num_stages=pp, num_microbatches=M)
+    ref, refg = jax.value_and_grad(_seq_ref, argnums=(0, 1, 2))(
+        pipe.stacked, emb_w, head_w, tokens, tlabels)
+    mesh = HybridMesh(pp=pp, devices=jax.devices()[:pp])
+    loss, ds, de, dh = pipeline_train_step(
+        pipe, mesh, tokens, tlabels, head_loss_fn=_head_loss,
+        head_params=head_w, embed_fn=_embed, embed_params=emb_w,
+        schedule="zb1")
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    for g, r in zip(jax.tree_util.tree_leaves((ds, de, dh)),
+                    jax.tree_util.tree_leaves(refg)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_zb1_loss_bit_identical_to_1f1b():
+    """The forward side is untouched by the W-split: losses match BITWISE;
+    grads match to fp32 accumulation-order tolerance."""
+    pp, M = 4, 4
+    blocks, emb_w, head_w, tokens, tlabels = _setup(M=M)
+    pipe = PipelineLayer(blocks, num_stages=pp, num_microbatches=M)
+    mesh = HybridMesh(pp=pp, devices=jax.devices()[:pp])
+    kw = dict(head_loss_fn=_head_loss, head_params=head_w,
+              embed_fn=_embed, embed_params=emb_w)
+    l1, d1, e1, h1 = pipeline_train_step(pipe, mesh, tokens, tlabels, **kw)
+    lz, dz, ez, hz = pipeline_train_step(pipe, mesh, tokens, tlabels,
+                                         schedule="zb1", **kw)
+    assert float(l1) == float(lz)
+    for g, r in zip(jax.tree_util.tree_leaves((dz, ez, hz)),
+                    jax.tree_util.tree_leaves((d1, e1, h1))):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_zb1_composes_with_dp():
+    pp, dp, M = 2, 2, 4
+    blocks, emb_w, head_w, tokens, tlabels = _setup(M=M, mb=4)
+    pipe = PipelineLayer(blocks, num_stages=pp, num_microbatches=M)
+    ref, refg = jax.value_and_grad(_seq_ref, argnums=(0, 1, 2))(
+        pipe.stacked, emb_w, head_w, tokens, tlabels)
+    mesh = HybridMesh(dp=dp, pp=pp, devices=jax.devices()[:dp * pp])
+    loss, ds, de, dh = pipeline_train_step(
+        pipe, mesh, tokens, tlabels, head_loss_fn=_head_loss,
+        head_params=head_w, embed_fn=_embed, embed_params=emb_w,
+        batch_axes=("dp",), schedule="zb1")
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    for g, r in zip(jax.tree_util.tree_leaves((ds, de, dh)),
+                    jax.tree_util.tree_leaves(refg)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_zb1_tail_ticks_and_wq_structure():
+    """Structural: zb1 runs M + 3(pp-1) ticks (pp-1 W-only tail ticks past
+    1F1B's M + 2(pp-1)) and carries a pp-slot (x, g) deferred-W queue."""
+    pp, M, mb, width, seq = 4, 8, 2, 9, 5
+    blocks, emb_w, head_w, tokens, tlabels = _setup(
+        n_layers=4, width=width, M=M, mb=mb, seq=seq)
+    pipe = PipelineLayer(blocks, num_stages=pp, num_microbatches=M)
+    mesh = HybridMesh(pp=pp, devices=jax.devices()[:pp])
+
+    def step(stacked, x, y, ep, hp, schedule):
+        pipe.stacked = stacked
+        return pipeline_train_step(pipe, mesh, x, y,
+                                   head_loss_fn=_head_loss, head_params=hp,
+                                   embed_fn=_embed, embed_params=ep,
+                                   schedule=schedule)
+
+    txt = str(jax.make_jaxpr(step, static_argnums=(5,))(
+        pipe.stacked, tokens, tlabels, emb_w, head_w, "zb1")
+    ).replace(" ", "")
+    t_zb = M + 3 * (pp - 1)
+    # the schedule scan iterates the tick index array [T]
+    assert f"iota[dtype=int32shape=({t_zb},)" in txt or \
+        f"i32[{t_zb}]" in txt, "expected M + 3(pp-1) ticks in zb1"
+    # two [pp, mb, seq, width] queue buffers ride the carry
+    assert txt.count(f"f32[{pp},{mb},{seq},{width}]") >= 2, \
+        "expected the pp-slot deferred-W (x, g) queue in the carry"
+
+
+def test_bad_schedule_name_raises():
+    blocks, emb_w, head_w, tokens, tlabels = _setup()
+    pipe = PipelineLayer(blocks, num_stages=2, num_microbatches=4)
+    mesh = HybridMesh(pp=2, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        pipeline_train_step(pipe, mesh, tokens, tlabels,
+                            head_loss_fn=_head_loss, head_params=head_w,
+                            embed_fn=_embed, embed_params=emb_w,
+                            schedule="gpipe")
