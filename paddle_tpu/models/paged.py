@@ -373,39 +373,52 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
 
 def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
                       upd_rows, upd_cols, upd_vals, rng,
-                      temperature=0.0, top_k=None, top_p=None):
+                      temperature=0.0, top_k=None, top_p=None,
+                      want_logp=False):
     """ONE fused serving tick: apply incremental block-table updates
     (``tables[upd_rows[i], upd_cols[i]] = upd_vals[i]``, sentinel rows
     dropped — no host-side table rebuild/re-upload), run the decode step,
     and sample the next token ON DEVICE. The only per-tick host traffic is
-    the [B] sampled-token fetch the engine needs for streaming/EOS."""
+    the [B] sampled-token fetch the engine needs for streaming/EOS.
+
+    ``want_logp`` (static): also return the [B, vocab] log-probs for beam
+    selection, LEFT ON DEVICE. When False (greedy-only ticks) logp is ()
+    so no [B, vocab] f32 buffer is ever materialised."""
     from paddle_tpu.models.decoding import _sample
     tables = cache.block_tables.at[upd_rows, upd_cols].set(upd_vals,
                                                            mode="drop")
     cache = PagedKVCache(cache.k_pools, cache.v_pools, tables, cache.lens)
     logits, cache = llama_decode_step_paged(model, tokens, cache, active)
+    logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            if want_logp else ())
     nxt = _sample(logits.astype(jnp.float32), rng, temperature, top_k, top_p)
     nxt = jnp.where(active, nxt.astype(jnp.int32), tokens)
-    return nxt, cache
+    return nxt, logp, cache
 
 
 # module-level jit wrappers: their compile caches persist across
 # paged_generate calls (a per-call jax.jit would recompile every request)
 _PREFILL_JIT = jax.jit(llama_prefill_paged)
 _DECODE_JIT = jax.jit(llama_decode_step_paged)
-_TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(8, 9, 10),
+_TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(8, 9, 10, 11),
                     donate_argnums=(2,))
+
+
+def _copy_partial_blocks(pools, copy_src, copy_dst):
+    """Copy-on-write pool block copies shared by every beam path.
+    copy_src/copy_dst: [K] block ids, sentinel num_blocks = no copy."""
+    return [p.at[copy_dst].set(p[jnp.clip(copy_src, 0, p.shape[0] - 1)],
+                               mode="drop") for p in pools]
 
 
 def _beam_cache_update(cache: PagedKVCache, new_tables, copy_src, copy_dst):
     """Apply a beam reorder to the paged cache: install the forked block
-    tables and copy the (at most one per beam) private partial blocks.
-    copy_src/copy_dst: [K] block ids, sentinel num_blocks = no copy."""
-    k_pools = [p.at[copy_dst].set(p[jnp.clip(copy_src, 0, p.shape[0] - 1)],
-                                  mode="drop") for p in cache.k_pools]
-    v_pools = [p.at[copy_dst].set(p[jnp.clip(copy_src, 0, p.shape[0] - 1)],
-                                  mode="drop") for p in cache.v_pools]
-    return PagedKVCache(k_pools, v_pools, new_tables, cache.lens)
+    tables and copy the (at most one per beam) private partial blocks."""
+    return PagedKVCache(_copy_partial_blocks(cache.k_pools, copy_src,
+                                             copy_dst),
+                        _copy_partial_blocks(cache.v_pools, copy_src,
+                                             copy_dst),
+                        new_tables, cache.lens)
 
 
 def _beam_select(running_lp, seqs, fin_seqs, fin_scores, logp, i,
@@ -419,8 +432,44 @@ def _beam_select(running_lp, seqs, fin_seqs, fin_scores, logp, i,
     return tuple(x[0] for x in out)
 
 
+def _beam_group_update(cache: PagedKVCache, slot_ids, rows, lens_val,
+                       copy_src, copy_dst):
+    """Engine-shaped beam reorder: install the K forked table rows at the
+    group's cache slots, pin their lens, and copy the private partial
+    blocks. slot_ids [K] int32; rows [K, max_blocks]; lens_val scalar;
+    copy_src/copy_dst [K] (sentinel num_blocks = no copy)."""
+    tables = cache.block_tables.at[slot_ids].set(rows)
+    lens = cache.lens.at[slot_ids].set(jnp.int32(lens_val))
+    return PagedKVCache(_copy_partial_blocks(cache.k_pools, copy_src,
+                                             copy_dst),
+                        _copy_partial_blocks(cache.v_pools, copy_src,
+                                             copy_dst),
+                        tables, lens)
+
+
+def _beam_finalize(running_lp, seqs, fin_seqs, fin_scores, prompt_len,
+                   max_new_tokens, eos_token_id, length_penalty):
+    """Pick the best hypothesis among finished + still-running beams and
+    EOS-fill past the first EOS — shared by ``paged_beam_search`` and the
+    serving engine's beam groups. Returns (best_seq, best_score)."""
+    run_score = running_lp / (float(max_new_tokens) ** length_penalty)
+    all_scores = jnp.concatenate([fin_scores, run_score])
+    all_seqs = jnp.concatenate([fin_seqs, seqs], axis=0)
+    best = int(jnp.argmax(all_scores))
+    best_seq = all_seqs[best]
+    best_score = all_scores[best]
+    if eos_token_id is not None:
+        gen = best_seq[prompt_len:]
+        seen = jnp.cumsum(gen == eos_token_id)
+        after = jnp.concatenate([jnp.zeros((1,), bool), (seen > 0)[:-1]])
+        best_seq = best_seq.at[prompt_len:].set(
+            jnp.where(after, eos_token_id, gen))
+    return best_seq, best_score
+
+
 _BEAM_SELECT_JIT = jax.jit(_beam_select, static_argnums=(6, 7, 8))
 _BEAM_UPDATE_JIT = jax.jit(_beam_cache_update, donate_argnums=(0,))
+_BEAM_GROUP_UPDATE_JIT = jax.jit(_beam_group_update, donate_argnums=(0,))
 
 
 def paged_beam_search(model, prompt, max_new_tokens=32, num_beams=4,
@@ -518,19 +567,8 @@ def paged_beam_search(model, prompt, max_new_tokens=32, num_beams=4,
                                     jnp.ones((K,), bool))
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
 
-    run_score = running_lp / (float(max_new_tokens) ** length_penalty)
-    all_scores = jnp.concatenate([fin_scores, run_score])
-    all_seqs = jnp.concatenate([fin_seqs, seqs], axis=0)
-    best = int(jnp.argmax(all_scores))
-    best_seq = all_seqs[best]
-    best_score = all_scores[best]
-    if eos_token_id is not None:
-        gen = best_seq[s:]
-        seen = jnp.cumsum(gen == eos_token_id)
-        after = jnp.concatenate([jnp.zeros((1,), bool), (seen > 0)[:-1]])
-        best_seq = best_seq.at[s:].set(
-            jnp.where(after, eos_token_id, gen))
-    return best_seq, best_score
+    return _beam_finalize(running_lp, seqs, fin_seqs, fin_scores, s,
+                          max_new_tokens, eos_token_id, length_penalty)
 
 
 def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,

@@ -31,8 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.models.decoding import _sample
-from paddle_tpu.models.paged import (BlockManager, PagedKVCache,
-                                     _PREFILL_JIT, _TICK_JIT)
+from paddle_tpu.models.paged import (PagedKVCache, RefBlockManager,
+                                     _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
+                                     _BEAM_SELECT_JIT, _PREFILL_JIT,
+                                     _TICK_JIT)
 
 # module-level so its compile cache persists across admissions
 _SAMPLE_JIT = jax.jit(_sample, static_argnums=(2, 3, 4))
@@ -41,18 +43,41 @@ _SAMPLE_JIT = jax.jit(_sample, static_argnums=(2, 3, 4))
 @dataclass
 class Request:
     """One generation request. ``stream`` (optional) is called as
-    ``stream(request, token)`` the tick each new token is sampled."""
+    ``stream(request, token)`` the tick each new token is sampled.
+    ``num_beams > 1``: beam search — the request occupies num_beams cache
+    slots, selection mirrors ``decoding.beam_search`` exactly, and the
+    BEST hypothesis lands in ``tokens`` when the request finishes (no
+    streaming; tail past a hypothesis' first EOS is EOS-filled)."""
     prompt: object                       # 1-D int tokens
     max_new_tokens: int = 32
     req_id: int = None
     stream: object = None
+    num_beams: int = 1
+    length_penalty: float = 1.0
     # filled by the engine:
     tokens: list = field(default_factory=list)   # generated tokens
     done: bool = False
     finish_reason: str = None
+    beam_score: float = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+
+
+@dataclass
+class _BeamGroup:
+    """Engine-side state of one in-flight beam request (K cache slots +
+    the device-resident selection state shared with paged_beam_search)."""
+    req: Request
+    slots: list
+    s: int                                # prompt length
+    i: int = 0                            # selects done
+    sid: dict = field(default_factory=dict)   # beam j -> BlockManager key
+    running_lp: object = None
+    seqs: object = None
+    fin_seqs: object = None
+    fin_scores: object = None
+    logp: object = None                   # [K, vocab] device, pre-select
 
 
 class LLMEngine:
@@ -77,7 +102,9 @@ class LLMEngine:
         self.max_blocks_per_seq = -(-self.max_seq_len // block_size)
         if num_blocks is None:
             num_blocks = num_slots * self.max_blocks_per_seq
-        self.mgr = BlockManager(num_blocks, block_size)
+        # refcounted: beam groups share prompt blocks copy-on-write; for
+        # unforked (greedy) sequences it behaves exactly like BlockManager
+        self.mgr = RefBlockManager(num_blocks, block_size)
         self.eos_token_id = eos_token_id
         self.sampling = (float(temperature), top_k, top_p)
         self.rng = jax.random.PRNGKey(seed)
@@ -102,6 +129,10 @@ class LLMEngine:
         self.table_len = np.zeros(num_slots, np.int64)
         self.last_tok = np.zeros(num_slots, np.int32)
 
+        self.is_beam = np.zeros(num_slots, bool)
+        self.groups: dict[int, _BeamGroup] = {}
+        self._sid_counter = 0        # unique fork keys: (req_id, counter)
+
         self.queue: deque[Request] = deque()
         self.requests: dict[int, Request] = {}
         self._ids = itertools.count()
@@ -118,6 +149,21 @@ class LLMEngine:
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "itself produces the first token)")
+        if req.num_beams < 1:
+            raise ValueError("num_beams must be >= 1")
+        if req.num_beams > 1:
+            if req.num_beams > self.num_slots:
+                raise ValueError(f"num_beams {req.num_beams} exceeds "
+                                 f"num_slots={self.num_slots}")
+            if self.window is not None:
+                raise NotImplementedError(
+                    "beam search + sliding-window block recycling are not "
+                    "combined (a recycled parent block may be needed by a "
+                    "forked child)")
+            if req.stream is not None:
+                raise ValueError("streaming is not supported for beam "
+                                 "requests (tokens are only known at the "
+                                 "final selection)")
         if len(req.prompt) < 1:
             raise ValueError("prompt must contain at least one token "
                              "(an empty row has no logit to sample from)")
@@ -157,13 +203,24 @@ class LLMEngine:
         return self.add_request(Request(prompt, **kw))
 
     def has_work(self) -> bool:
-        return bool(self.queue) or bool(self.active.any())
+        return (bool(self.queue) or bool(self.active.any())
+                or bool(self.groups))
 
     def _worst_case_blocks(self, req) -> int:
         """Blocks a request can ever hold at once. Windowed models recycle
         below-window blocks, so the live span is bounded by the window
         (plus the write-frontier block) — but prefill scatters the WHOLE
-        prompt before any recycling, so that is a floor."""
+        prompt before any recycling, so that is a floor.
+
+        Beam requests (K slots): shared prompt blocks once, plus per beam
+        the generated span (straddling ≤ ceil(new/bs)+1 blocks), plus 2
+        per beam for the copy-on-write partial forks (one held, one
+        transient while the new fork exists before the parent is freed)."""
+        if req.num_beams > 1:
+            k = req.num_beams
+            return (self.mgr.blocks_needed(len(req.prompt))
+                    + k * (self.mgr.blocks_needed(
+                        req.max_new_tokens + self.block_size) + 2))
         total = len(req.prompt) + req.max_new_tokens
         if self.window is None:
             return self.mgr.blocks_needed(total)
@@ -174,23 +231,33 @@ class LLMEngine:
     # ---------------------------------------------------------- admission
     def _admit(self):
         """FCFS: move queued requests into free slots while the pool can
-        cover their worst case; returns the admitted (slot, req) pairs."""
-        free_slots = np.nonzero(self.slot_req < 0)[0]
-        admits = []
-        for slot in free_slots:
-            if not self.queue:
-                break
+        cover their worst case; returns (greedy (slot, req) pairs,
+        beam (slots, req) pairs). A beam request needs num_beams slots."""
+        free_slots = list(np.nonzero(self.slot_req < 0)[0])
+        admits, beam_admits = [], []
+        while self.queue and free_slots:
             req = self.queue[0]
+            k = req.num_beams
             need = self._worst_case_blocks(req)
-            if need > self.mgr.free_blocks - self._reserved:
+            if (k > len(free_slots)
+                    or need > self.mgr.free_blocks - self._reserved):
                 break                      # FCFS: do not starve the head
             self.queue.popleft()
-            self.mgr.allocate(req.req_id, len(req.prompt))
             self._need[req.req_id] = need
             self._resv[req.req_id] = 0
-            self._update_resv(req.req_id)
-            admits.append((int(slot), req))
-        return admits
+            if k == 1:
+                slot = int(free_slots.pop(0))
+                self.mgr.allocate(req.req_id, len(req.prompt))
+                self._update_resv(req.req_id)
+                admits.append((slot, req))
+            else:
+                slots = [int(free_slots.pop(0)) for _ in range(k)]
+                # full worst-case reservation up front; relaxed to
+                # (need - live) as the group's blocks materialise
+                self._reserved += need
+                self._resv[req.req_id] = need
+                beam_admits.append((slots, req))
+        return admits, beam_admits
 
     def _live_blocks(self, rid: int) -> int:
         return sum(b is not None for b in self.mgr.tables.get(rid, []))
@@ -215,7 +282,11 @@ class LLMEngine:
             if dead > 0 and self.mgr.free_prefix(rid, dead):
                 self._update_resv(rid)
 
-    def _prefill(self, admits):
+    def _prefill(self, admits, beam_admits=()):
+        """ONE padded prefill forward for every prompt admitted this tick —
+        greedy prompts in rows 0..n-1, each beam request's prompt as one
+        more row (written into its beam-0 slot; the forks are installed
+        after, in ``_beam_init``)."""
         a_cap = self.num_slots           # one compiled admission shape
         ids = np.zeros((a_cap, self.max_prompt_len), np.int32)
         lens = np.zeros(a_cap, np.int32)
@@ -234,6 +305,16 @@ class LLMEngine:
             self.gen[slot] = 0
             self.max_gen[slot] = req.max_new_tokens
             self.table_len[slot] = len(t)
+        n = len(admits)
+        beams = []
+        for bi, (bslots, req) in enumerate(beam_admits):
+            g, grows, csrc, cdst = self._beam_alloc(bslots, req)
+            i = n + bi                   # every admit holds >= 1 slot, so
+            ids[i, :g.s] = req.prompt    # greedy + beam rows fit in a_cap
+            lens[i] = g.s
+            slots[i] = bslots[0]
+            rows[i] = grows[0]
+            beams.append((g, grows, csrc, cdst))
         logits, self.cache = _PREFILL_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             self.cache, jnp.asarray(slots), jnp.asarray(rows))
@@ -256,7 +337,143 @@ class LLMEngine:
         emitted = []
         for i, (slot, req) in enumerate(admits):
             emitted += self._emit(slot, int(first[i]))
+        for bi, (g, grows, csrc, cdst) in enumerate(beams):
+            emitted += self._beam_init(g, grows, csrc, cdst, logits[n + bi])
         return emitted
+
+    # ------------------------------------------------------------ beams
+    def _group_live_blocks(self, g: _BeamGroup) -> int:
+        """Distinct pool blocks held by the whole group (shared prompt
+        blocks appear in several beams' tables — count them once)."""
+        return len({b for sid in g.sid.values()
+                    for b in self.mgr.tables.get(sid, []) if b is not None})
+
+    def _update_resv_group(self, rid: int):
+        g = self.groups[rid]
+        new = max(0, self._need[rid] - self._group_live_blocks(g))
+        self._reserved += new - self._resv[rid]
+        self._resv[rid] = new
+
+    def _new_sid(self, rid):
+        self._sid_counter += 1
+        return (rid, self._sid_counter)
+
+    def _beam_alloc(self, slots, req: Request):
+        """Host/manager phase of beam admission: allocate the prompt under
+        beam 0's key and fork the other beams copy-on-write. Returns the
+        group plus the fork data; the prompt itself rides as ONE row of
+        the shared admission prefill."""
+        k, s, rid = req.num_beams, len(req.prompt), req.req_id
+        nb, max_b = self.mgr.num_blocks, self.max_blocks_per_seq
+        g = _BeamGroup(req=req, slots=list(slots), s=s)
+        g.sid = {j: self._new_sid(rid) for j in range(k)}
+        self.mgr.allocate(g.sid[0], s)
+        rows = np.full((k, max_b), nb, np.int32)
+        copy_src = np.full(k, nb, np.int32)
+        copy_dst = np.full(k, nb, np.int32)
+        for j in range(1, k):
+            pair = self.mgr.fork(g.sid[0], g.sid[j], s)
+            if pair is not None:
+                copy_src[j], copy_dst[j] = pair
+        for j in range(k):
+            t = self.mgr.tables[g.sid[j]]
+            rows[j, :len(t)] = t
+        return g, rows, copy_src, copy_dst
+
+    def _beam_init(self, g: _BeamGroup, rows, copy_src, copy_dst,
+                   logits_row):
+        """Device-state phase after the shared prefill: install the forked
+        tables, init the selection state from the prompt's last logits,
+        then run the group's FIRST select so its slots enter this tick's
+        forward with real beam tokens."""
+        req, s, rid, k = g.req, g.s, g.req.req_id, g.req.num_beams
+        self.cache = _BEAM_GROUP_UPDATE_JIT(
+            self.cache, jnp.asarray(g.slots, jnp.int32), jnp.asarray(rows),
+            jnp.asarray(s, jnp.int32), jnp.asarray(copy_src),
+            jnp.asarray(copy_dst))
+        neg = jnp.float32(-1e9)
+        vocab = self.model.cfg.vocab_size
+        logp0 = jax.nn.log_softmax(logits_row.astype(jnp.float32))
+        g.logp = jnp.broadcast_to(logp0[None], (k, vocab))
+        g.running_lp = jnp.asarray([0.0] + [float(neg)] * (k - 1),
+                                   jnp.float32)
+        max_len = s + req.max_new_tokens
+        g.seqs = jnp.zeros((k, max_len), jnp.int32).at[:, :s].set(
+            jnp.asarray(req.prompt)[None])
+        g.fin_seqs = jnp.zeros_like(g.seqs)
+        g.fin_scores = jnp.full((k,), neg, jnp.float32)
+
+        for slot in g.slots:
+            self.slot_req[slot] = rid
+            self.active[slot] = True
+            self.is_beam[slot] = True
+            self.cur[slot] = s
+        self.groups[rid] = g
+        self._update_resv_group(rid)
+        return self._beam_advance(rid, g)
+
+    def _beam_advance(self, rid: int, g: _BeamGroup):
+        """One beam select over the group's pending logp; fork the caches
+        along the chosen parents (or finalize at the last select).
+        Selection/fork math mirrors ``paged_beam_search`` exactly."""
+        k = g.req.num_beams
+        (g.running_lp, g.seqs, g.fin_seqs, g.fin_scores, new_beam,
+         new_tok) = _BEAM_SELECT_JIT(
+            g.running_lp, g.seqs, g.fin_seqs, g.fin_scores, g.logp,
+            jnp.int32(g.i), g.s, self.eos_token_id,
+            float(g.req.length_penalty))
+        if g.i == g.req.max_new_tokens - 1:
+            return self._finalize_beam(rid, g)
+        parents = np.asarray(new_beam)
+        toks = np.asarray(new_tok)
+        cur = g.s + g.i                       # tokens stored per beam
+        nb, max_b = self.mgr.num_blocks, self.max_blocks_per_seq
+        rows = np.full((k, max_b), nb, np.int32)
+        copy_src = np.full(k, nb, np.int32)
+        copy_dst = np.full(k, nb, np.int32)
+        new_sids = {}
+        for j in range(k):
+            dst = self._new_sid(rid)
+            pair = self.mgr.fork(g.sid[int(parents[j])], dst, cur)
+            if pair is not None:
+                copy_src[j], copy_dst[j] = pair
+            new_sids[j] = dst
+        for j in range(k):
+            self.mgr.free(g.sid[j])
+        g.sid = new_sids
+        for j in range(k):
+            t = self.mgr.allocate(g.sid[j], cur + 1)  # room for the write
+            rows[j, :len(t)] = t
+        self.cache = _BEAM_GROUP_UPDATE_JIT(
+            self.cache, jnp.asarray(g.slots, jnp.int32), jnp.asarray(rows),
+            jnp.asarray(cur, jnp.int32), jnp.asarray(copy_src),
+            jnp.asarray(copy_dst))
+        self._update_resv_group(rid)
+        for j, slot in enumerate(g.slots):
+            self.last_tok[slot] = toks[j]
+        g.i += 1
+        return []
+
+    def _finalize_beam(self, rid: int, g: _BeamGroup):
+        req = g.req
+        best_seq, best_score = _beam_finalize(
+            g.running_lp, g.seqs, g.fin_seqs, g.fin_scores, g.s,
+            req.max_new_tokens, self.eos_token_id,
+            float(req.length_penalty))
+        req.tokens = [int(t) for t in np.asarray(best_seq)[g.s:]]
+        req.beam_score = float(best_score)
+        req.done = True
+        req.finish_reason = "beam"
+        for sid in g.sid.values():
+            self.mgr.free(sid)
+        for slot in g.slots:
+            self.active[slot] = False
+            self.is_beam[slot] = False
+            self.slot_req[slot] = -1
+        self._reserved -= self._resv.pop(rid, 0)
+        self._need.pop(rid, None)
+        del self.groups[rid]
+        return [(rid, t) for t in req.tokens]
 
     # ------------------------------------------------------------- decode
     def _grow_tables(self):
@@ -265,8 +482,8 @@ class LLMEngine:
         rows = np.full(self.num_slots, self.num_slots, np.int32)
         cols = np.zeros(self.num_slots, np.int32)
         vals = np.zeros(self.num_slots, np.int32)
-        crossing = self.active & (self.cur // self.block_size
-                                  >= self.table_len)
+        crossing = self.active & ~self.is_beam & (
+            self.cur // self.block_size >= self.table_len)
         for slot in np.nonzero(crossing)[0]:     # ≤ once per bs ticks/slot
             rid = int(self.slot_req[slot])
             t = self.mgr.allocate(rid, int(self.cur[slot]) + 1)
@@ -276,7 +493,7 @@ class LLMEngine:
             vals[slot] = t[-1]
             self.table_len[slot] = len(t)
         if self.window is not None:
-            self._recycle_window(np.nonzero(self.active)[0])
+            self._recycle_window(np.nonzero(self.active & ~self.is_beam)[0])
         return rows, cols, vals
 
     def _emit(self, slot: int, token: int):
@@ -301,29 +518,35 @@ class LLMEngine:
         return [(rid, token)]
 
     def step(self):
-        """One engine tick: admit waiting requests into free slots (their
-        prefill runs now, interleaved with decode), then one decode tick
-        for every active slot. Returns [(req_id, new_token), ...]."""
+        """One engine tick: advance in-flight beam groups (select + fork,
+        or their final selection), admit waiting requests into free slots
+        (their prefill runs now, interleaved with decode), then one decode
+        tick for every active slot. Returns [(req_id, new_token), ...]
+        (a finishing beam request emits its whole best hypothesis)."""
         from time import perf_counter
         emitted = []
-        admits = self._admit()
-        if admits:
-            emitted += self._prefill(admits)
+        for rid in list(self.groups):
+            emitted += self._beam_advance(rid, self.groups[rid])
+        admits, beam_admits = self._admit()
+        if admits or beam_admits:
+            emitted += self._prefill(admits, beam_admits)
         if not self.active.any():
             return emitted
         t0 = perf_counter()
         rows, cols, vals = self._grow_tables()
         self.rng, sub = jax.random.split(self.rng)
         t1 = perf_counter()
-        nxt, self.cache = _TICK_JIT(
+        nxt, logp, self.cache = _TICK_JIT(
             self.model, jnp.asarray(self.last_tok), self.cache,
             jnp.asarray(self.active), jnp.asarray(rows), jnp.asarray(cols),
-            jnp.asarray(vals), sub, *self.sampling)
+            jnp.asarray(vals), sub, *self.sampling, bool(self.groups))
         was_active = self.active.copy()
         nxt = np.asarray(nxt)                 # the one per-tick host fetch
         t2 = perf_counter()
+        for g in self.groups.values():        # device-resident, lazy gather
+            g.logp = logp[np.asarray(g.slots)]
         self.cur += was_active                # vectorised mirrors
-        for slot in np.nonzero(was_active)[0]:
+        for slot in np.nonzero(was_active & ~self.is_beam)[0]:
             emitted += self._emit(slot, int(nxt[slot]))
         t3 = perf_counter()
         self.stats["host_s"] += (t1 - t0) + (t3 - t2)

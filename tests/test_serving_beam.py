@@ -1,0 +1,124 @@
+"""Beam search INSIDE the continuous-batching engine: a num_beams=K
+request occupies K cache slots, shares prompt blocks copy-on-write, and
+its result equals ``paged_beam_search`` (which itself equals the static
+beam) — including while OTHER requests decode greedily in the same ticks.
+
+Ref: PaddleNLP llm/predict/predictor.py block-attention serving with
+beam/sampling decode strategies.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.decoding import generate
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.paged import paged_beam_search
+from paddle_tpu.serving import LLMEngine, Request
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def win_model():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64, sliding_window=8)
+    return LlamaForCausalLM(cfg)
+
+
+def test_engine_beam_matches_paged_beam_search(model):
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(0, 64, (7,))
+    new, K = 6, 3
+    ref_seq, ref_score = paged_beam_search(model, prompt,
+                                           max_new_tokens=new, num_beams=K,
+                                           eos_token_id=1, block_size=4)
+    eng = LLMEngine(model, num_slots=4, block_size=4, max_prompt_len=16,
+                    max_seq_len=24, eos_token_id=1)
+    rid = eng.add_request(Request(prompt, max_new_tokens=new, num_beams=K))
+    out = eng.run()
+    assert out[rid] == [int(t) for t in np.asarray(ref_seq)[len(prompt):]]
+    assert eng.requests[rid].finish_reason == "beam"
+    np.testing.assert_allclose(eng.requests[rid].beam_score,
+                               float(ref_score), rtol=1e-5)
+    # every block went back to the pool
+    assert eng.mgr.free_blocks == eng.mgr.num_blocks
+
+
+def test_engine_beam_rides_with_greedy_traffic(model):
+    """A beam request and greedy requests interleave in the same ticks;
+    each result equals its isolated reference, under oversubscription."""
+    rs = np.random.RandomState(4)
+    g_prompts = [rs.randint(0, 64, (int(l),))
+                 for l in rs.randint(3, 12, size=5)]
+    b_prompt = rs.randint(0, 64, (6,))
+    new, K = 5, 2
+    ref_seq, _ = paged_beam_search(model, b_prompt, max_new_tokens=new,
+                                   num_beams=K, eos_token_id=1,
+                                   block_size=4)
+    g_refs = [np.asarray(generate(model, p[None], max_new_tokens=new,
+                                  eos_token_id=1))[0]
+              for p in g_prompts]
+
+    eng = LLMEngine(model, num_slots=3, block_size=4, max_prompt_len=16,
+                    max_seq_len=24, eos_token_id=1)
+    rids = [eng.add_request(Request(p, max_new_tokens=new))
+            for p in g_prompts[:2]]
+    beam_rid = eng.add_request(Request(b_prompt, max_new_tokens=new,
+                                       num_beams=K))
+    rids += [eng.add_request(Request(p, max_new_tokens=new))
+             for p in g_prompts[2:]]
+    out = eng.run()
+    assert out[beam_rid] == [int(t)
+                             for t in np.asarray(ref_seq)[len(b_prompt):]]
+    for rid, p, ref in zip(rids, g_prompts, g_refs):
+        got = out[rid]
+        want = [int(t) for t in ref[len(p): len(p) + len(got)]]
+        assert got == want
+        r = eng.requests[rid]
+        if r.finish_reason == "eos":
+            assert got[-1] == 1
+        else:
+            assert len(got) == new
+    assert eng.mgr.free_blocks == eng.mgr.num_blocks
+
+
+def test_engine_beam_blocks_shared_not_duplicated(model):
+    """While the group runs, the prompt's full blocks are SHARED: live
+    pool usage stays far below K * (prompt + generated) blocks."""
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(0, 64, (12,))     # 3 full blocks at bs=4
+    K = 4
+    eng = LLMEngine(model, num_slots=4, block_size=4, max_prompt_len=16,
+                    max_seq_len=32)
+    rid = eng.add_request(Request(prompt, max_new_tokens=8, num_beams=K))
+    eng.step()                            # prefill + first select
+    g = eng.groups[rid]
+    live = eng._group_live_blocks(g)
+    dense = K * eng.mgr.blocks_needed(len(prompt) + 1)
+    assert live < dense, (live, dense)
+    assert live <= eng.mgr.blocks_needed(len(prompt)) + 2 * K
+    eng.run()
+    assert eng.mgr.free_blocks == eng.mgr.num_blocks
+
+
+def test_engine_beam_validation(model, win_model):
+    eng = LLMEngine(model, num_slots=2, block_size=4)
+    with pytest.raises(ValueError, match="num_beams"):
+        eng.add_request(Request([1, 2], num_beams=0))
+    with pytest.raises(ValueError, match="exceeds num_slots"):
+        eng.add_request(Request([1, 2], num_beams=3))
+    with pytest.raises(ValueError, match="streaming"):
+        eng.add_request(Request([1, 2], num_beams=2,
+                                stream=lambda r, t: None))
+    weng = LLMEngine(win_model, num_slots=4, block_size=4)
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        weng.add_request(Request([1, 2], num_beams=2))
