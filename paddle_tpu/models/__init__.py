@@ -5,6 +5,8 @@ from paddle_tpu.models.bert import (
     BertModel,
 )
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
+from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
+from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, LlamaModel
 from paddle_tpu.models.moe_llm import MoEConfig, MoEForCausalLM

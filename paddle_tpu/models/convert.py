@@ -304,3 +304,86 @@ def load_bloom_state_dict(model, state_dict, dtype=None):
         blk.four_h_to_h = j(sd[p + "mlp.dense_4h_to_h.weight"].T)
         blk.four_h_to_h_bias = j(sd[p + "mlp.dense_4h_to_h.bias"])
     return model
+
+
+def load_opt_state_dict(model, state_dict, dtype=None):
+    """Populate an ``OPTForCausalLM`` from an HF state_dict (keys under
+    ``model.decoder.``; lm_head is tied to embed_tokens). Covers the 350m
+    shape too (project_in/out, post-norm blocks)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("model.").removeprefix("decoder."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    model.embed_tokens = j(sd["embed_tokens.weight"])
+    model.embed_positions = j(sd["embed_positions.weight"])
+    if model.project_in is not None:
+        model.project_in = j(sd["project_in.weight"].T)
+        model.project_out = j(sd["project_out.weight"].T)
+    if model.final_layer_norm is not None:
+        ln(model.final_layer_norm, "final_layer_norm")
+    for i, blk in enumerate(model.layers):
+        p = f"layers.{i}."
+        ln(blk.self_attn_layer_norm, p + "self_attn_layer_norm")
+        ln(blk.final_layer_norm, p + "final_layer_norm")
+        for ours, theirs in [("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                             ("v_proj", "v_proj"), ("out_proj", "out_proj")]:
+            setattr(blk, ours, j(sd[p + f"self_attn.{theirs}.weight"].T))
+            setattr(blk, ours.replace("_proj", "") + "_bias"
+                    if ours != "out_proj" else "out_bias",
+                    j(sd[p + f"self_attn.{theirs}.bias"]))
+        blk.fc1 = j(sd[p + "fc1.weight"].T)
+        blk.fc1_bias = j(sd[p + "fc1.bias"])
+        blk.fc2 = j(sd[p + "fc2.weight"].T)
+        blk.fc2_bias = j(sd[p + "fc2.bias"])
+    return model
+
+
+def load_gpt_neox_state_dict(model, state_dict, dtype=None):
+    """Populate a ``GPTNeoXForCausalLM`` from an HF state_dict. HF fuses
+    QKV head-interleaved ([nh, 3, d] out-dim, same as BLOOM); ours is
+    [q|k|v] blocks. ``embed_out`` is untied ([vocab, h] -> transposed)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("gpt_neox."): _np(v)
+          for k, v in state_dict.items()}
+    nh = cfg.num_attention_heads
+    d = cfg.hidden_size // nh
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    model.embed_in = j(sd["embed_in.weight"])
+    model.embed_out = j(sd["embed_out.weight"].T)
+    ln(model.final_layer_norm, "final_layer_norm")
+    for i, blk in enumerate(model.layers):
+        p = f"layers.{i}."
+        ln(blk.input_layernorm, p + "input_layernorm")
+        ln(blk.post_attention_layernorm, p + "post_attention_layernorm")
+        w = sd[p + "attention.query_key_value.weight"]       # [3h, h]
+        w = w.reshape(nh, 3, d, cfg.hidden_size)
+        blk.qkv = j(np.concatenate(
+            [w[:, 0].reshape(nh * d, -1), w[:, 1].reshape(nh * d, -1),
+             w[:, 2].reshape(nh * d, -1)], axis=0).T)        # [h, 3h]
+        b = sd[p + "attention.query_key_value.bias"].reshape(nh, 3, d)
+        blk.qkv_bias = j(np.concatenate(
+            [b[:, 0].reshape(-1), b[:, 1].reshape(-1),
+             b[:, 2].reshape(-1)]))
+        blk.dense = j(sd[p + "attention.dense.weight"].T)
+        blk.dense_bias = j(sd[p + "attention.dense.bias"])
+        blk.h_to_4h = j(sd[p + "mlp.dense_h_to_4h.weight"].T)
+        blk.h_to_4h_bias = j(sd[p + "mlp.dense_h_to_4h.bias"])
+        blk.four_h_to_h = j(sd[p + "mlp.dense_4h_to_h.weight"].T)
+        blk.four_h_to_h_bias = j(sd[p + "mlp.dense_4h_to_h.bias"])
+    return model

@@ -1,0 +1,145 @@
+"""GPT-NeoX decoder LM (ref capability: PaddleNLP ``gpt_neox`` /
+Pythia-family checkpoints; ``paddlenlp.transformers`` GPTNeoX classes).
+
+The partial-rotary, parallel-residual member of the model zoo:
+  * rope covers only the first ``rotary_pct`` of each head's dims
+    (Pythia: 25%); the rest pass through unrotated.
+  * ``use_parallel_residual``: attention and MLP both read the SAME block
+    input through their own LayerNorms and their outputs are summed with
+    the residual in one step — one residual add per block, not two. (The
+    sequential form is also supported for the few non-parallel configs.)
+  * fused head-interleaved QKV in HF ([nh, 3, d] out-dim layout),
+    re-laid out to [q|k|v] blocks at load (convert.py), untied embed_out.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    rotary_pct: float = 0.25
+    rotary_emb_base: float = 10000.0
+    max_position_embeddings: int = 2048
+    use_parallel_residual: bool = True
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+
+    @staticmethod
+    def tiny(**kw):
+        return GPTNeoXConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                       num_hidden_layers=2,
+                                       num_attention_heads=4,
+                                       intermediate_size=64,
+                                       max_position_embeddings=64,
+                                       dtype=jnp.float32, remat=False),
+                                **kw})
+
+
+def _rope_partial(x, cos, sin, rot_dims):
+    """Rotate only the first ``rot_dims`` of the head dim (NeoX partial
+    rotary); the tail passes through."""
+    rot, rest = x[..., :rot_dims], x[..., rot_dims:]
+    return jnp.concatenate([A.apply_rope(rot, cos, sin), rest], axis=-1)
+
+
+class GPTNeoXLayer(Module):
+    def __init__(self, cfg: GPTNeoXConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.input_layernorm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                         dtype=cfg.dtype)
+        self.post_attention_layernorm = LayerNorm(
+            h, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype)
+        # our layout: [h, 3h] columns = [q all heads | k | v]
+        self.qkv = init((h, 3 * h), cfg.dtype)
+        self.qkv_bias = jnp.zeros((3 * h,), cfg.dtype)
+        self.dense = init((h, h), cfg.dtype)
+        self.dense_bias = jnp.zeros((h,), cfg.dtype)
+        self.h_to_4h = init((h, cfg.intermediate_size), cfg.dtype)
+        self.h_to_4h_bias = jnp.zeros((cfg.intermediate_size,), cfg.dtype)
+        self.four_h_to_h = init((cfg.intermediate_size, h), cfg.dtype)
+        self.four_h_to_h_bias = jnp.zeros((h,), cfg.dtype)
+        self.n_head = cfg.num_attention_heads
+        self.parallel = cfg.use_parallel_residual
+
+    def _attn(self, h, cos, sin, rot_dims):
+        b, s, hd = h.shape
+        nh = self.n_head
+        d = hd // nh
+        qkv = h @ self.qkv + self.qkv_bias
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = _rope_partial(q.reshape(b, s, nh, d), cos, sin, rot_dims)
+        k = _rope_partial(k.reshape(b, s, nh, d), cos, sin, rot_dims)
+        att = A.scaled_dot_product_attention(q, k, v.reshape(b, s, nh, d),
+                                             is_causal=True)
+        return att.reshape(b, s, hd) @ self.dense + self.dense_bias
+
+    def _mlp(self, h):
+        m = jax.nn.gelu(h @ self.h_to_4h + self.h_to_4h_bias,
+                        approximate=False)
+        return m @ self.four_h_to_h + self.four_h_to_h_bias
+
+    def __call__(self, x, cos, sin, rot_dims):
+        att = self._attn(self.input_layernorm(x), cos, sin, rot_dims)
+        if self.parallel:
+            return x + att + self._mlp(self.post_attention_layernorm(x))
+        x = x + att
+        return x + self._mlp(self.post_attention_layernorm(x))
+
+
+class GPTNeoXForCausalLM(Module):
+    def __init__(self, cfg: GPTNeoXConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.embed_in = init((cfg.vocab_size, h), cfg.dtype)
+        self.layers = [GPTNeoXLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.final_layer_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                          dtype=cfg.dtype)
+        self.embed_out = init((h, cfg.vocab_size), cfg.dtype)  # untied
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        d = cfg.hidden_size // cfg.num_attention_heads
+        rot = int(d * cfg.rotary_pct)
+        cos, sin = A.rope_cos_sin(s, rot, base=cfg.rotary_emb_base)
+        x = jnp.take(self.embed_in, input_ids, axis=0)
+        blk = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin, rot))
+               if cfg.remat else (lambda lyr, h: lyr(h, cos, sin, rot)))
+        for lyr in self.layers:
+            x = blk(lyr, x)
+        x = self.final_layer_norm(x)
+        return x @ self.embed_out
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,143 @@
+"""OPT decoder LM (ref capability: PaddleNLP ``opt`` model family /
+``paddlenlp.transformers.OPTForCausalLM``).
+
+The learned-position member of the model zoo: no rotary/ALiBi — positions
+come from a trained embedding table read at ``position + 2`` (the HF
+offset convention, inherited from fairseq's padding index). Architecture
+(HF ``OPTModel``): word embeddings (optionally projected in/out when
+``word_embed_proj_dim != hidden_size``, the 350m shape), blocks of
+[LN -> MHA -> LN -> fc1 relu fc2] — pre-norm when
+``do_layer_norm_before`` (everything except 350m), post-norm otherwise —
+final LN (pre-norm only), lm head tied to the word embeddings.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    do_layer_norm_before: bool = True
+    word_embed_proj_dim: int = None      # != hidden_size only for 350m
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+        if self.word_embed_proj_dim is None:
+            self.word_embed_proj_dim = self.hidden_size
+
+    @staticmethod
+    def tiny(**kw):
+        return OPTConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                   ffn_dim=64, num_hidden_layers=2,
+                                   num_attention_heads=4,
+                                   max_position_embeddings=64,
+                                   dtype=jnp.float32, remat=False), **kw})
+
+
+class OPTDecoderLayer(Module):
+    def __init__(self, cfg: OPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.self_attn_layer_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                              dtype=cfg.dtype)
+        self.q_proj = init((h, h), cfg.dtype)
+        self.k_proj = init((h, h), cfg.dtype)
+        self.v_proj = init((h, h), cfg.dtype)
+        self.out_proj = init((h, h), cfg.dtype)
+        self.q_bias = jnp.zeros((h,), cfg.dtype)
+        self.k_bias = jnp.zeros((h,), cfg.dtype)
+        self.v_bias = jnp.zeros((h,), cfg.dtype)
+        self.out_bias = jnp.zeros((h,), cfg.dtype)
+        self.final_layer_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                          dtype=cfg.dtype)
+        self.fc1 = init((h, cfg.ffn_dim), cfg.dtype)
+        self.fc1_bias = jnp.zeros((cfg.ffn_dim,), cfg.dtype)
+        self.fc2 = init((cfg.ffn_dim, h), cfg.dtype)
+        self.fc2_bias = jnp.zeros((h,), cfg.dtype)
+        self.n_head = cfg.num_attention_heads
+        self.pre_norm = cfg.do_layer_norm_before
+
+    def __call__(self, x):
+        b, s, hd = x.shape
+        nh = self.n_head
+        d = hd // nh
+        h = self.self_attn_layer_norm(x) if self.pre_norm else x
+        q = (h @ self.q_proj + self.q_bias).reshape(b, s, nh, d)
+        k = (h @ self.k_proj + self.k_bias).reshape(b, s, nh, d)
+        v = (h @ self.v_proj + self.v_bias).reshape(b, s, nh, d)
+        att = A.scaled_dot_product_attention(q, k, v, is_causal=True)
+        x = x + att.reshape(b, s, hd) @ self.out_proj + self.out_bias
+        if not self.pre_norm:
+            x = self.self_attn_layer_norm(x)
+        h2 = self.final_layer_norm(x) if self.pre_norm else x
+        m = jax.nn.relu(h2 @ self.fc1 + self.fc1_bias)
+        x = x + m @ self.fc2 + self.fc2_bias
+        if not self.pre_norm:
+            x = self.final_layer_norm(x)
+        return x
+
+
+class OPTForCausalLM(Module):
+    def __init__(self, cfg: OPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        e = cfg.word_embed_proj_dim
+        h = cfg.hidden_size
+        self.embed_tokens = init((cfg.vocab_size, e), cfg.dtype)
+        # HF offset: row p+2 holds position p (fairseq padding heritage)
+        self.embed_positions = init((cfg.max_position_embeddings + 2, h),
+                                    cfg.dtype)
+        self.project_in = None if e == h else init((e, h), cfg.dtype)
+        self.project_out = None if e == h else init((h, e), cfg.dtype)
+        self.layers = [OPTDecoderLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.final_layer_norm = (LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                           dtype=cfg.dtype)
+                                 if cfg.do_layer_norm_before else None)
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        if self.project_in is not None:
+            x = x @ self.project_in
+        x = x + self.embed_positions[2: s + 2][None]
+        blk = jax.checkpoint(lambda lyr, h: lyr(h)) if cfg.remat \
+            else (lambda lyr, h: lyr(h))
+        for lyr in self.layers:
+            x = blk(lyr, x)
+        if self.final_layer_norm is not None:
+            x = self.final_layer_norm(x)
+        if self.project_out is not None:
+            x = x @ self.project_out
+        return x @ self.embed_tokens.T       # tied head
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

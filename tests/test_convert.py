@@ -332,3 +332,101 @@ def test_bloom_non_power_of_two_heads():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_opt_logits_match_transformers():
+    """OPT (learned positions at offset 2, pre-norm): logits match HF."""
+    import torch
+    from transformers import OPTConfig as HFConfig
+    from transformers import OPTForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32, ffn_dim=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          max_position_embeddings=64, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_opt_state_dict
+    from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM
+
+    pt.seed(0)
+    cfg = OPTConfig(vocab_size=96, hidden_size=32, ffn_dim=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64, dtype=jnp.float32,
+                    remat=False)
+    ours = load_opt_state_dict(OPTForCausalLM(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_opt_350m_shape_project_and_post_norm():
+    """The 350m peculiarities: word_embed_proj_dim != hidden (project_in/
+    out) AND post-norm blocks (do_layer_norm_before=False, no final LN)."""
+    import torch
+    from transformers import OPTConfig as HFConfig
+    from transformers import OPTForCausalLM as HFModel
+
+    torch.manual_seed(1)
+    hf = HFModel(HFConfig(vocab_size=64, hidden_size=32, ffn_dim=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          max_position_embeddings=64, use_cache=False,
+                          word_embed_proj_dim=16,
+                          do_layer_norm_before=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_opt_state_dict
+    from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM
+
+    pt.seed(0)
+    cfg = OPTConfig(vocab_size=64, hidden_size=32, ffn_dim=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64, word_embed_proj_dim=16,
+                    do_layer_norm_before=False, dtype=jnp.float32,
+                    remat=False)
+    ours = load_opt_state_dict(OPTForCausalLM(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(1)
+    ids = rs.randint(0, 64, (1, 9))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_gpt_neox_logits_match_transformers(parallel):
+    """GPT-NeoX/Pythia (partial rotary 25%, parallel residual, fused
+    head-interleaved QKV, untied embed_out): logits match HF."""
+    import torch
+    from transformers import GPTNeoXConfig as HFConfig
+    from transformers import GPTNeoXForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=64, rotary_pct=0.25,
+                          max_position_embeddings=64, use_cache=False,
+                          use_parallel_residual=parallel,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_gpt_neox_state_dict
+    from paddle_tpu.models.gpt_neox import (GPTNeoXConfig,
+                                            GPTNeoXForCausalLM)
+
+    pt.seed(0)
+    cfg = GPTNeoXConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=64,
+                        rotary_pct=0.25, max_position_embeddings=64,
+                        use_parallel_residual=parallel, dtype=jnp.float32,
+                        remat=False)
+    ours = load_gpt_neox_state_dict(GPTNeoXForCausalLM(cfg).eval(),
+                                    hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
