@@ -5,6 +5,9 @@ from paddle_tpu.models.bert import (
     BertModel,
 )
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
+from paddle_tpu.models.ernie import (ErnieConfig, ErnieForMaskedLM,
+                                     ErnieForSequenceClassification,
+                                     ErnieModel)
 from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
 from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM

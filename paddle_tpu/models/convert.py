@@ -387,3 +387,39 @@ def load_gpt_neox_state_dict(model, state_dict, dtype=None):
         blk.four_h_to_h = j(sd[p + "mlp.dense_4h_to_h.weight"].T)
         blk.four_h_to_h_bias = j(sd[p + "mlp.dense_4h_to_h.bias"])
     return model
+
+
+def load_ernie_state_dict(model, state_dict, dtype=None):
+    """Populate an ``ErnieForMaskedLM``/``ErnieModel`` from an HF
+    state_dict (``ernie.*`` naming). The encoder block layout is BERT's,
+    so the shared parts route through ``load_bert_state_dict`` with the
+    prefix remapped; ERNIE's task_type embedding and the MLM head load
+    here."""
+    cfg = model.cfg
+    dtype = dtype or jnp.float32
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    remapped = {("bert." + k.removeprefix("ernie.")): v
+                for k, v in sd.items() if k.startswith("ernie.")}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    ernie = model.ernie if hasattr(model, "ernie") else model
+
+    class _Shim:                       # load_bert_state_dict reads .bert
+        bert = ernie
+    load_bert_state_dict(_Shim(), remapped, dtype=dtype)
+    tte = "ernie.embeddings.task_type_embeddings.weight"
+    if ernie.embeddings.task_type_embeddings is not None:
+        ernie.embeddings.task_type_embeddings.weight = j(sd[tte])
+    if hasattr(model, "mlm_transform") and "cls.predictions.bias" in sd:
+        model.mlm_transform.weight = j(
+            sd["cls.predictions.transform.dense.weight"].T)
+        model.mlm_transform.bias = j(
+            sd["cls.predictions.transform.dense.bias"])
+        model.mlm_norm.weight = j(
+            sd["cls.predictions.transform.LayerNorm.weight"])
+        model.mlm_norm.bias = j(
+            sd["cls.predictions.transform.LayerNorm.bias"])
+        model.mlm_bias = j(sd["cls.predictions.bias"])
+    return model

@@ -1,0 +1,171 @@
+"""ERNIE (ref: PaddleNLP ``paddlenlp/transformers/ernie/modeling.py`` —
+Baidu's flagship pretrained encoder family, ERNIE 1.0/3.0).
+
+Structurally a BERT-style post-LN encoder (the blocks ARE ``BertLayer``)
+plus ERNIE's task-type embedding: a third id stream (``task_type_ids``)
+marking which pretraining task a segment came from, added into the
+embedding sum when ``use_task_id`` (ERNIE 3.0 checkpoints). HF's
+``ErnieForMaskedLM`` is the parity reference (tests/test_convert.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.bert import BertLayer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    use_task_id: bool = True
+    task_type_vocab_size: int = 3
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return ErnieConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                     num_hidden_layers=2,
+                                     num_attention_heads=2,
+                                     intermediate_size=64,
+                                     max_position_embeddings=64), **kw})
+
+    def _bert_view(self):
+        """The shared-field view BertLayer construction reads."""
+        from paddle_tpu.models.bert import BertConfig
+        return BertConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            intermediate_size=self.intermediate_size,
+            hidden_dropout_prob=self.hidden_dropout_prob,
+            attention_probs_dropout_prob=self.attention_probs_dropout_prob,
+            max_position_embeddings=self.max_position_embeddings,
+            type_vocab_size=self.type_vocab_size,
+            layer_norm_eps=self.layer_norm_eps,
+            initializer_range=self.initializer_range, dtype=self.dtype)
+
+
+class ErnieEmbeddings(Module):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, h,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, h,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.task_type_embeddings = (
+            Embedding(cfg.task_type_vocab_size, h, weight_init=init,
+                      dtype=cfg.dtype) if cfg.use_task_id else None)
+        self.layer_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                    dtype=cfg.dtype)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None,
+                 task_type_ids=None, rng=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = jnp.zeros_like(input_ids)
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x), rng=rng)
+
+
+class ErnieModel(Module):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        bcfg = cfg._bert_view()
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.layers = [BertLayer(bcfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size,
+                             dtype=cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 task_type_ids=None, rng=None):
+        import jax
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        x = self.embeddings(input_ids, token_type_ids,
+                            task_type_ids=task_type_ids, rng=rng)
+        for i, lyr in enumerate(self.layers):
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = lyr(x, attn_mask=attention_mask, rng=sub)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForMaskedLM(Module):
+    """MLM head (HF ``ErnieForMaskedLM``): transform + LN + tied decoder."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    dtype=cfg.dtype)
+        self.mlm_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.mlm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 task_type_ids=None, rng=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, attention_mask,
+                            task_type_ids=task_type_ids, rng=rng)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        return (h @ self.ernie.embeddings.word_embeddings.weight.T
+                + self.mlm_bias)
+
+    def loss(self, input_ids, mlm_labels, token_type_ids=None,
+             attention_mask=None, task_type_ids=None, rng=None):
+        logits = self(input_ids, token_type_ids, attention_mask,
+                      task_type_ids=task_type_ids, rng=rng)
+        ce = F.cross_entropy(logits, jnp.maximum(mlm_labels, 0),
+                             reduction="none")
+        mask = (mlm_labels >= 0).astype(jnp.float32)
+        return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class ErnieForSequenceClassification(Module):
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.classifier = Linear(cfg.hidden_size, num_classes,
+                                 dtype=cfg.dtype)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 task_type_ids=None, rng=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask,
+                               task_type_ids=task_type_ids, rng=rng)
+        return self.classifier(self.dropout(pooled, rng=rng))

@@ -430,3 +430,45 @@ def test_gpt_neox_logits_match_transformers(parallel):
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("use_task_id", [True, False])
+def test_ernie_mlm_logits_match_transformers(use_task_id):
+    """ERNIE (Baidu's flagship encoder: BERT blocks + task-type
+    embeddings): MLM logits match HF, with and without task ids."""
+    import torch
+    from transformers import ErnieConfig as HFConfig
+    from transformers import ErnieForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=64, type_vocab_size=4,
+                          use_task_id=use_task_id, task_type_vocab_size=3,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_ernie_state_dict
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForMaskedLM
+
+    pt.seed(0)
+    cfg = ErnieConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=64,
+                      max_position_embeddings=64, type_vocab_size=4,
+                      use_task_id=use_task_id, task_type_vocab_size=3)
+    ours = load_ernie_state_dict(ErnieForMaskedLM(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 4, (2, 12))
+    kw_hf, kw_us = {}, {}
+    if use_task_id:
+        task = rs.randint(0, 3, (2, 12))
+        kw_hf["task_type_ids"] = torch.tensor(task)
+        kw_us["task_type_ids"] = jnp.asarray(task)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids), token_type_ids=torch.tensor(tt),
+                 **kw_hf).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids), token_type_ids=jnp.asarray(tt),
+                          **kw_us), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
