@@ -8,7 +8,9 @@ from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
 from paddle_tpu.models.ernie import (ErnieConfig, ErnieForMaskedLM,
                                      ErnieForSequenceClassification,
                                      ErnieModel)
+from paddle_tpu.models.falcon import FalconConfig, FalconForCausalLM
 from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
+from paddle_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
 from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, LlamaModel

@@ -423,3 +423,94 @@ def load_ernie_state_dict(model, state_dict, dtype=None):
             sd["cls.predictions.transform.LayerNorm.bias"])
         model.mlm_bias = j(sd["cls.predictions.bias"])
     return model
+
+
+def load_gptj_state_dict(model, state_dict, dtype=None):
+    """Populate a ``GPTJForCausalLM`` from an HF state_dict
+    (``transformer.*`` naming; separate biased lm_head, untied)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    model.wte = j(sd["wte.weight"])
+    model.ln_f.weight = j(sd["ln_f.weight"])
+    model.ln_f.bias = j(sd["ln_f.bias"])
+    model.lm_head = j(sd["lm_head.weight"].T)
+    model.lm_head_bias = j(sd["lm_head.bias"])
+    for i, blk in enumerate(model.h):
+        p = f"h.{i}."
+        blk.ln_1.weight = j(sd[p + "ln_1.weight"])
+        blk.ln_1.bias = j(sd[p + "ln_1.bias"])
+        for name in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            setattr(blk, name, j(sd[p + f"attn.{name}.weight"].T))
+        blk.fc_in = j(sd[p + "mlp.fc_in.weight"].T)
+        blk.fc_in_bias = j(sd[p + "mlp.fc_in.bias"])
+        blk.fc_out = j(sd[p + "mlp.fc_out.weight"].T)
+        blk.fc_out_bias = j(sd[p + "mlp.fc_out.bias"])
+    return model
+
+
+def load_falcon_state_dict(model, state_dict, dtype=None):
+    """Populate a ``FalconForCausalLM`` from an HF state_dict. The fused
+    QKV layout differs per variant: grouped [q*r | k | v] per kv head
+    (new decoder architecture), [all q | k | v] (multi_query), or
+    head-interleaved (falcon-rw) — all re-laid out to separate q/k/v."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+    nh = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    d = cfg.hidden_size // nh
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def split_qkv(w):
+        """[out, h] (or [out] bias) -> (q, k, v) along the out dim."""
+        if cfg.new_decoder_architecture:
+            r = nh // nkv
+            w = w.reshape((nkv, r + 2, d) + w.shape[1:])
+            return (w[:, :r].reshape((nh * d,) + w.shape[3:]),
+                    w[:, r].reshape((nkv * d,) + w.shape[3:]),
+                    w[:, r + 1].reshape((nkv * d,) + w.shape[3:]))
+        if cfg.multi_query:
+            return w[:nh * d], w[nh * d:(nh + 1) * d], w[(nh + 1) * d:]
+        w = w.reshape((nh, 3, d) + w.shape[1:])      # rw: interleaved
+        return (w[:, 0].reshape((nh * d,) + w.shape[3:]),
+                w[:, 1].reshape((nh * d,) + w.shape[3:]),
+                w[:, 2].reshape((nh * d,) + w.shape[3:]))
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    model.word_embeddings = j(sd["word_embeddings.weight"])
+    ln(model.ln_f, "ln_f")
+    for i, blk in enumerate(model.h):
+        p = f"h.{i}."
+        if cfg.new_decoder_architecture:
+            ln(blk.ln_attn, p + "ln_attn")
+            ln(blk.ln_mlp, p + "ln_mlp")
+        else:
+            ln(blk.input_layernorm, p + "input_layernorm")
+            if blk.post_attention_layernorm is not None:
+                ln(blk.post_attention_layernorm,
+                   p + "post_attention_layernorm")
+        q, k, v = split_qkv(sd[p + "self_attention.query_key_value.weight"])
+        blk.wq, blk.wk, blk.wv = j(q.T), j(k.T), j(v.T)
+        blk.dense = j(sd[p + "self_attention.dense.weight"].T)
+        blk.h_to_4h = j(sd[p + "mlp.dense_h_to_4h.weight"].T)
+        blk.four_h_to_h = j(sd[p + "mlp.dense_4h_to_h.weight"].T)
+        if cfg.bias:
+            qb, kb, vb = split_qkv(
+                sd[p + "self_attention.query_key_value.bias"])
+            blk.wq_bias, blk.wk_bias, blk.wv_bias = j(qb), j(kb), j(vb)
+            blk.dense_bias = j(sd[p + "self_attention.dense.bias"])
+            blk.h_to_4h_bias = j(sd[p + "mlp.dense_h_to_4h.bias"])
+            blk.four_h_to_h_bias = j(sd[p + "mlp.dense_4h_to_h.bias"])
+    return model

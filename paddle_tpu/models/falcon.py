@@ -1,0 +1,176 @@
+"""Falcon decoder LM (ref capability: PaddleNLP/FlagAI Falcon family —
+``tiiuae/falcon-*`` checkpoints; HF ``FalconForCausalLM`` is the parity
+reference).
+
+The multi-query member of the model zoo: falcon-7b runs ONE shared K/V
+head (multi_query) under a single-LN parallel block (attention and MLP
+both read ``input_layernorm(x)``); the 40b/180b "new decoder
+architecture" runs grouped K/V heads with separate ``ln_attn``/``ln_mlp``.
+Rotary is LLaMA-style rotate-half over the full head dim; the falcon-rw
+variants use ALiBi instead (BLOOM's slope schedule) with sequential
+residuals. All variants share tied word embeddings.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.bloom import alibi_slopes
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class FalconConfig:
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 71
+    num_kv_heads: int = None         # new_decoder_architecture only
+    new_decoder_architecture: bool = False
+    multi_query: bool = True
+    parallel_attn: bool = True
+    bias: bool = False
+    alibi: bool = False
+    rope_theta: float = 10000.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+
+    @property
+    def kv_heads(self):
+        if self.new_decoder_architecture:
+            return self.num_kv_heads or self.num_attention_heads
+        return 1 if self.multi_query else self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw):
+        return FalconConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                      num_hidden_layers=2,
+                                      num_attention_heads=4,
+                                      dtype=jnp.float32, remat=False),
+                               **kw})
+
+
+class FalconDecoderLayer(Module):
+    def __init__(self, cfg: FalconConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        nkv = cfg.kv_heads
+        d = h // cfg.num_attention_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        eps = cfg.layer_norm_epsilon
+        if cfg.new_decoder_architecture:
+            self.ln_attn = LayerNorm(h, epsilon=eps, dtype=cfg.dtype)
+            self.ln_mlp = LayerNorm(h, epsilon=eps, dtype=cfg.dtype)
+            self.input_layernorm = None
+            self.post_attention_layernorm = None
+        else:
+            self.input_layernorm = LayerNorm(h, epsilon=eps, dtype=cfg.dtype)
+            self.ln_attn = self.ln_mlp = None
+            self.post_attention_layernorm = (
+                None if cfg.parallel_attn
+                else LayerNorm(h, epsilon=eps, dtype=cfg.dtype))
+        self.wq = init((h, h), cfg.dtype)
+        self.wk = init((h, nkv * d), cfg.dtype)
+        self.wv = init((h, nkv * d), cfg.dtype)
+        self.dense = init((h, h), cfg.dtype)
+        zb = (lambda n: jnp.zeros((n,), cfg.dtype)) if cfg.bias else \
+            (lambda n: None)
+        self.wq_bias, self.wk_bias = zb(h), zb(nkv * d)
+        self.wv_bias, self.dense_bias = zb(nkv * d), zb(h)
+        self.h_to_4h = init((h, 4 * h), cfg.dtype)
+        self.four_h_to_h = init((4 * h, h), cfg.dtype)
+        self.h_to_4h_bias, self.four_h_to_h_bias = zb(4 * h), zb(h)
+        self.cfg_ref = (cfg.num_attention_heads, nkv, cfg.parallel_attn,
+                        cfg.alibi)
+
+    def _proj(self, x, w, b):
+        y = x @ w
+        return y if b is None else y + b
+
+    def _attn(self, h, cos, sin, slopes):
+        b, s, hd = h.shape
+        nh, nkv, _, alibi = self.cfg_ref
+        d = hd // nh
+        q = self._proj(h, self.wq, self.wq_bias).reshape(b, s, nh, d)
+        k = self._proj(h, self.wk, self.wk_bias).reshape(b, s, nkv, d)
+        v = self._proj(h, self.wv, self.wv_bias).reshape(b, s, nkv, d)
+        if not alibi:
+            q, k = A.apply_rope(q, cos, sin), A.apply_rope(k, cos, sin)
+        att = A.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            alibi_slopes=slopes if alibi else None)
+        return self._proj(att.reshape(b, s, hd), self.dense,
+                          self.dense_bias)
+
+    def _mlp(self, h):
+        m = jax.nn.gelu(self._proj(h, self.h_to_4h, self.h_to_4h_bias),
+                        approximate=False)
+        return self._proj(m, self.four_h_to_h, self.four_h_to_h_bias)
+
+    def __call__(self, x, cos, sin, slopes):
+        _, _, parallel, _ = self.cfg_ref
+        if self.ln_attn is not None:        # new decoder architecture
+            return (x + self._attn(self.ln_attn(x), cos, sin, slopes)
+                    + self._mlp(self.ln_mlp(x)))
+        h = self.input_layernorm(x)
+        att = self._attn(h, cos, sin, slopes)
+        if parallel:                        # 7b: ONE ln feeds attn and mlp
+            return x + att + self._mlp(h)
+        x = x + att                         # falcon-rw: sequential
+        return x + self._mlp(self.post_attention_layernorm(x))
+
+
+class FalconForCausalLM(Module):
+    def __init__(self, cfg: FalconConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = init((cfg.vocab_size, cfg.hidden_size),
+                                    cfg.dtype)
+        self.h = [FalconDecoderLayer(cfg)
+                  for _ in range(cfg.num_hidden_layers)]
+        self.ln_f = LayerNorm(cfg.hidden_size,
+                              epsilon=cfg.layer_norm_epsilon,
+                              dtype=cfg.dtype)
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        d = cfg.hidden_size // cfg.num_attention_heads
+        cos, sin = A.rope_cos_sin(s, d, base=cfg.rope_theta)
+        # Parity with HF transformers' Falcon: the model folds alibi/sqrt(d)
+        # into the causal mask (FalconModel._update_causal_mask) AND the
+        # eager attention adds alibi again before scaling by 1/sqrt(d)
+        # ((scores + alibi) * inv_norm_factor) — the effective bias is
+        # 2*m/sqrt(d). We reproduce the reference implementation's numbers,
+        # double-add included (verified against tiny checkpoints in
+        # tests/test_convert.py).
+        slopes = (alibi_slopes(cfg.num_attention_heads) * (2.0 * d ** -0.5)
+                  if cfg.alibi else None)
+        x = jnp.take(self.word_embeddings, input_ids, axis=0)
+        blk = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin, slopes))
+               if cfg.remat else (lambda lyr, h: lyr(h, cos, sin, slopes)))
+        for lyr in self.h:
+            x = blk(lyr, x)
+        x = self.ln_f(x)
+        return x @ self.word_embeddings.T    # tied head
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,119 @@
+"""GPT-J decoder LM (ref capability: PaddleNLP ``gptj`` model family /
+``paddlenlp.transformers.GPTJForCausalLM``).
+
+The INTERLEAVED-rotary member of the model zoo: rope pairs are the even/
+odd lanes ``(x[2i], x[2i+1])`` over the first ``rotary_dim`` dims (unlike
+LLaMA/NeoX's half-split), attention and MLP read the SAME LayerNorm
+output and sum into one residual (single-LN parallel block), q/k/v/out
+projections carry no bias, and the LM head is a separate biased linear
+(untied).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class GPTJConfig:
+    vocab_size: int = 50400
+    n_embd: int = 4096
+    n_layer: int = 28
+    n_head: int = 16
+    rotary_dim: int = 64
+    n_inner: int = None                  # default 4 * n_embd
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+        if self.n_inner is None:
+            self.n_inner = 4 * self.n_embd
+
+    @staticmethod
+    def tiny(**kw):
+        return GPTJConfig(**{**dict(vocab_size=128, n_embd=32, n_layer=2,
+                                    n_head=4, rotary_dim=4,
+                                    dtype=jnp.float32, remat=False), **kw})
+
+
+class GPTJBlock(Module):
+    def __init__(self, cfg: GPTJConfig):
+        super().__init__()
+        h = cfg.n_embd
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.ln_1 = LayerNorm(h, epsilon=cfg.layer_norm_epsilon,
+                              dtype=cfg.dtype)
+        self.q_proj = init((h, h), cfg.dtype)    # no biases (GPT-J)
+        self.k_proj = init((h, h), cfg.dtype)
+        self.v_proj = init((h, h), cfg.dtype)
+        self.out_proj = init((h, h), cfg.dtype)
+        self.fc_in = init((h, cfg.n_inner), cfg.dtype)
+        self.fc_in_bias = jnp.zeros((cfg.n_inner,), cfg.dtype)
+        self.fc_out = init((cfg.n_inner, h), cfg.dtype)
+        self.fc_out_bias = jnp.zeros((h,), cfg.dtype)
+        self.n_head = cfg.n_head
+        self.rotary_dim = cfg.rotary_dim
+
+    def __call__(self, x, cos, sin):
+        b, s, hd = x.shape
+        nh = self.n_head
+        d = hd // nh
+        rot = self.rotary_dim
+        h = self.ln_1(x)                         # ONE LN feeds attn AND mlp
+
+        def rope(t):
+            r = A.apply_rope_interleaved(t[..., :rot], cos, sin)
+            return jnp.concatenate([r, t[..., rot:]], axis=-1)
+
+        q = rope((h @ self.q_proj).reshape(b, s, nh, d))
+        k = rope((h @ self.k_proj).reshape(b, s, nh, d))
+        v = (h @ self.v_proj).reshape(b, s, nh, d)
+        att = A.scaled_dot_product_attention(q, k, v, is_causal=True)
+        att = att.reshape(b, s, hd) @ self.out_proj
+        m = jax.nn.gelu(h @ self.fc_in + self.fc_in_bias, approximate=True)
+        return x + att + (m @ self.fc_out + self.fc_out_bias)
+
+
+class GPTJForCausalLM(Module):
+    def __init__(self, cfg: GPTJConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.wte = init((cfg.vocab_size, cfg.n_embd), cfg.dtype)
+        self.h = [GPTJBlock(cfg) for _ in range(cfg.n_layer)]
+        self.ln_f = LayerNorm(cfg.n_embd, epsilon=cfg.layer_norm_epsilon,
+                              dtype=cfg.dtype)
+        self.lm_head = init((cfg.n_embd, cfg.vocab_size), cfg.dtype)
+        self.lm_head_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        cos, sin = A.rope_cos_sin(s, cfg.rotary_dim)
+        x = jnp.take(self.wte, input_ids, axis=0)
+        blk = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin))
+               if cfg.remat else (lambda lyr, h: lyr(h, cos, sin)))
+        for lyr in self.h:
+            x = blk(lyr, x)
+        x = self.ln_f(x)
+        return x @ self.lm_head + self.lm_head_bias
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

@@ -223,6 +223,17 @@ def apply_rope(x, cos, sin):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
+def apply_rope_interleaved(x, cos, sin):
+    """GPT-J-style INTERLEAVED rotary: pairs are (even, odd) lanes
+    ``(x[2i], x[2i+1])``, not the half-split. x: [B,S,H,D(rot)];
+    cos/sin: [S, D/2]."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
 def fused_rotary_position_embedding(q, k, seq_len=None, base=10000.0, position_ids=None):
     s = seq_len or q.shape[1]
     cos, sin = rope_cos_sin(s, q.shape[-1], base=base, dtype=jnp.float32,

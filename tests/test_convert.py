@@ -472,3 +472,73 @@ def test_ernie_mlm_logits_match_transformers(use_task_id):
     got = np.asarray(ours(jnp.asarray(ids), token_type_ids=jnp.asarray(tt),
                           **kw_us), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gptj_logits_match_transformers():
+    """GPT-J (interleaved rotary over rotary_dim, single-LN parallel
+    block, biasless attention, untied biased head): logits match HF."""
+    import torch
+    from transformers import GPTJConfig as HFConfig
+    from transformers import GPTJForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, n_embd=32, n_layer=2, n_head=4,
+                          rotary_dim=4, n_positions=64, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_gptj_state_dict
+    from paddle_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
+
+    pt.seed(0)
+    cfg = GPTJConfig(vocab_size=96, n_embd=32, n_layer=2, n_head=4,
+                     rotary_dim=4, dtype=jnp.float32, remat=False)
+    ours = load_gptj_state_dict(GPTJForCausalLM(cfg).eval(),
+                                hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("variant", ["7b", "new", "rw"])
+def test_falcon_logits_match_transformers(variant):
+    """Falcon's three shapes: 7b (multi-query, single-LN parallel block),
+    new decoder architecture (grouped KV, ln_attn/ln_mlp), and rw (ALiBi,
+    sequential residuals, biased)."""
+    import torch
+    from transformers import FalconConfig as HFConfig
+    from transformers import FalconForCausalLM as HFModel
+
+    hfkw = dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, use_cache=False,
+                attn_implementation="eager")
+    uskw = dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, dtype=jnp.float32, remat=False)
+    if variant == "7b":
+        extra = dict(multi_query=True, parallel_attn=True, bias=False,
+                     new_decoder_architecture=False, alibi=False)
+    elif variant == "new":
+        extra = dict(new_decoder_architecture=True, num_kv_heads=2,
+                     multi_query=True, parallel_attn=True, bias=False,
+                     alibi=False)
+    else:
+        extra = dict(multi_query=False, parallel_attn=False, bias=True,
+                     new_decoder_architecture=False, alibi=True)
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(**hfkw, **extra)).eval()
+
+    from paddle_tpu.models.convert import load_falcon_state_dict
+    from paddle_tpu.models.falcon import FalconConfig, FalconForCausalLM
+
+    pt.seed(0)
+    ours = load_falcon_state_dict(
+        FalconForCausalLM(FalconConfig(**uskw, **extra)).eval(),
+        hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=3e-4)
