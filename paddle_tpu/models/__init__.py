@@ -5,9 +5,14 @@ from paddle_tpu.models.bert import (
     BertModel,
 )
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
+from paddle_tpu.models.electra import (ElectraConfig, ElectraForPreTraining,
+                                       ElectraModel)
 from paddle_tpu.models.ernie import (ErnieConfig, ErnieForMaskedLM,
                                      ErnieForSequenceClassification,
                                      ErnieModel)
+from paddle_tpu.models.roberta import (RobertaConfig, RobertaForMaskedLM,
+                                       RobertaForSequenceClassification,
+                                       RobertaModel)
 from paddle_tpu.models.falcon import FalconConfig, FalconForCausalLM
 from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
 from paddle_tpu.models.gptj import GPTJConfig, GPTJForCausalLM

@@ -113,7 +113,8 @@ class BertModel(Module):
         self.layers = [BertLayer(cfg) for _ in range(cfg.num_hidden_layers)]
         self.pooler = Linear(cfg.hidden_size, cfg.hidden_size, dtype=cfg.dtype)
 
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, rng=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 rng=None, position_ids=None):
         kv_lens = None
         if attention_mask is not None:
             if self.cfg.varlen_attention:
@@ -137,7 +138,8 @@ class BertModel(Module):
                 # [B, S] 1/0 -> additive mask [B, 1, 1, S]
                 attention_mask = (1.0 - attention_mask[:, None, None, :]
                                   .astype(jnp.float32)) * -1e9
-        x = self.embeddings(input_ids, token_type_ids, rng=rng)
+        x = self.embeddings(input_ids, token_type_ids,
+                            position_ids=position_ids, rng=rng)
         for i, lyr in enumerate(self.layers):
             sub = None if rng is None else jax.random.fold_in(rng, i)
             x = lyr(x, attn_mask=attention_mask, rng=sub, kv_lens=kv_lens)

@@ -514,3 +514,86 @@ def load_falcon_state_dict(model, state_dict, dtype=None):
             blk.h_to_4h_bias = j(sd[p + "mlp.dense_h_to_4h.bias"])
             blk.four_h_to_h_bias = j(sd[p + "mlp.dense_4h_to_h.bias"])
     return model
+
+
+def load_roberta_state_dict(model, state_dict, dtype=None):
+    """Populate a ``RobertaForMaskedLM``/``RobertaModel`` from an HF
+    state_dict (``roberta.*`` naming). The encoder is BERT's layout —
+    routed through ``load_bert_state_dict`` with the prefix remapped —
+    plus RoBERTa's lm_head (dense+LN+tied decoder)."""
+    cfg = model.cfg
+    dtype = dtype or jnp.float32
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    remapped = {("bert." + k.removeprefix("roberta.")): v
+                for k, v in sd.items() if k.startswith("roberta.")}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    rob = model.roberta if hasattr(model, "roberta") else model
+
+    class _Shim:
+        bert = rob.bert
+    load_bert_state_dict(_Shim(), remapped, dtype=dtype)
+    if hasattr(model, "lm_dense") and "lm_head.bias" in sd:
+        model.lm_dense.weight = j(sd["lm_head.dense.weight"].T)
+        model.lm_dense.bias = j(sd["lm_head.dense.bias"])
+        model.lm_norm.weight = j(sd["lm_head.layer_norm.weight"])
+        model.lm_norm.bias = j(sd["lm_head.layer_norm.bias"])
+        model.lm_bias = j(sd["lm_head.bias"])
+    return model
+
+
+def load_electra_state_dict(model, state_dict, dtype=None):
+    """Populate an ``ElectraForPreTraining``/``ElectraModel`` from an HF
+    state_dict (``electra.*`` naming; factorized embeddings +
+    discriminator head)."""
+    cfg = model.cfg
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("electra."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    el = model.electra if hasattr(model, "electra") else model
+    el.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    el.position_embeddings.weight = j(
+        sd["embeddings.position_embeddings.weight"])
+    el.token_type_embeddings.weight = j(
+        sd["embeddings.token_type_embeddings.weight"])
+    el.emb_norm.weight = j(sd["embeddings.LayerNorm.weight"])
+    el.emb_norm.bias = j(sd["embeddings.LayerNorm.bias"])
+    if el.embeddings_project is not None:
+        el.embeddings_project.weight = j(sd["embeddings_project.weight"].T)
+        el.embeddings_project.bias = j(sd["embeddings_project.bias"])
+    for i, lyr in enumerate(el.layers):
+        p = f"encoder.layer.{i}."
+        a = lyr.attention
+        a.q_proj.weight = j(sd[p + "attention.self.query.weight"].T)
+        a.q_proj.bias = j(sd[p + "attention.self.query.bias"])
+        a.k_proj.weight = j(sd[p + "attention.self.key.weight"].T)
+        a.k_proj.bias = j(sd[p + "attention.self.key.bias"])
+        a.v_proj.weight = j(sd[p + "attention.self.value.weight"].T)
+        a.v_proj.bias = j(sd[p + "attention.self.value.bias"])
+        a.out_proj.weight = j(sd[p + "attention.output.dense.weight"].T)
+        a.out_proj.bias = j(sd[p + "attention.output.dense.bias"])
+        lyr.attn_norm.weight = j(sd[p + "attention.output.LayerNorm.weight"])
+        lyr.attn_norm.bias = j(sd[p + "attention.output.LayerNorm.bias"])
+        lyr.intermediate.weight = j(sd[p + "intermediate.dense.weight"].T)
+        lyr.intermediate.bias = j(sd[p + "intermediate.dense.bias"])
+        lyr.output.weight = j(sd[p + "output.dense.weight"].T)
+        lyr.output.bias = j(sd[p + "output.dense.bias"])
+        lyr.out_norm.weight = j(sd[p + "output.LayerNorm.weight"])
+        lyr.out_norm.bias = j(sd[p + "output.LayerNorm.bias"])
+    if hasattr(model, "disc_dense") and \
+            "discriminator_predictions.dense.weight" in sd:
+        model.disc_dense.weight = j(
+            sd["discriminator_predictions.dense.weight"].T)
+        model.disc_dense.bias = j(
+            sd["discriminator_predictions.dense.bias"])
+        model.disc_out.weight = j(
+            sd["discriminator_predictions.dense_prediction.weight"].T)
+        model.disc_out.bias = j(
+            sd["discriminator_predictions.dense_prediction.bias"])
+    return model

@@ -1,0 +1,109 @@
+"""ELECTRA (ref: PaddleNLP ``paddlenlp/transformers/electra/modeling.py``).
+
+BERT-style encoder with a factorized embedding: embeddings live in
+``embedding_size`` dims (often < hidden) and are linearly projected up
+before the first block. ``ElectraForPreTraining`` is the replaced-token
+DISCRIMINATOR — a per-token binary head — which is the half of the
+ELECTRA objective that makes it sample-efficient (the generator is just
+a small BERT-MLM).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.bert import BertConfig, BertLayer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
+
+
+@dataclass
+class ElectraConfig(BertConfig):
+    vocab_size: int = 30522
+    embedding_size: int = 128
+
+    @staticmethod
+    def tiny(**kw):
+        return ElectraConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                       embedding_size=16,
+                                       num_hidden_layers=2,
+                                       num_attention_heads=2,
+                                       intermediate_size=64,
+                                       max_position_embeddings=64), **kw})
+
+
+class ElectraModel(Module):
+    def __init__(self, cfg: ElectraConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        e = cfg.embedding_size
+        self.word_embeddings = Embedding(cfg.vocab_size, e,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, e,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, e,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(e, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.embeddings_project = (Linear(e, cfg.hidden_size,
+                                          dtype=cfg.dtype)
+                                   if e != cfg.hidden_size else None)
+        self.layers = [BertLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 rng=None):
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(jnp.arange(s)[None, :])
+             + self.token_type_embeddings(token_type_ids))
+        x = self.dropout(self.emb_norm(x), rng=rng)
+        if self.embeddings_project is not None:
+            x = self.embeddings_project(x)
+        for i, lyr in enumerate(self.layers):
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = lyr(x, attn_mask=attention_mask, rng=sub)
+        return x
+
+
+class ElectraForPreTraining(Module):
+    """Replaced-token-detection discriminator: [B, S] logits (>0 =
+    predicted replaced)."""
+
+    def __init__(self, cfg: ElectraConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.electra = ElectraModel(cfg)
+        self.disc_dense = Linear(cfg.hidden_size, cfg.hidden_size,
+                                 dtype=cfg.dtype)
+        self.disc_out = Linear(cfg.hidden_size, 1, dtype=cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 rng=None):
+        seq = self.electra(input_ids, token_type_ids, attention_mask,
+                           rng=rng)
+        return self.disc_out(F.gelu(self.disc_dense(seq)))[..., 0]
+
+    def loss(self, input_ids, labels, token_type_ids=None,
+             attention_mask=None, rng=None):
+        """Per-token binary cross-entropy; labels -100 = ignored."""
+        logits = self(input_ids, token_type_ids, attention_mask,
+                      rng=rng).astype(jnp.float32)
+        valid = (labels >= 0).astype(jnp.float32)
+        y = jnp.clip(labels, 0, 1).astype(jnp.float32)
+        ce = jnp.maximum(logits, 0) - logits * y + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)

@@ -1,0 +1,98 @@
+"""RoBERTa (ref: PaddleNLP ``paddlenlp/transformers/roberta/modeling.py``).
+
+Structurally BERT with two embedding quirks: position ids start at
+``padding_idx + 1`` (fairseq heritage — position of token i is
+``i + 2`` for unpadded input, computed from the attention mask so padded
+positions reuse ``padding_idx``), and token types are a single zero row.
+The encoder IS ``BertModel``; the MLM head is dense+gelu+LN with the
+decoder tied to the word embeddings.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.bert import BertConfig, BertModel
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layers import LayerNorm, Linear
+
+
+@dataclass
+class RobertaConfig(BertConfig):
+    vocab_size: int = 50265
+    max_position_embeddings: int = 514
+    type_vocab_size: int = 1
+    pad_token_id: int = 1
+
+    @staticmethod
+    def tiny(**kw):
+        return RobertaConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                       num_hidden_layers=2,
+                                       num_attention_heads=2,
+                                       intermediate_size=64,
+                                       max_position_embeddings=66), **kw})
+
+
+def roberta_position_ids(input_ids, pad_token_id):
+    """fairseq-style: pad positions stay at padding_idx; real tokens get
+    padding_idx + their 1-based index among non-pad tokens."""
+    mask = (input_ids != pad_token_id).astype(jnp.int32)
+    return jnp.cumsum(mask, axis=1) * mask + pad_token_id
+
+
+class RobertaModel(Module):
+    def __init__(self, cfg: RobertaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+
+    def __call__(self, input_ids, attention_mask=None, rng=None):
+        pos = roberta_position_ids(input_ids, self.cfg.pad_token_id)
+        return self.bert(input_ids, attention_mask=attention_mask,
+                         rng=rng, position_ids=pos)
+
+
+class RobertaForMaskedLM(Module):
+    def __init__(self, cfg: RobertaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.roberta = RobertaModel(cfg)
+        self.lm_dense = Linear(cfg.hidden_size, cfg.hidden_size,
+                               dtype=cfg.dtype)
+        self.lm_norm = LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_eps,
+                                 dtype=cfg.dtype)
+        self.lm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, attention_mask=None, rng=None):
+        seq, _ = self.roberta(input_ids, attention_mask, rng=rng)
+        h = self.lm_norm(F.gelu(self.lm_dense(seq)))
+        emb = self.roberta.bert.embeddings.word_embeddings.weight
+        return h @ emb.T + self.lm_bias
+
+    def loss(self, input_ids, mlm_labels, attention_mask=None, rng=None):
+        logits = self(input_ids, attention_mask, rng=rng)
+        ce = F.cross_entropy(logits, jnp.maximum(mlm_labels, 0),
+                             reduction="none")
+        mask = (mlm_labels >= 0).astype(jnp.float32)
+        return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class RobertaForSequenceClassification(Module):
+    """HF-style classification head over <s> (no pooler tanh): dense +
+    tanh + out_proj, both trained from scratch."""
+
+    def __init__(self, cfg: RobertaConfig, num_classes: int = 2):
+        super().__init__()
+        self.roberta = RobertaModel(cfg)
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size,
+                            dtype=cfg.dtype)
+        self.out_proj = Linear(cfg.hidden_size, num_classes,
+                               dtype=cfg.dtype)
+
+    def __call__(self, input_ids, attention_mask=None, rng=None):
+        seq, _ = self.roberta(input_ids, attention_mask, rng=rng)
+        h = jnp.tanh(self.dense(seq[:, 0]))
+        return self.out_proj(h)

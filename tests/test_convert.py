@@ -542,3 +542,75 @@ def test_falcon_logits_match_transformers(variant):
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=3e-4)
+
+
+def test_roberta_mlm_logits_match_transformers():
+    """RoBERTa (fairseq position offset via pad mask, tied MLM head):
+    logits match HF, including rows with padding."""
+    import torch
+    from transformers import RobertaConfig as HFConfig
+    from transformers import RobertaForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=66, type_vocab_size=1,
+                          pad_token_id=1,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_roberta_state_dict
+    from paddle_tpu.models.roberta import RobertaConfig, RobertaForMaskedLM
+
+    pt.seed(0)
+    cfg = RobertaConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=2, intermediate_size=64,
+                        max_position_embeddings=66, type_vocab_size=1,
+                        pad_token_id=1)
+    ours = load_roberta_state_dict(RobertaForMaskedLM(cfg).eval(),
+                                   hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(2, 96, (2, 12))
+    ids[1, 9:] = 1                       # padded row
+    mask = (ids != 1).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 attention_mask=torch.tensor(mask)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids),
+                          attention_mask=jnp.asarray(mask)), np.float32)
+    valid = mask[:, :, None].astype(bool)
+    np.testing.assert_allclose(np.where(valid, got, 0),
+                               np.where(valid, ref, 0),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_electra_discriminator_logits_match_transformers():
+    """ELECTRA discriminator (factorized embeddings + projection,
+    per-token binary head): logits match HF."""
+    import torch
+    from transformers import ElectraConfig as HFConfig
+    from transformers import ElectraForPreTraining as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, embedding_size=16, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=64,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_electra_state_dict
+    from paddle_tpu.models.electra import (ElectraConfig,
+                                           ElectraForPreTraining)
+
+    pt.seed(0)
+    cfg = ElectraConfig(vocab_size=96, embedding_size=16, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=2,
+                        intermediate_size=64, max_position_embeddings=64)
+    ours = load_electra_state_dict(ElectraForPreTraining(cfg).eval(),
+                                   hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
