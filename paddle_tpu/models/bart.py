@@ -1,0 +1,147 @@
+"""BART (ref: PaddleNLP ``paddlenlp/transformers/bart/modeling.py`` —
+the denoising seq2seq family, also the mBART shape).
+
+The POST-LN encoder-decoder of the zoo (T5 is pre-LN/relative-bias; BART
+is post-LN/learned-positions): shared embeddings (optionally scaled by
+sqrt(d)), learned positions at the fairseq +2 offset, an embedding
+LayerNorm, decoder with cross-attention, and a tied LM head with a
+``final_logits_bias`` buffer.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm, Linear
+from paddle_tpu.nn.transformer import MultiHeadAttention
+
+
+@dataclass
+class BartConfig:
+    vocab_size: int = 50265
+    d_model: int = 768
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 12
+    decoder_attention_heads: int = 12
+    encoder_ffn_dim: int = 3072
+    decoder_ffn_dim: int = 3072
+    max_position_embeddings: int = 1024
+    pad_token_id: int = 1
+    scale_embedding: bool = False
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return BartConfig(**{**dict(vocab_size=128, d_model=32,
+                                    encoder_layers=2, decoder_layers=2,
+                                    encoder_attention_heads=4,
+                                    decoder_attention_heads=4,
+                                    encoder_ffn_dim=64, decoder_ffn_dim=64,
+                                    max_position_embeddings=64), **kw})
+
+
+class BartEncoderLayer(Module):
+    def __init__(self, cfg: BartConfig):
+        super().__init__()
+        d = cfg.d_model
+        self.self_attn = MultiHeadAttention(d, cfg.encoder_attention_heads,
+                                            dtype=cfg.dtype)
+        self.self_attn_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.fc1 = Linear(d, cfg.encoder_ffn_dim, dtype=cfg.dtype)
+        self.fc2 = Linear(cfg.encoder_ffn_dim, d, dtype=cfg.dtype)
+        self.final_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+
+    def __call__(self, x, attn_mask=None):
+        x = self.self_attn_layer_norm(
+            x + self.self_attn(x, attn_mask=attn_mask))
+        return self.final_layer_norm(x + self.fc2(F.gelu(self.fc1(x))))
+
+
+class BartDecoderLayer(Module):
+    def __init__(self, cfg: BartConfig):
+        super().__init__()
+        d = cfg.d_model
+        self.self_attn = MultiHeadAttention(d, cfg.decoder_attention_heads,
+                                            dtype=cfg.dtype)
+        self.self_attn_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.encoder_attn = MultiHeadAttention(d,
+                                               cfg.decoder_attention_heads,
+                                               dtype=cfg.dtype)
+        self.encoder_attn_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.fc1 = Linear(d, cfg.decoder_ffn_dim, dtype=cfg.dtype)
+        self.fc2 = Linear(cfg.decoder_ffn_dim, d, dtype=cfg.dtype)
+        self.final_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+
+    def __call__(self, x, enc, enc_mask=None):
+        x = self.self_attn_layer_norm(
+            x + self.self_attn(x, is_causal=True))
+        x = self.encoder_attn_layer_norm(
+            x + self.encoder_attn(x, enc, enc, attn_mask=enc_mask))
+        return self.final_layer_norm(x + self.fc2(F.gelu(self.fc1(x))))
+
+
+class BartForConditionalGeneration(Module):
+    def __init__(self, cfg: BartConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        d = cfg.d_model
+        self.shared = init((cfg.vocab_size, d), cfg.dtype)
+        # +2: fairseq offset rows (positions p live at row p + 2)
+        self.enc_positions = init((cfg.max_position_embeddings + 2, d),
+                                  cfg.dtype)
+        self.dec_positions = init((cfg.max_position_embeddings + 2, d),
+                                  cfg.dtype)
+        self.enc_layernorm_embedding = LayerNorm(d, dtype=cfg.dtype)
+        self.dec_layernorm_embedding = LayerNorm(d, dtype=cfg.dtype)
+        self.encoder_layers_m = [BartEncoderLayer(cfg)
+                                 for _ in range(cfg.encoder_layers)]
+        self.decoder_layers_m = [BartDecoderLayer(cfg)
+                                 for _ in range(cfg.decoder_layers)]
+        self.final_logits_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def _embed(self, ids, pos_table, norm):
+        scale = (self.cfg.d_model ** 0.5 if self.cfg.scale_embedding
+                 else 1.0)
+        s = ids.shape[1]
+        x = jnp.take(self.shared, ids, axis=0) * scale
+        return norm(x + pos_table[2: s + 2][None])
+
+    def encode(self, input_ids, attention_mask=None):
+        mask = None
+        if attention_mask is not None:
+            mask = (1.0 - attention_mask[:, None, None, :]
+                    .astype(jnp.float32)) * -1e9
+        x = self._embed(input_ids, self.enc_positions,
+                        self.enc_layernorm_embedding)
+        for lyr in self.encoder_layers_m:
+            x = lyr(x, attn_mask=mask)
+        return x
+
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+        enc = self.encode(input_ids, attention_mask)
+        enc_mask = None
+        if attention_mask is not None:
+            enc_mask = (1.0 - attention_mask[:, None, None, :]
+                        .astype(jnp.float32)) * -1e9
+        x = self._embed(decoder_input_ids, self.dec_positions,
+                        self.dec_layernorm_embedding)
+        for lyr in self.decoder_layers_m:
+            x = lyr(x, enc, enc_mask=enc_mask)
+        return x @ self.shared.T + self.final_logits_bias
+
+    def loss(self, input_ids, decoder_input_ids, labels,
+             attention_mask=None):
+        logits = self(input_ids, decoder_input_ids,
+                      attention_mask).astype(jnp.float32)
+        ce = F.cross_entropy(logits, jnp.maximum(labels, 0),
+                             reduction="none")
+        mask = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -597,3 +597,52 @@ def load_electra_state_dict(model, state_dict, dtype=None):
         model.disc_out.bias = j(
             sd["discriminator_predictions.dense_prediction.bias"])
     return model
+
+
+def load_bart_state_dict(model, state_dict, dtype=None):
+    """Populate a ``BartForConditionalGeneration`` from an HF state_dict
+    (``model.encoder/decoder`` naming; lm_head tied to ``model.shared``)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("model."): _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def attn(a, prefix):
+        for ours, theirs in [("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                             ("v_proj", "v_proj"), ("out_proj", "out_proj")]:
+            lin(getattr(a, ours), f"{prefix}.{theirs}")
+
+    model.shared = j(sd["shared.weight"])
+    if "final_logits_bias" in sd:
+        model.final_logits_bias = j(sd["final_logits_bias"].reshape(-1))
+    model.enc_positions = j(sd["encoder.embed_positions.weight"])
+    model.dec_positions = j(sd["decoder.embed_positions.weight"])
+    ln(model.enc_layernorm_embedding, "encoder.layernorm_embedding")
+    ln(model.dec_layernorm_embedding, "decoder.layernorm_embedding")
+    for i, lyr in enumerate(model.encoder_layers_m):
+        p = f"encoder.layers.{i}."
+        attn(lyr.self_attn, p + "self_attn")
+        ln(lyr.self_attn_layer_norm, p + "self_attn_layer_norm")
+        lin(lyr.fc1, p + "fc1")
+        lin(lyr.fc2, p + "fc2")
+        ln(lyr.final_layer_norm, p + "final_layer_norm")
+    for i, lyr in enumerate(model.decoder_layers_m):
+        p = f"decoder.layers.{i}."
+        attn(lyr.self_attn, p + "self_attn")
+        ln(lyr.self_attn_layer_norm, p + "self_attn_layer_norm")
+        attn(lyr.encoder_attn, p + "encoder_attn")
+        ln(lyr.encoder_attn_layer_norm, p + "encoder_attn_layer_norm")
+        lin(lyr.fc1, p + "fc1")
+        lin(lyr.fc2, p + "fc2")
+        ln(lyr.final_layer_norm, p + "final_layer_norm")
+    return model

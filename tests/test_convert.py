@@ -614,3 +614,43 @@ def test_electra_discriminator_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bart_logits_match_transformers():
+    """BART (post-LN seq2seq, fairseq-offset learned positions, embedding
+    LN, cross-attention, tied head + final_logits_bias): logits match
+    HF, including a padded encoder row."""
+    import torch
+    from transformers import BartConfig as HFConfig
+    from transformers import BartForConditionalGeneration as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                          decoder_layers=2, encoder_attention_heads=4,
+                          decoder_attention_heads=4, encoder_ffn_dim=64,
+                          decoder_ffn_dim=64, max_position_embeddings=64,
+                          pad_token_id=1, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.bart import (BartConfig,
+                                        BartForConditionalGeneration)
+    from paddle_tpu.models.convert import load_bart_state_dict
+
+    pt.seed(0)
+    cfg = BartConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                     decoder_layers=2, encoder_attention_heads=4,
+                     decoder_attention_heads=4, encoder_ffn_dim=64,
+                     decoder_ffn_dim=64, max_position_embeddings=64)
+    ours = load_bart_state_dict(BartForConditionalGeneration(cfg).eval(),
+                                hf.state_dict())
+    rs = np.random.RandomState(0)
+    src = rs.randint(2, 96, (2, 10))
+    src[1, 8:] = 1
+    mask = (src != 1).astype(np.int64)
+    tgt = rs.randint(2, 96, (2, 7))
+    with torch.no_grad():
+        ref = hf(torch.tensor(src), attention_mask=torch.tensor(mask),
+                 decoder_input_ids=torch.tensor(tgt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt),
+                          attention_mask=jnp.asarray(mask)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
