@@ -40,12 +40,15 @@ from paddle_tpu.core.module import Module
 from paddle_tpu.nn import initializer as I
 
 
-def _gate_probs(logits, k):
-    """softmax -> top-k -> renormalised gates. Returns ([T,k] vals, idx, probs)."""
+def _gate_probs(logits, k, renormalize=True):
+    """softmax -> top-k -> (optionally) renormalised gates. Returns
+    ([T,k] vals, idx, probs). ``renormalize=False`` keeps the raw softmax
+    mass at the top-k (Qwen2-MoE's norm_topk_prob=False convention)."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
-    gate_vals = gate_vals / jnp.maximum(
-        jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+    if renormalize:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
     return gate_vals, gate_idx, probs
 
 
@@ -92,7 +95,7 @@ def top_k_gate(logits, k: int, capacity: int, *, jitter_rng=None):
     return dispatch, combine, aux
 
 
-def top_k_route(logits, k: int, capacity: int):
+def top_k_route(logits, k: int, capacity: int, renormalize: bool = True):
     """Sort-based top-k routing — O(T·k log) compute, O(T·k) memory.
 
     logits: [T, E]. Returns ``(route, aux, drop_rate)`` where ``route`` is a
@@ -110,7 +113,7 @@ def top_k_route(logits, k: int, capacity: int):
     """
     t, e = logits.shape
     n = t * k
-    gate_vals, gate_idx, probs = _gate_probs(logits, k)
+    gate_vals, gate_idx, probs = _gate_probs(logits, k, renormalize)
 
     flat_e = gate_idx.T.reshape(n)                 # choice-major [k*T]
     flat_gate = gate_vals.T.reshape(n)
@@ -191,14 +194,19 @@ class MoELayer(Module):
     exposed via ``return_metrics=True``."""
 
     def __init__(self, hidden, intermediate, num_experts, k=2,
-                 capacity_factor=1.25, dtype=None):
+                 capacity_factor=1.25, dtype=None, norm_topk_prob=True):
         super().__init__()
         dtype = dtype or get_default_dtype()
         self.gate_w = I.Normal(0.0, 0.02)((hidden, num_experts), jnp.float32)
         self.experts = ExpertMLP(num_experts, hidden, intermediate, dtype)
         self.num_experts, self.k, self.capacity_factor = num_experts, k, capacity_factor
+        self.norm_topk_prob = norm_topk_prob
 
     def _capacity(self, tokens: int) -> int:
+        if self.capacity_factor is None:
+            # EXACT (dropless) mode: every expert can take every token —
+            # HF-style eval/inference semantics; memory O(T) per expert
+            return max(tokens, 4)
         cap = int(self.capacity_factor * self.k * tokens / self.num_experts
                   + 0.999)
         return max(cap, 4)
@@ -223,7 +231,8 @@ class MoELayer(Module):
         cap = self._capacity(t)
         xt = x.reshape(t, h)
         logits = xt.astype(jnp.float32) @ self.gate_w
-        route, aux, drop = top_k_route(logits, self.k, cap)
+        route, aux, drop = top_k_route(logits, self.k, cap,
+                                       self.norm_topk_prob)
         x_e, dest = sparse_dispatch(xt, route, e, cap)
         y_e = self.experts(x_e)
         yt = sparse_combine(y_e, route, dest, t)
@@ -247,6 +256,7 @@ class MoELayer(Module):
         # fill at most C_local slots of each (global) expert
         cap = self._capacity((b // data_shards) * s)
         k = self.k
+        renorm = self.norm_topk_prob
 
         batch_axes = ("dp", "fsdp", "ep")
         xspec = P(batch_axes, None, None)
@@ -256,7 +266,7 @@ class MoELayer(Module):
             tl = bl * sl
             xt = xl.reshape(tl, hl)
             logits = xt.astype(jnp.float32) @ gate_w
-            route, _, _ = top_k_route(logits, k, cap)
+            route, _, _ = top_k_route(logits, k, cap, renorm)
             # exact global aux loss: pmean the gate's ingredients
             me = jax.lax.pmean(route["me"], batch_axes)
             ce = jax.lax.pmean(route["ce"], batch_axes)

@@ -17,6 +17,7 @@ from paddle_tpu.models.roberta import (RobertaConfig, RobertaForMaskedLM,
 from paddle_tpu.models.falcon import FalconConfig, FalconForCausalLM
 from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
 from paddle_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
+from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
 from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, LlamaModel

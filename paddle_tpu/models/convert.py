@@ -646,3 +646,62 @@ def load_bart_state_dict(model, state_dict, dtype=None):
         lin(lyr.fc2, p + "fc2")
         ln(lyr.final_layer_norm, p + "final_layer_norm")
     return model
+
+
+def load_qwen2_moe_state_dict(model, state_dict, dtype=None):
+    """Populate a ``Qwen2MoeForCausalLM`` from an HF state_dict: Qwen2
+    attention packing (fused biased QKV) + per-layer expert stacks
+    (E separate gate/up/down linears -> stacked [E, h, 2I]/[E, I, h])
+    + the shared expert and its sigmoid gate + the router."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    model.embed_tokens = j(sd["model.embed_tokens.weight"])
+    model.norm.weight = j(sd["model.norm.weight"])
+    model.lm_head = j(sd.get("lm_head.weight",
+                             sd["model.embed_tokens.weight"]).T)
+    for i, lyr in enumerate(model.layers):
+        p = f"model.layers.{i}."
+        att = lyr.self_attn
+        q = sd[p + "self_attn.q_proj.weight"].T
+        k = sd[p + "self_attn.k_proj.weight"].T
+        v = sd[p + "self_attn.v_proj.weight"].T
+        att.qkv_proj = j(np.concatenate([q, k, v], axis=1))
+        att.o_proj = j(sd[p + "self_attn.o_proj.weight"].T)
+        if att.qkv_bias is not None:
+            att.qkv_bias = j(np.concatenate(
+                [sd[p + "self_attn.q_proj.bias"],
+                 sd[p + "self_attn.k_proj.bias"],
+                 sd[p + "self_attn.v_proj.bias"]]))
+        lyr.input_layernorm.weight = j(sd[p + "input_layernorm.weight"])
+        lyr.post_attention_layernorm.weight = j(
+            sd[p + "post_attention_layernorm.weight"])
+        if not lyr.sparse:
+            gate = sd[p + "mlp.gate_proj.weight"].T
+            up = sd[p + "mlp.up_proj.weight"].T
+            lyr.mlp.gate_up_proj = j(np.concatenate([gate, up], axis=1))
+            lyr.mlp.down_proj = j(sd[p + "mlp.down_proj.weight"].T)
+            continue
+        blk = lyr.mlp
+        # router stays f32: the reference computes routing in float
+        blk.moe.gate_w = jnp.asarray(sd[p + "mlp.gate.weight"].T,
+                                     jnp.float32)
+        gu, dn = [], []
+        for e in range(cfg.num_experts):
+            ep = p + f"mlp.experts.{e}."
+            g = sd[ep + "gate_proj.weight"].T       # [h, I]
+            u = sd[ep + "up_proj.weight"].T
+            gu.append(np.concatenate([g, u], axis=1))
+            dn.append(sd[ep + "down_proj.weight"].T)
+        blk.moe.experts.gate_up = j(np.stack(gu))
+        blk.moe.experts.down = j(np.stack(dn))
+        sg = sd[p + "mlp.shared_expert.gate_proj.weight"].T
+        su = sd[p + "mlp.shared_expert.up_proj.weight"].T
+        blk.shared_gate_up = j(np.concatenate([sg, su], axis=1))
+        blk.shared_down = j(sd[p + "mlp.shared_expert.down_proj.weight"].T)
+        blk.shared_gate = j(sd[p + "mlp.shared_expert_gate.weight"].T)
+    return model
