@@ -15,6 +15,7 @@ from paddle_tpu.models.roberta import (RobertaConfig, RobertaForMaskedLM,
                                        RobertaForSequenceClassification,
                                        RobertaModel)
 from paddle_tpu.models.falcon import FalconConfig, FalconForCausalLM
+from paddle_tpu.models.gemma import GemmaConfig, GemmaForCausalLM
 from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
 from paddle_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
 from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM

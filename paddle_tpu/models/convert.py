@@ -705,3 +705,32 @@ def load_qwen2_moe_state_dict(model, state_dict, dtype=None):
         blk.shared_down = j(sd[p + "mlp.shared_expert.down_proj.weight"].T)
         blk.shared_gate = j(sd[p + "mlp.shared_expert_gate.weight"].T)
     return model
+
+
+def load_gemma_state_dict(model, state_dict, dtype=None):
+    """Populate a ``GemmaForCausalLM`` from an HF state_dict (zero-
+    centered norm weights stored as-is; head tied to embeddings)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    model.embed_tokens = j(sd["model.embed_tokens.weight"])
+    model.norm.weight = j(sd["model.norm.weight"])
+    for i, lyr in enumerate(model.layers):
+        p = f"model.layers.{i}."
+        q = sd[p + "self_attn.q_proj.weight"].T
+        k = sd[p + "self_attn.k_proj.weight"].T
+        v = sd[p + "self_attn.v_proj.weight"].T
+        lyr.qkv_proj = j(np.concatenate([q, k, v], axis=1))
+        lyr.o_proj = j(sd[p + "self_attn.o_proj.weight"].T)
+        gate = sd[p + "mlp.gate_proj.weight"].T
+        up = sd[p + "mlp.up_proj.weight"].T
+        lyr.gate_up_proj = j(np.concatenate([gate, up], axis=1))
+        lyr.down_proj = j(sd[p + "mlp.down_proj.weight"].T)
+        lyr.input_layernorm.weight = j(sd[p + "input_layernorm.weight"])
+        lyr.post_attention_layernorm.weight = j(
+            sd[p + "post_attention_layernorm.weight"])
+    return model

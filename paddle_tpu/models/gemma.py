@@ -1,0 +1,133 @@
+"""Gemma decoder LM (ref capability: PaddleNLP ``gemma`` model family).
+
+The zero-centered-norm member of the zoo, with three departures from the
+LLaMA recipe that make it NOT a config of ``LlamaForCausalLM``:
+  * RMSNorm multiplies by ``1 + weight`` (weights stored zero-centered);
+  * ``head_dim`` is decoupled from ``hidden_size / num_heads`` (gemma-7b:
+    16 heads x 256 dims on a 3072 hidden) — q/k/v project h -> nh*hd and
+    o projects nh*hd -> h;
+  * embeddings are scaled by ``sqrt(hidden_size)`` at the input and the
+    MLP activation is tanh-gelu. Head tied to the embeddings.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class GemmaConfig:
+    vocab_size: int = 256000
+    hidden_size: int = 3072
+    intermediate_size: int = 24576
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    head_dim: int = 256
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+
+    @staticmethod
+    def tiny(**kw):
+        return GemmaConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                     intermediate_size=64,
+                                     num_hidden_layers=2,
+                                     num_attention_heads=4,
+                                     num_key_value_heads=2, head_dim=16,
+                                     max_position_embeddings=64,
+                                     dtype=jnp.float32, remat=False), **kw})
+
+
+class GemmaRMSNorm(Module):
+    """RMSNorm with a ZERO-CENTERED weight: y = norm(x) * (1 + w)."""
+
+    def __init__(self, size, eps, dtype):
+        super().__init__()
+        self.weight = jnp.zeros((size,), dtype)
+        self.eps = eps
+
+    def __call__(self, x):
+        h = x.astype(jnp.float32)
+        h = h * jax.lax.rsqrt(jnp.mean(h * h, -1, keepdims=True) + self.eps)
+        return (h * (1.0 + self.weight.astype(jnp.float32))).astype(x.dtype)
+
+
+class GemmaDecoderLayer(Module):
+    def __init__(self, cfg: GemmaConfig):
+        super().__init__()
+        h, hd = cfg.hidden_size, cfg.head_dim
+        nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.input_layernorm = GemmaRMSNorm(h, cfg.rms_norm_eps, cfg.dtype)
+        self.qkv_proj = init((h, (nh + 2 * nkv) * hd), cfg.dtype)
+        self.o_proj = init((nh * hd, h), cfg.dtype)
+        self.post_attention_layernorm = GemmaRMSNorm(h, cfg.rms_norm_eps,
+                                                     cfg.dtype)
+        self.gate_up_proj = init((h, 2 * cfg.intermediate_size), cfg.dtype)
+        self.down_proj = init((cfg.intermediate_size, h), cfg.dtype)
+        self.dims = (nh, nkv, hd)
+
+    def __call__(self, x, cos, sin):
+        b, s, hdim = x.shape
+        nh, nkv, hd = self.dims
+        h = self.input_layernorm(x)
+        qkv = h @ self.qkv_proj
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = A.apply_rope(q.reshape(b, s, nh, hd), cos, sin)
+        k = A.apply_rope(k.reshape(b, s, nkv, hd), cos, sin)
+        att = A.scaled_dot_product_attention(q, k, v.reshape(b, s, nkv, hd),
+                                             is_causal=True)
+        x = x + att.reshape(b, s, nh * hd) @ self.o_proj
+        h2 = self.post_attention_layernorm(x)
+        gate, up = jnp.split(h2 @ self.gate_up_proj, 2, axis=-1)
+        m = jax.nn.gelu(gate, approximate=True) * up
+        return x + m @ self.down_proj
+
+
+class GemmaForCausalLM(Module):
+    def __init__(self, cfg: GemmaConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.embed_tokens = init((cfg.vocab_size, cfg.hidden_size),
+                                 cfg.dtype)
+        self.layers = [GemmaDecoderLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.norm = GemmaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                                 cfg.dtype)
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        cos, sin = A.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta)
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+        blk = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin))
+               if cfg.remat else (lambda lyr, h: lyr(h, cos, sin)))
+        for lyr in self.layers:
+            x = blk(lyr, x)
+        x = self.norm(x)
+        return x @ self.embed_tokens.T       # tied head
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

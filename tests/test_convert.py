@@ -690,3 +690,33 @@ def test_qwen2_moe_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_gemma_logits_match_transformers():
+    """Gemma (zero-centered RMSNorm, decoupled head_dim, sqrt(h)-scaled
+    embeddings, tanh-gelu MLP, tied head): logits match HF."""
+    import torch
+    from transformers import GemmaConfig as HFConfig
+    from transformers import GemmaForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          head_dim=16, max_position_embeddings=64,
+                          use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_gemma_state_dict
+    from paddle_tpu.models.gemma import GemmaConfig, GemmaForCausalLM
+
+    pt.seed(0)
+    cfg = GemmaConfig.tiny(vocab_size=96)
+    ours = load_gemma_state_dict(GemmaForCausalLM(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
