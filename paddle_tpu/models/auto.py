@@ -1,0 +1,127 @@
+"""Auto registry (ref: PaddleNLP ``paddlenlp.transformers.AutoModel*`` /
+HF ``AutoModelForCausalLM``): one entry point that maps an HF config's
+``architectures``/``model_type`` onto the right (config, model, loader)
+triple of this zoo.
+
+Usage with a LOCAL checkpoint directory (zero-egress environment — no
+hub downloads; ref AutoModel.from_pretrained):
+
+    model = auto_from_pretrained("/path/to/ckpt")        # reads
+    # config.json + *.safetensors via models.convert.load_safetensors
+
+or from in-memory pieces:
+
+    model = auto_from_config(cfg_dict)                   # random init
+    model = AUTO_REGISTRY["llama"].load(model, state_dict)
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, fields
+
+
+@dataclass(frozen=True)
+class _Entry:
+    config_cls: object
+    model_cls: object
+    load: object                        # load_*_state_dict(model, sd)
+    # HF config key -> our config field (identity where omitted)
+    remap: tuple = ()
+
+
+def _registry():
+    from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
+    from paddle_tpu.models import gemma, gpt, gpt_neox, gptj, llama
+    from paddle_tpu.models import opt, qwen, qwen2_moe, roberta, t5
+    from paddle_tpu.models import convert as C
+
+    return {
+        "llama": _Entry(llama.LlamaConfig, llama.LlamaForCausalLM,
+                        C.load_llama_state_dict),
+        "mistral": _Entry(llama.LlamaConfig, llama.LlamaForCausalLM,
+                          C.load_llama_state_dict),
+        "qwen2": _Entry(qwen.Qwen2Config, qwen.Qwen2ForCausalLM,
+                        C.load_llama_state_dict),
+        "qwen2_moe": _Entry(qwen2_moe.Qwen2MoeConfig,
+                            qwen2_moe.Qwen2MoeForCausalLM,
+                            C.load_qwen2_moe_state_dict),
+        "gemma": _Entry(gemma.GemmaConfig, gemma.GemmaForCausalLM,
+                        C.load_gemma_state_dict),
+        "bloom": _Entry(bloom.BloomConfig, bloom.BloomForCausalLM,
+                        C.load_bloom_state_dict),
+        "falcon": _Entry(falcon.FalconConfig, falcon.FalconForCausalLM,
+                         C.load_falcon_state_dict),
+        "gpt_neox": _Entry(gpt_neox.GPTNeoXConfig,
+                           gpt_neox.GPTNeoXForCausalLM,
+                           C.load_gpt_neox_state_dict),
+        "gptj": _Entry(gptj.GPTJConfig, gptj.GPTJForCausalLM,
+                       C.load_gptj_state_dict),
+        "opt": _Entry(opt.OPTConfig, opt.OPTForCausalLM,
+                      C.load_opt_state_dict),
+        "gpt2": _Entry(gpt.GPTConfig, gpt.GPTForCausalLM,
+                       C.load_gpt2_state_dict,
+                       remap=(("n_embd", "hidden_size"),
+                              ("n_layer", "num_hidden_layers"),
+                              ("n_head", "num_attention_heads"),
+                              ("n_inner", "intermediate_size"),
+                              ("n_positions", "max_position_embeddings"))),
+        "bert": _Entry(bert.BertConfig, bert.BertForPretraining,
+                       C.load_bert_state_dict),
+        "ernie": _Entry(ernie.ErnieConfig, ernie.ErnieForMaskedLM,
+                        C.load_ernie_state_dict),
+        "roberta": _Entry(roberta.RobertaConfig, roberta.RobertaForMaskedLM,
+                          C.load_roberta_state_dict),
+        "electra": _Entry(electra.ElectraConfig,
+                          electra.ElectraForPreTraining,
+                          C.load_electra_state_dict),
+        "bart": _Entry(bart.BartConfig, bart.BartForConditionalGeneration,
+                       C.load_bart_state_dict),
+        "t5": _Entry(t5.T5Config, t5.T5ForConditionalGeneration,
+                     C.load_t5_state_dict),
+    }
+
+
+def auto_config(model_type: str, hf_cfg: dict):
+    """Build our config dataclass from an HF config dict: shared field
+    names copy over; unknown HF keys are ignored (they configure parts
+    the zoo model derives or does not need)."""
+    entry = _registry()[model_type]
+    names = {f.name for f in fields(entry.config_cls)}
+    # None means "derive the default" in HF configs (e.g. gpt2 n_inner)
+    kw = {k: v for k, v in hf_cfg.items() if k in names and v is not None}
+    for theirs, ours in entry.remap:
+        if hf_cfg.get(theirs) is not None:
+            kw[ours] = hf_cfg[theirs]
+    if "mlp_only_layers" in kw and isinstance(kw["mlp_only_layers"], list):
+        kw["mlp_only_layers"] = tuple(kw["mlp_only_layers"])
+    return entry.config_cls(**kw)
+
+
+def auto_from_config(hf_cfg: dict):
+    """Random-init model from an HF config dict (``model_type`` key)."""
+    mt = hf_cfg["model_type"]
+    return _registry()[mt].model_cls(auto_config(mt, hf_cfg))
+
+
+def auto_from_pretrained(path: str, dtype=None):
+    """Load a LOCAL HF checkpoint directory: config.json + safetensors
+    shards (dependency-free reader from models.convert)."""
+    from paddle_tpu.models.convert import load_safetensors
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    mt = hf_cfg["model_type"]
+    if mt not in _registry():
+        raise ValueError(
+            f"model_type {mt!r} is not in the auto registry; supported: "
+            f"{sorted(_registry())}")
+    model = auto_from_config(hf_cfg)
+    sd = {}
+    shards = [fn for fn in sorted(os.listdir(path))
+              if fn.endswith(".safetensors")]
+    if not shards:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    for fn in shards:
+        sd.update(load_safetensors(os.path.join(path, fn)))
+    return _registry()[mt].load(model, sd, dtype=dtype)
