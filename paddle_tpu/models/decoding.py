@@ -384,3 +384,78 @@ def beam_search(model, input_ids, max_new_tokens=32, num_beams=4,
         return best_seqs, best_scores
 
     return run(model, input_ids, cache)
+
+
+def generic_generate(model, input_ids, max_new_tokens=32, temperature=0.0,
+                     top_k=None, top_p=None, eos_token_id=None, rng=None,
+                     repetition_penalty=1.0, min_new_tokens=0):
+    """Family-agnostic decoding (ref PaddleNLP GenerationMixin over every
+    causal architecture): works with ANY causal LM whose
+    ``__call__(ids [B, S]) -> logits [B, S, V]`` — BLOOM, Falcon,
+    GPT-J/NeoX, OPT, Gemma, Qwen2-MoE, custom models — with the same
+    sampling/penalty/EOS semantics as ``generate``.
+
+    The whole buffer is re-forwarded each step (no KV cache): position
+    ``p``'s logits depend only on tokens ``<= p`` under causal masking,
+    so the zero-padded future is inert. O(S^2) attention per token —
+    the correctness-first generic path; the LLaMA family's ``generate``
+    is the cached fast path. One jitted while_loop, fixed shapes.
+    """
+    cfg = model.cfg
+    b, prompt_len = input_ids.shape
+    max_len = prompt_len + max_new_tokens
+    vocab = cfg.vocab_size
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def constrain(logits, appeared, gen_len):
+        logits = _apply_repetition_penalty(logits, appeared,
+                                           repetition_penalty)
+        if eos_token_id is not None and min_new_tokens > 0:
+            logits = jnp.where(
+                (gen_len < min_new_tokens)
+                & (jnp.arange(logits.shape[-1]) == eos_token_id)[None, :],
+                -1e30, logits)
+        return logits
+
+    @jax.jit
+    def run(model, input_ids, rng):
+        tokens = jnp.concatenate(
+            [input_ids, jnp.zeros((b, max_new_tokens), input_ids.dtype)],
+            axis=1)
+        appeared = jnp.zeros((b, vocab), bool)
+        appeared = appeared.at[jnp.arange(b)[:, None], input_ids].set(True)
+
+        def logits_at(tokens, pos):
+            lg = model(tokens).astype(jnp.float32)
+            return lax.dynamic_index_in_dim(lg, pos, 1, keepdims=False)
+
+        logits = constrain(logits_at(tokens, prompt_len - 1), appeared, 0)
+        next_tok = _sample(logits, rng, temperature, top_k, top_p)
+        appeared = appeared.at[jnp.arange(b), next_tok].set(True)
+        tokens = tokens.at[:, prompt_len].set(next_tok)
+        done = (jnp.zeros((b,), bool) if eos_token_id is None
+                else (next_tok == eos_token_id))
+
+        def cond(state):
+            i, tokens, rng, done, appeared = state
+            return jnp.logical_and(i < max_new_tokens - 1, ~jnp.all(done))
+
+        def body(state):
+            i, tokens, rng, done, appeared = state
+            rng, sub = jax.random.split(rng)
+            logits = constrain(logits_at(tokens, prompt_len + i), appeared,
+                               i + 1)
+            nxt = _sample(logits, sub, temperature, top_k, top_p)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            appeared = appeared.at[jnp.arange(b), nxt].set(True)
+            tokens = lax.dynamic_update_slice_in_dim(
+                tokens, nxt[:, None], prompt_len + i + 1, axis=1)
+            return (i + 1, tokens, rng, done, appeared)
+
+        state = (jnp.zeros((), jnp.int32), tokens, rng, done, appeared)
+        state = lax.while_loop(cond, body, state)
+        return state[1]
+
+    return run(model, jnp.asarray(input_ids), rng)
