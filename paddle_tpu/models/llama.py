@@ -310,7 +310,8 @@ class LlamaForCausalLM(Module):
 
 
 def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
-                              labels, num_microbatches: int, batch_axes=()):
+                              labels, num_microbatches: int, batch_axes=(),
+                              schedule: str = "1f1b"):
     """1F1B pipeline-parallel loss + grads for LLaMA over the pp mesh axis.
 
     Decoder layers are the pipeline stages; the embedding runs at stage 0
@@ -333,7 +334,7 @@ def llama_pipeline_train_step(model: "LlamaForCausalLM", mesh, input_ids,
         params = tp_shuffle_llama_params(params, model.cfg, mesh.size("tp"))
     return _pp_loss_and_grads(model.cfg, len(model.model.layers), mesh,
                               params, input_ids, labels, num_microbatches,
-                              batch_axes)
+                              batch_axes, schedule=schedule)
 
 
 def _check_pp_model(model):
@@ -386,7 +387,7 @@ def make_llama_pp_train_step(model: "LlamaForCausalLM", mesh, optimizer,
 
 
 def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
-                       num_microbatches, batch_axes):
+                       num_microbatches, batch_axes, schedule="1f1b"):
     """The ONE pipeline-LLaMA forward/backward: reads weights from
     ``params`` ({layers, embed_tokens, norm_weight, lm_head}) so both the
     module-level wrapper (llama_pipeline_train_step) and the jitted
@@ -472,7 +473,7 @@ def _pp_loss_and_grads(cfg, n_layers, mesh, params, input_ids, labels,
         head_loss_fn=head_loss,
         head_params=(params["norm_weight"], params["lm_head"]),
         embed_fn=embed_fn, embed_params=params["embed_tokens"],
-        batch_axes=batch_axes, stage_specs=stage_specs)
+        batch_axes=batch_axes, stage_specs=stage_specs, schedule=schedule)
     grads = PpParams.make(
         dict(layers=dstage, embed_tokens=dembed,
              norm_weight=dhead[0], lm_head=dhead[1]),
