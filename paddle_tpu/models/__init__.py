@@ -17,7 +17,9 @@ from paddle_tpu.models.roberta import (RobertaConfig, RobertaForMaskedLM,
 from paddle_tpu.models.falcon import FalconConfig, FalconForCausalLM
 from paddle_tpu.models.gemma import GemmaConfig, GemmaForCausalLM
 from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
+from paddle_tpu.models.glm import GlmConfig, GlmForCausalLM
 from paddle_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
+from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
 from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
 from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM

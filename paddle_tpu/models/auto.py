@@ -32,11 +32,15 @@ class _Entry:
 
 def _registry():
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
-    from paddle_tpu.models import gemma, gpt, gpt_neox, gptj, llama
-    from paddle_tpu.models import opt, qwen, qwen2_moe, roberta, t5
+    from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
+    from paddle_tpu.models import mixtral, opt, qwen, qwen2_moe, roberta, t5
     from paddle_tpu.models import convert as C
 
     return {
+        "glm": _Entry(glm.GlmConfig, glm.GlmForCausalLM,
+                      C.load_glm_state_dict),
+        "mixtral": _Entry(mixtral.MixtralConfig, mixtral.MixtralForCausalLM,
+                          C.load_mixtral_state_dict),
         "llama": _Entry(llama.LlamaConfig, llama.LlamaForCausalLM,
                         C.load_llama_state_dict),
         "mistral": _Entry(llama.LlamaConfig, llama.LlamaForCausalLM,

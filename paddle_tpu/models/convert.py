@@ -734,3 +734,77 @@ def load_gemma_state_dict(model, state_dict, dtype=None):
         lyr.post_attention_layernorm.weight = j(
             sd[p + "post_attention_layernorm.weight"])
     return model
+
+
+def load_mixtral_state_dict(model, state_dict, dtype=None):
+    """Populate a ``MixtralForCausalLM`` from an HF state_dict: llama
+    attention packing + per-layer expert stacks (HF w1=gate, w3=up,
+    w2=down -> stacked [E, h, 2I]/[E, I, h]) + the router."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    model.embed_tokens = j(sd["model.embed_tokens.weight"])
+    model.norm.weight = j(sd["model.norm.weight"])
+    model.lm_head = j(sd.get("lm_head.weight",
+                             sd["model.embed_tokens.weight"]).T)
+    for i, lyr in enumerate(model.layers):
+        p = f"model.layers.{i}."
+        att = lyr.self_attn
+        q = sd[p + "self_attn.q_proj.weight"].T
+        k = sd[p + "self_attn.k_proj.weight"].T
+        v = sd[p + "self_attn.v_proj.weight"].T
+        att.qkv_proj = j(np.concatenate([q, k, v], axis=1))
+        att.o_proj = j(sd[p + "self_attn.o_proj.weight"].T)
+        lyr.input_layernorm.weight = j(sd[p + "input_layernorm.weight"])
+        lyr.post_attention_layernorm.weight = j(
+            sd[p + "post_attention_layernorm.weight"])
+        lyr.moe.gate_w = jnp.asarray(
+            sd[p + "block_sparse_moe.gate.weight"].T, jnp.float32)
+        gu, dn = [], []
+        for e in range(cfg.num_local_experts):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            g = sd[ep + "w1.weight"].T            # gate  [h, I]
+            u = sd[ep + "w3.weight"].T            # up
+            gu.append(np.concatenate([g, u], axis=1))
+            dn.append(sd[ep + "w2.weight"].T)     # down  [I, h]
+        lyr.moe.experts.gate_up = j(np.stack(gu))
+        lyr.moe.experts.down = j(np.stack(dn))
+    return model
+
+
+def load_glm_state_dict(model, state_dict, dtype=None):
+    """Populate a ``GlmForCausalLM`` from an HF state_dict (llama-style
+    q/k/v packing with biases; fused gate_up MLP loads directly)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    model.embed_tokens = j(sd["model.embed_tokens.weight"])
+    model.norm.weight = j(sd["model.norm.weight"])
+    model.lm_head = j(sd.get("lm_head.weight",
+                             sd["model.embed_tokens.weight"]).T)
+    for i, lyr in enumerate(model.layers):
+        p = f"model.layers.{i}."
+        q = sd[p + "self_attn.q_proj.weight"].T
+        k = sd[p + "self_attn.k_proj.weight"].T
+        v = sd[p + "self_attn.v_proj.weight"].T
+        lyr.qkv_proj = j(np.concatenate([q, k, v], axis=1))
+        if lyr.qkv_bias is not None:
+            lyr.qkv_bias = j(np.concatenate(
+                [sd[p + "self_attn.q_proj.bias"],
+                 sd[p + "self_attn.k_proj.bias"],
+                 sd[p + "self_attn.v_proj.bias"]]))
+        lyr.o_proj = j(sd[p + "self_attn.o_proj.weight"].T)
+        lyr.gate_up_proj = j(sd[p + "mlp.gate_up_proj.weight"].T)
+        lyr.down_proj = j(sd[p + "mlp.down_proj.weight"].T)
+        lyr.input_layernorm.weight = j(sd[p + "input_layernorm.weight"])
+        lyr.post_attention_layernorm.weight = j(
+            sd[p + "post_attention_layernorm.weight"])
+    return model

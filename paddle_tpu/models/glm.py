@@ -1,0 +1,147 @@
+"""GLM-4 decoder LM (ref capability: PaddleNLP ``chatglm``/``glm`` model
+families — the ChatGLM lineage, HF ``GlmForCausalLM``).
+
+GLM rotates only the first ``partial_rotary_factor`` of each head dim,
+with GPT-J-style INTERLEAVED even/odd pairing (its ``rotate_half`` helper
+interleaves despite the name — parity-verified against HF). Attention
+carries q/k/v biases (no o bias), the MLP is a fused gate_up SwiGLU,
+norms are RMS, head untied.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class GlmConfig:
+    vocab_size: int = 151552
+    hidden_size: int = 4096
+    intermediate_size: int = 13696
+    num_hidden_layers: int = 40
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 2
+    partial_rotary_factor: float = 0.5
+    max_position_embeddings: int = 131072
+    rms_norm_eps: float = 1.5625e-07
+    rope_theta: float = 10000.0
+    attention_bias: bool = True
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+
+    @staticmethod
+    def tiny(**kw):
+        return GlmConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                   intermediate_size=64,
+                                   num_hidden_layers=2,
+                                   num_attention_heads=4,
+                                   num_key_value_heads=2,
+                                   max_position_embeddings=64,
+                                   rms_norm_eps=1e-6,
+                                   dtype=jnp.float32, remat=False), **kw})
+
+
+def glm_rope(x, cos, sin):
+    """GLM rope over the leading rotary dims: GPT-J-style INTERLEAVED
+    even/odd pairing (GLM's ``rotate_half`` interleaves despite the
+    name). x: [B,S,H,rd]; cos/sin: [S, rd/2] unique freqs."""
+    return A.apply_rope_interleaved(x, cos, sin)
+
+
+class GlmRMSNorm(Module):
+    def __init__(self, size, eps, dtype):
+        super().__init__()
+        self.weight = jnp.ones((size,), dtype)
+        self.eps = eps
+
+    def __call__(self, x):
+        h = x.astype(jnp.float32)
+        h = h * jax.lax.rsqrt(jnp.mean(h * h, -1, keepdims=True) + self.eps)
+        return (h * self.weight.astype(jnp.float32)).astype(x.dtype)
+
+
+class GlmDecoderLayer(Module):
+    def __init__(self, cfg: GlmConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+        d = h // nh
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.input_layernorm = GlmRMSNorm(h, cfg.rms_norm_eps, cfg.dtype)
+        self.qkv_proj = init((h, (nh + 2 * nkv) * d), cfg.dtype)
+        self.qkv_bias = (jnp.zeros(((nh + 2 * nkv) * d,), cfg.dtype)
+                         if cfg.attention_bias else None)
+        self.o_proj = init((h, h), cfg.dtype)
+        self.post_attention_layernorm = GlmRMSNorm(h, cfg.rms_norm_eps,
+                                                   cfg.dtype)
+        self.gate_up_proj = init((h, 2 * cfg.intermediate_size), cfg.dtype)
+        self.down_proj = init((cfg.intermediate_size, h), cfg.dtype)
+        self.dims = (nh, nkv, d, int(d * cfg.partial_rotary_factor))
+
+    def __call__(self, x, cos, sin):
+        b, s, hd = x.shape
+        nh, nkv, d, rd = self.dims
+        h = self.input_layernorm(x)
+        qkv = h @ self.qkv_proj
+        if self.qkv_bias is not None:
+            qkv = qkv + self.qkv_bias
+        q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
+
+        def rope(t, n):
+            t = t.reshape(b, s, n, d)
+            return jnp.concatenate(
+                [glm_rope(t[..., :rd], cos, sin), t[..., rd:]], axis=-1)
+
+        q, k = rope(q, nh), rope(k, nkv)
+        att = A.scaled_dot_product_attention(q, k, v.reshape(b, s, nkv, d),
+                                             is_causal=True)
+        x = x + att.reshape(b, s, hd) @ self.o_proj
+        h2 = self.post_attention_layernorm(x)
+        gate, up = jnp.split(h2 @ self.gate_up_proj, 2, axis=-1)
+        return x + (up * jax.nn.silu(gate)) @ self.down_proj
+
+
+class GlmForCausalLM(Module):
+    def __init__(self, cfg: GlmConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.embed_tokens = init((cfg.vocab_size, cfg.hidden_size),
+                                 cfg.dtype)
+        self.layers = [GlmDecoderLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.norm = GlmRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, cfg.dtype)
+        self.lm_head = init((cfg.hidden_size, cfg.vocab_size), cfg.dtype)
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        d = cfg.hidden_size // cfg.num_attention_heads
+        rd = int(d * cfg.partial_rotary_factor)
+        cos, sin = A.rope_cos_sin(s, rd, base=cfg.rope_theta)
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        blk = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin))
+               if cfg.remat else (lambda lyr, h: lyr(h, cos, sin)))
+        for lyr in self.layers:
+            x = blk(lyr, x)
+        return self.norm(x) @ self.lm_head
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,99 @@
+"""Mixtral (ref capability: PaddleNLP ``mixtral`` model family —
+Mixtral-8x7B-class sparse MoE).
+
+LLaMA attention (GQA, optional sliding window, no biases) with every MLP
+a routed-expert block: softmax -> top-k -> RENORMALISED gates (unlike
+Qwen2-MoE's raw mass), no shared expert. Runs on the same sort-based
+``distributed.moe.MoELayer`` in dropless mode; HF checkpoint parity in
+tests/test_convert.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.distributed.moe import MoELayer
+from paddle_tpu.models.llama import (LlamaAttention, LlamaConfig,
+                                     LlamaRMSNorm)
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class MixtralConfig(LlamaConfig):
+    rms_norm_eps: float = 1e-5
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    router_aux_loss_coef: float = 0.02
+
+    @staticmethod
+    def tiny(**kw):
+        return MixtralConfig(**{**dict(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            num_local_experts=4, num_experts_per_tok=2,
+            dtype=jnp.float32, remat=False, scan_layers=False), **kw})
+
+
+class MixtralDecoderLayer(Module):
+    def __init__(self, cfg: MixtralConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(cfg.hidden_size,
+                                            cfg.rms_norm_eps, cfg.dtype)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = LlamaRMSNorm(
+            cfg.hidden_size, cfg.rms_norm_eps, cfg.dtype)
+        self.moe = MoELayer(cfg.hidden_size, cfg.intermediate_size,
+                            cfg.num_local_experts,
+                            k=cfg.num_experts_per_tok,
+                            capacity_factor=None,      # dropless (exact)
+                            norm_topk_prob=True,       # Mixtral renorms
+                            dtype=cfg.dtype)
+
+    def __call__(self, x, cos, sin, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        y, aux = self.moe(self.post_attention_layernorm(x))
+        return x + y, aux
+
+
+class MixtralForCausalLM(Module):
+    def __init__(self, cfg: MixtralConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.embed_tokens = init((cfg.vocab_size, cfg.hidden_size),
+                                 cfg.dtype)
+        self.layers = [MixtralDecoderLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                                 cfg.dtype)
+        self.lm_head = init((cfg.hidden_size, cfg.vocab_size), cfg.dtype)
+
+    def _forward(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        d = cfg.hidden_size // cfg.num_attention_heads
+        cos, sin = A.rope_cos_sin(
+            s, d, base=cfg.rope_theta, scaling=cfg.rope_scaling,
+            max_position_embeddings=cfg.max_position_embeddings)
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        aux_total = 0.0
+        for lyr in self.layers:
+            x, aux = lyr(x, cos, sin)
+            aux_total = aux_total + aux
+        return self.norm(x) @ self.lm_head, aux_total
+
+    def __call__(self, input_ids):
+        return self._forward(input_ids)[0]
+
+    def loss(self, input_ids, labels):
+        from paddle_tpu.nn import functional as F
+        logits, aux = self._forward(input_ids)
+        ce = F.cross_entropy(logits.astype(jnp.float32),
+                             jnp.maximum(labels, 0), reduction="none")
+        mask = (labels >= 0).astype(jnp.float32)
+        lm = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return lm + self.cfg.router_aux_loss_coef * aux

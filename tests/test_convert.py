@@ -720,3 +720,65 @@ def test_gemma_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_mixtral_logits_match_transformers():
+    """Mixtral (renormalised top-k routed experts, no shared expert):
+    HF checkpoint parity through the sort-based MoE stack."""
+    import torch
+    from transformers import MixtralConfig as HFConfig
+    from transformers import MixtralForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64, num_local_experts=4,
+                          num_experts_per_tok=2, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_mixtral_state_dict
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    pt.seed(0)
+    cfg = MixtralConfig.tiny(vocab_size=96)
+    ours = load_mixtral_state_dict(MixtralForCausalLM(cfg).eval(),
+                                   hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_glm_logits_match_transformers():
+    """GLM-4 / ChatGLM lineage (partial rotary with INTERLEAVED tables +
+    rotate-half pairing, biased qkv, fused gate_up SwiGLU): logits match
+    HF."""
+    import torch
+    from transformers import GlmConfig as HFConfig
+    from transformers import GlmForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          partial_rotary_factor=0.5, rms_norm_eps=1e-6,
+                          max_position_embeddings=64, use_cache=False,
+                          pad_token_id=0, eos_token_id=1, bos_token_id=2,
+                          head_dim=8,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_glm_state_dict
+    from paddle_tpu.models.glm import GlmConfig, GlmForCausalLM
+
+    pt.seed(0)
+    cfg = GlmConfig.tiny(vocab_size=96)
+    ours = load_glm_state_dict(GlmForCausalLM(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
