@@ -4,6 +4,7 @@ from paddle_tpu.models.bert import (
     BertForSequenceClassification,
     BertModel,
 )
+from paddle_tpu.models.albert import AlbertConfig, AlbertForMaskedLM
 from paddle_tpu.models.bart import BartConfig, BartForConditionalGeneration
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
 from paddle_tpu.models.electra import (ElectraConfig, ElectraForPreTraining,

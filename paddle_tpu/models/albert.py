@@ -1,0 +1,133 @@
+"""ALBERT (ref: PaddleNLP ``paddlenlp/transformers/albert/modeling.py``).
+
+The parameter-sharing encoder: ONE transformer layer's weights are
+applied ``num_hidden_layers`` times (the ALBERT recycling trick — a
+natural fit for ``lax.scan``-over-depth with a constant carry of shared
+weights), on top of a factorized embedding (``embedding_size`` <<
+``hidden_size`` + projection). Post-LN blocks, gelu_new activation, MLM
+head back in embedding space with the decoder tied to the word table.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
+from paddle_tpu.nn.transformer import MultiHeadAttention
+
+
+@dataclass
+class AlbertConfig:
+    vocab_size: int = 30000
+    embedding_size: int = 128
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return AlbertConfig(**{**dict(vocab_size=128, embedding_size=16,
+                                      hidden_size=32, num_hidden_layers=3,
+                                      num_attention_heads=2,
+                                      intermediate_size=64,
+                                      max_position_embeddings=64), **kw})
+
+
+class AlbertSharedLayer(Module):
+    """The ONE layer whose weights every depth step reuses."""
+
+    def __init__(self, cfg: AlbertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.attention = MultiHeadAttention(h, cfg.num_attention_heads,
+                                            dtype=cfg.dtype)
+        self.attn_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                   dtype=cfg.dtype)
+        self.ffn = Linear(h, cfg.intermediate_size, dtype=cfg.dtype)
+        self.ffn_output = Linear(cfg.intermediate_size, h, dtype=cfg.dtype)
+        self.full_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                   dtype=cfg.dtype)
+
+    def __call__(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.attention(x, attn_mask=attn_mask))
+        m = self.ffn_output(F.gelu(self.ffn(x), approximate=True))
+        return self.full_norm(x + m)
+
+
+class AlbertModel(Module):
+    def __init__(self, cfg: AlbertConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        e = cfg.embedding_size
+        self.word_embeddings = Embedding(cfg.vocab_size, e,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, e,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, e,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(e, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.embedding_project = Linear(e, cfg.hidden_size, dtype=cfg.dtype)
+        self.shared = AlbertSharedLayer(cfg)
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size,
+                             dtype=cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(jnp.arange(s)[None, :])
+             + self.token_type_embeddings(token_type_ids))
+        x = self.embedding_project(self.emb_norm(x))
+        # weight recycling: the SAME layer params each depth step
+        for _ in range(cfg.num_hidden_layers):
+            x = self.shared(x, attn_mask=attention_mask)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class AlbertForMaskedLM(Module):
+    def __init__(self, cfg: AlbertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.albert = AlbertModel(cfg)
+        self.lm_dense = Linear(cfg.hidden_size, cfg.embedding_size,
+                               dtype=cfg.dtype)
+        self.lm_norm = LayerNorm(cfg.embedding_size,
+                                 epsilon=cfg.layer_norm_eps,
+                                 dtype=cfg.dtype)
+        self.lm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.albert(input_ids, token_type_ids, attention_mask)
+        h = self.lm_norm(F.gelu(self.lm_dense(seq), approximate=True))
+        emb = self.albert.word_embeddings.weight
+        return h @ emb.T + self.lm_bias
+
+    def loss(self, input_ids, mlm_labels, token_type_ids=None,
+             attention_mask=None):
+        logits = self(input_ids, token_type_ids, attention_mask)
+        ce = F.cross_entropy(logits.astype(jnp.float32),
+                             jnp.maximum(mlm_labels, 0), reduction="none")
+        mask = (mlm_labels >= 0).astype(jnp.float32)
+        return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -808,3 +808,51 @@ def load_glm_state_dict(model, state_dict, dtype=None):
         lyr.post_attention_layernorm.weight = j(
             sd[p + "post_attention_layernorm.weight"])
     return model
+
+
+def load_albert_state_dict(model, state_dict, dtype=None):
+    """Populate an ``AlbertForMaskedLM``/``AlbertModel`` from an HF
+    state_dict (factorized embeddings + ONE shared layer group)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("albert."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    al = model.albert if hasattr(model, "albert") else model
+    al.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    al.position_embeddings.weight = j(
+        sd["embeddings.position_embeddings.weight"])
+    al.token_type_embeddings.weight = j(
+        sd["embeddings.token_type_embeddings.weight"])
+    ln(al.emb_norm, "embeddings.LayerNorm")
+    lin(al.embedding_project, "encoder.embedding_hidden_mapping_in")
+    p = "encoder.albert_layer_groups.0.albert_layers.0."
+    a = al.shared.attention
+    lin(a.q_proj, p + "attention.query")
+    lin(a.k_proj, p + "attention.key")
+    lin(a.v_proj, p + "attention.value")
+    lin(a.out_proj, p + "attention.dense")
+    ln(al.shared.attn_norm, p + "attention.LayerNorm")
+    lin(al.shared.ffn, p + "ffn")
+    lin(al.shared.ffn_output, p + "ffn_output")
+    ln(al.shared.full_norm, p + "full_layer_layer_norm")
+    if "pooler.weight" in sd:
+        lin(al.pooler, "pooler")
+    if hasattr(model, "lm_dense") and "predictions.bias" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.lm_dense.weight = j(sp["predictions.dense.weight"].T)
+        model.lm_dense.bias = j(sp["predictions.dense.bias"])
+        model.lm_norm.weight = j(sp["predictions.LayerNorm.weight"])
+        model.lm_norm.bias = j(sp["predictions.LayerNorm.bias"])
+        model.lm_bias = j(sp["predictions.bias"])
+    return model

@@ -782,3 +782,35 @@ def test_glm_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_albert_mlm_logits_match_transformers():
+    """ALBERT (one shared layer applied L times, factorized embeddings,
+    MLM head back in embedding space): logits match HF."""
+    import torch
+    from transformers import AlbertConfig as HFConfig
+    from transformers import AlbertForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, embedding_size=16, hidden_size=32,
+                          num_hidden_layers=3, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=64,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.albert import AlbertConfig, AlbertForMaskedLM
+    from paddle_tpu.models.convert import load_albert_state_dict
+
+    pt.seed(0)
+    cfg = AlbertConfig.tiny(vocab_size=96)
+    ours = load_albert_state_dict(AlbertForMaskedLM(cfg).eval(),
+                                  hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 token_type_ids=torch.tensor(tt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids), token_type_ids=jnp.asarray(tt)),
+                     np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
