@@ -184,6 +184,27 @@ def _sample(logits, rng, temperature, top_k, top_p):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _sample_rows(logits, rng, temps, top_ps, top_k=None):
+    """Per-ROW temperature/top-p sampling (the serving engine's
+    per-request params; ref PaddleNLP predictor per-request
+    GenerationConfig). ``temps``/``top_ps``: [B] traced — temperature 0
+    means greedy FOR THAT ROW; top_p 1.0 disables the nucleus cut.
+    ``top_k`` stays global/static."""
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    scaled = logits / safe_t
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_ps[:, None], axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temps > 0, sampled, jnp.argmax(logits, axis=-1))
+
+
 def generate(model, input_ids, max_new_tokens=32, temperature=0.0, top_k=None,
              top_p=None, eos_token_id=None, rng=None, repetition_penalty=1.0,
              min_new_tokens=0):

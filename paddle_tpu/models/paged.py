@@ -372,26 +372,29 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
 
 
 def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
-                      upd_rows, upd_cols, upd_vals, rng,
-                      temperature=0.0, top_k=None, top_p=None,
-                      want_logp=False):
+                      upd_rows, upd_cols, upd_vals, rng, temps, top_ps,
+                      top_k=None, want_logp=False):
     """ONE fused serving tick: apply incremental block-table updates
     (``tables[upd_rows[i], upd_cols[i]] = upd_vals[i]``, sentinel rows
     dropped — no host-side table rebuild/re-upload), run the decode step,
     and sample the next token ON DEVICE. The only per-tick host traffic is
     the [B] sampled-token fetch the engine needs for streaming/EOS.
 
-    ``want_logp`` (static): also return the [B, vocab] log-probs for beam
-    selection, LEFT ON DEVICE. When False (greedy-only ticks) logp is ()
-    so no [B, vocab] f32 buffer is ever materialised."""
-    from paddle_tpu.models.decoding import _sample
+    ``temps``/``top_ps``: [B] traced per-slot sampling params (each
+    request its own; 0 temperature = greedy for that row). ``top_k`` is
+    static/global. ``want_logp`` (static): also return the [B, vocab]
+    log-probs for beam selection, LEFT ON DEVICE. When False
+    (greedy-only ticks) logp is () so no [B, vocab] f32 buffer is ever
+    materialised."""
+    from paddle_tpu.models.decoding import _sample_rows
     tables = cache.block_tables.at[upd_rows, upd_cols].set(upd_vals,
                                                            mode="drop")
     cache = PagedKVCache(cache.k_pools, cache.v_pools, tables, cache.lens)
     logits, cache = llama_decode_step_paged(model, tokens, cache, active)
     logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             if want_logp else ())
-    nxt = _sample(logits.astype(jnp.float32), rng, temperature, top_k, top_p)
+    nxt = _sample_rows(logits.astype(jnp.float32), rng, temps, top_ps,
+                       top_k)
     nxt = jnp.where(active, nxt.astype(jnp.int32), tokens)
     return nxt, logp, cache
 
@@ -400,7 +403,7 @@ def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
 # paged_generate calls (a per-call jax.jit would recompile every request)
 _PREFILL_JIT = jax.jit(llama_prefill_paged)
 _DECODE_JIT = jax.jit(llama_decode_step_paged)
-_TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(8, 9, 10, 11),
+_TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(10, 11),
                     donate_argnums=(2,))
 
 

@@ -30,14 +30,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.models.decoding import _sample
+from paddle_tpu.models.decoding import _sample_rows
 from paddle_tpu.models.paged import (PagedKVCache, RefBlockManager,
                                      _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
                                      _BEAM_SELECT_JIT, _PREFILL_JIT,
                                      _TICK_JIT)
 
 # module-level so its compile cache persists across admissions
-_SAMPLE_JIT = jax.jit(_sample, static_argnums=(2, 3, 4))
+_SAMPLE_ROWS_JIT = jax.jit(_sample_rows, static_argnums=(4,))
 
 
 @dataclass
@@ -54,6 +54,9 @@ class Request:
     stream: object = None
     num_beams: int = 1
     length_penalty: float = 1.0
+    # per-request sampling overrides (None = the engine's defaults):
+    temperature: float = None
+    top_p: float = None
     # filled by the engine:
     tokens: list = field(default_factory=list)   # generated tokens
     done: bool = False
@@ -106,7 +109,13 @@ class LLMEngine:
         # unforked (greedy) sequences it behaves exactly like BlockManager
         self.mgr = RefBlockManager(num_blocks, block_size)
         self.eos_token_id = eos_token_id
-        self.sampling = (float(temperature), top_k, top_p)
+        # engine defaults; each request may override temperature/top_p
+        # (top_k stays engine-global — it is a static compile parameter)
+        self.default_temp = float(temperature)
+        self.default_top_p = 1.0 if top_p is None else float(top_p)
+        self.top_k = top_k
+        self.temps = np.zeros(num_slots, np.float32)
+        self.top_ps = np.ones(num_slots, np.float32)
         self.rng = jax.random.PRNGKey(seed)
         # sliding-window models: blocks entirely below cur - window are
         # never attended again (the paged kernel KEEPS only positions
@@ -305,6 +314,10 @@ class LLMEngine:
             self.gen[slot] = 0
             self.max_gen[slot] = req.max_new_tokens
             self.table_len[slot] = len(t)
+            self.temps[slot] = (self.default_temp if req.temperature is None
+                                else req.temperature)
+            self.top_ps[slot] = (self.default_top_p if req.top_p is None
+                                 else req.top_p)
         n = len(admits)
         beams = []
         for bi, (bslots, req) in enumerate(beam_admits):
@@ -319,8 +332,14 @@ class LLMEngine:
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             self.cache, jnp.asarray(slots), jnp.asarray(rows))
         self.rng, sub = jax.random.split(self.rng)
-        first = np.asarray(_SAMPLE_JIT(logits.astype(jnp.float32), sub,
-                                       *self.sampling))
+        row_temps = np.zeros(a_cap, np.float32)
+        row_tps = np.ones(a_cap, np.float32)
+        for i, (slot, req) in enumerate(admits):
+            row_temps[i] = self.temps[slot]
+            row_tps[i] = self.top_ps[slot]
+        first = np.asarray(_SAMPLE_ROWS_JIT(
+            logits.astype(jnp.float32), sub, jnp.asarray(row_temps),
+            jnp.asarray(row_tps), self.top_k))
         if self.window is not None:
             # a long prompt's below-window blocks die the moment prefill
             # has scattered them — and from here on the sequence can never
@@ -408,6 +427,8 @@ class LLMEngine:
             self.active[slot] = True
             self.is_beam[slot] = True
             self.cur[slot] = s
+            self.temps[slot] = 0.0       # beam tokens come from select
+            self.top_ps[slot] = 1.0
         self.groups[rid] = g
         self._update_resv_group(rid)
         return self._beam_advance(rid, g)
@@ -539,7 +560,8 @@ class LLMEngine:
         nxt, logp, self.cache = _TICK_JIT(
             self.model, jnp.asarray(self.last_tok), self.cache,
             jnp.asarray(self.active), jnp.asarray(rows), jnp.asarray(cols),
-            jnp.asarray(vals), sub, *self.sampling, bool(self.groups))
+            jnp.asarray(vals), sub, jnp.asarray(self.temps),
+            jnp.asarray(self.top_ps), self.top_k, bool(self.groups))
         was_active = self.active.copy()
         nxt = np.asarray(nxt)                 # the one per-tick host fetch
         t2 = perf_counter()
