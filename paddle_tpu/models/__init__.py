@@ -7,6 +7,8 @@ from paddle_tpu.models.bert import (
 from paddle_tpu.models.albert import AlbertConfig, AlbertForMaskedLM
 from paddle_tpu.models.bart import BartConfig, BartForConditionalGeneration
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
+from paddle_tpu.models.deberta import (DebertaV2Config,
+                                       DebertaV2ForMaskedLM, DebertaV2Model)
 from paddle_tpu.models.electra import (ElectraConfig, ElectraForPreTraining,
                                        ElectraModel)
 from paddle_tpu.models.ernie import (ErnieConfig, ErnieForMaskedLM,

@@ -31,7 +31,7 @@ class _Entry:
 
 
 def _registry():
-    from paddle_tpu.models import albert
+    from paddle_tpu.models import albert, deberta
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
     from paddle_tpu.models import mixtral, opt, qwen, qwen2_moe, roberta, t5
@@ -40,6 +40,9 @@ def _registry():
     return {
         "albert": _Entry(albert.AlbertConfig, albert.AlbertForMaskedLM,
                          C.load_albert_state_dict),
+        "deberta-v2": _Entry(deberta.DebertaV2Config,
+                             deberta.DebertaV2ForMaskedLM,
+                             C.load_deberta_v2_state_dict),
         "glm": _Entry(glm.GlmConfig, glm.GlmForCausalLM,
                       C.load_glm_state_dict),
         "mixtral": _Entry(mixtral.MixtralConfig, mixtral.MixtralForCausalLM,

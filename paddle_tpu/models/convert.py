@@ -856,3 +856,62 @@ def load_albert_state_dict(model, state_dict, dtype=None):
         model.lm_norm.bias = j(sp["predictions.LayerNorm.bias"])
         model.lm_bias = j(sp["predictions.bias"])
     return model
+
+
+def load_deberta_v2_state_dict(model, state_dict, dtype=None):
+    """Populate a ``DebertaV2ForMaskedLM``/``DebertaV2Model`` from an HF
+    state_dict (disentangled attention, shared rel embeddings)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("deberta."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    de = model.deberta if hasattr(model, "deberta") else model
+    de.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    if de.position_embeddings is not None:
+        de.position_embeddings.weight = j(
+            sd["embeddings.position_embeddings.weight"])
+    if de.token_type_embeddings is not None:
+        de.token_type_embeddings.weight = j(
+            sd["embeddings.token_type_embeddings.weight"])
+    if de.embed_proj is not None:
+        de.embed_proj = j(sd["embeddings.embed_proj.weight"].T)
+    ln(de.emb_norm, "embeddings.LayerNorm")
+    if de.rel_embeddings is not None:
+        de.rel_embeddings = j(sd["encoder.rel_embeddings.weight"])
+        if de.rel_norm is not None:
+            ln(de.rel_norm, "encoder.LayerNorm")
+    for i, lyr in enumerate(de.layers):
+        p = f"encoder.layer.{i}."
+        a = lyr.attention
+        lin(a.query_proj, p + "attention.self.query_proj")
+        lin(a.key_proj, p + "attention.self.key_proj")
+        lin(a.value_proj, p + "attention.self.value_proj")
+        lin(a.dense, p + "attention.output.dense")
+        ln(a.out_norm, p + "attention.output.LayerNorm")
+        lin(lyr.intermediate, p + "intermediate.dense")
+        lin(lyr.output, p + "output.dense")
+        ln(lyr.out_norm, p + "output.LayerNorm")
+    if hasattr(model, "mlm_transform") and \
+            "cls.predictions.transform.dense.weight" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.mlm_transform.weight = j(
+            sp["cls.predictions.transform.dense.weight"].T)
+        model.mlm_transform.bias = j(
+            sp["cls.predictions.transform.dense.bias"])
+        model.mlm_norm.weight = j(
+            sp["cls.predictions.transform.LayerNorm.weight"])
+        model.mlm_norm.bias = j(
+            sp["cls.predictions.transform.LayerNorm.bias"])
+        model.mlm_bias = j(sp["cls.predictions.bias"])
+    return model

@@ -1,0 +1,251 @@
+"""DeBERTa-v2/v3 (ref: PaddleNLP ``paddlenlp/transformers/deberta_v2``).
+
+The disentangled-attention encoder: attention scores are the sum of
+content-to-content, content-to-POSITION and POSITION-to-content terms,
+each scaled by ``1/sqrt(d * scale_factor)``, where positions are
+log-bucketed relative distances looked up in ONE shared relative
+embedding table (projected through the same q/k projections when
+``share_att_key``). Post-LN blocks; optional factorized embedding.
+Encoder-only (q_len == k_len), matching the HF reference numerics
+(tests/test_convert.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+
+
+@dataclass
+class DebertaV2Config:
+    vocab_size: int = 128100
+    hidden_size: int = 1536
+    embedding_size: int = None           # != hidden -> projected
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 24
+    intermediate_size: int = 6144
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 0
+    position_biased_input: bool = False
+    relative_attention: bool = True
+    position_buckets: int = 256
+    max_relative_positions: int = -1     # -1 -> max_position_embeddings
+    pos_att_type: tuple = ("p2c", "c2p")
+    share_att_key: bool = True
+    norm_rel_ebd: str = "layer_norm"
+    layer_norm_eps: float = 1e-7
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    def __post_init__(self):
+        if self.embedding_size is None:
+            self.embedding_size = self.hidden_size
+        if self.max_relative_positions < 1:
+            self.max_relative_positions = self.max_position_embeddings
+
+    @property
+    def pos_ebd_size(self):
+        return (self.position_buckets if self.position_buckets > 0
+                else self.max_relative_positions)
+
+    @staticmethod
+    def tiny(**kw):
+        return DebertaV2Config(**{**dict(vocab_size=128, hidden_size=32,
+                                         num_hidden_layers=2,
+                                         num_attention_heads=2,
+                                         intermediate_size=64,
+                                         max_position_embeddings=64,
+                                         position_buckets=4,
+                                         layer_norm_eps=1e-7), **kw})
+
+
+def make_log_bucket_position(rel, bucket_size: int, max_position: int):
+    """HF's log-bucketed relative distance: exact within +-bucket/2,
+    logarithmic out to max_position beyond."""
+    sign = jnp.sign(rel).astype(jnp.float32)
+    mid = bucket_size // 2
+    abs_pos = jnp.where((rel < mid) & (rel > -mid), mid - 1,
+                        jnp.abs(rel)).astype(jnp.float32)
+    log_pos = jnp.ceil(jnp.log(abs_pos / mid)
+                       / math.log((max_position - 1) / mid)
+                       * (mid - 1)) + mid
+    return jnp.where(abs_pos <= mid, rel,
+                     (log_pos * sign).astype(jnp.int32))
+
+
+class DisentangledSelfAttention(Module):
+    def __init__(self, cfg: DebertaV2Config):
+        super().__init__()
+        h = cfg.hidden_size
+        self.query_proj = Linear(h, h, dtype=cfg.dtype)
+        self.key_proj = Linear(h, h, dtype=cfg.dtype)
+        self.value_proj = Linear(h, h, dtype=cfg.dtype)
+        self.dense = Linear(h, h, dtype=cfg.dtype)
+        self.out_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.cfg_ref = cfg
+
+    def __call__(self, x, rel_emb, attn_mask=None):
+        cfg = self.cfg_ref
+        b, s, hd = x.shape
+        nh = cfg.num_attention_heads
+        d = hd // nh
+
+        def heads(t):
+            return t.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+
+        q = heads(self.query_proj(x))
+        k = heads(self.key_proj(x))
+        v = heads(self.value_proj(x))
+        sf = 1 + len(tuple(cfg.pos_att_type))
+        scale = math.sqrt(d * sf)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / scale
+
+        if cfg.relative_attention:
+            span = cfg.pos_ebd_size
+            rel = (jnp.arange(s)[:, None]
+                   - jnp.arange(s)[None, :]).astype(jnp.int32)
+            if cfg.position_buckets > 0:
+                rel = make_log_bucket_position(rel, cfg.position_buckets,
+                                               cfg.max_relative_positions)
+            table = rel_emb[: span * 2]                  # [2A, H]
+            # share_att_key: positions go through the SAME q/k projections
+            pos_k = self.key_proj(table).reshape(2 * span, nh, d)
+            pos_q = self.query_proj(table).reshape(2 * span, nh, d)
+            if "c2p" in cfg.pos_att_type:
+                qp = jnp.einsum("bhqd,phd->bhqp", q, pos_k)  # [B,H,S,2A]
+                idx = jnp.clip(rel + span, 0, 2 * span - 1)
+                c2p = jnp.take_along_axis(
+                    qp, jnp.broadcast_to(idx[None, None], (b, nh, s, s)),
+                    axis=-1)
+                scores = scores + c2p / scale
+            if "p2c" in cfg.pos_att_type:
+                kp = jnp.einsum("bhkd,phd->bhkp", k, pos_q)  # [B,H,S,2A]
+                idx = jnp.clip(-rel + span, 0, 2 * span - 1)
+                p2c = jnp.take_along_axis(
+                    kp, jnp.broadcast_to(idx[None, None], (b, nh, s, s)),
+                    axis=-1)
+                scores = scores + p2c.transpose(0, 1, 3, 2) / scale
+
+        if attn_mask is not None:
+            scores = scores + attn_mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, hd)
+        return self.out_norm(x + self.dense(out))
+
+
+class DebertaV2Layer(Module):
+    def __init__(self, cfg: DebertaV2Config):
+        super().__init__()
+        self.attention = DisentangledSelfAttention(cfg)
+        self.intermediate = Linear(cfg.hidden_size, cfg.intermediate_size,
+                                   dtype=cfg.dtype)
+        self.output = Linear(cfg.intermediate_size, cfg.hidden_size,
+                             dtype=cfg.dtype)
+        self.out_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+
+    def __call__(self, x, rel_emb, attn_mask=None):
+        x = self.attention(x, rel_emb, attn_mask)
+        m = self.output(F.gelu(self.intermediate(x)))
+        return self.out_norm(x + m)
+
+
+class DebertaV2Model(Module):
+    def __init__(self, cfg: DebertaV2Config):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        e = cfg.embedding_size
+        self.word_embeddings = Embedding(cfg.vocab_size, e,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = (
+            Embedding(cfg.max_position_embeddings, e, weight_init=init,
+                      dtype=cfg.dtype) if cfg.position_biased_input
+            else None)
+        self.token_type_embeddings = (
+            Embedding(cfg.type_vocab_size, e, weight_init=init,
+                      dtype=cfg.dtype) if cfg.type_vocab_size > 0 else None)
+        self.embed_proj = (init((e, cfg.hidden_size), cfg.dtype)
+                           if e != cfg.hidden_size else None)
+        self.emb_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.layers = [DebertaV2Layer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.rel_embeddings = (init((cfg.pos_ebd_size * 2, cfg.hidden_size),
+                                    cfg.dtype)
+                               if cfg.relative_attention else None)
+        self.rel_norm = (LayerNorm(cfg.hidden_size,
+                                   epsilon=cfg.layer_norm_eps,
+                                   dtype=cfg.dtype)
+                         if cfg.relative_attention
+                         and "layer_norm" in cfg.norm_rel_ebd else None)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        x = self.word_embeddings(input_ids)
+        if self.position_embeddings is not None:
+            x = x + self.position_embeddings(jnp.arange(s)[None, :])
+        if self.token_type_embeddings is not None:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + self.token_type_embeddings(token_type_ids)
+        if self.embed_proj is not None:
+            x = x @ self.embed_proj
+        x = self.emb_norm(x)
+        mask = None
+        if attention_mask is not None:
+            mask = (1.0 - attention_mask[:, None, None, :]
+                    .astype(jnp.float32)) * -1e9
+        rel = self.rel_embeddings
+        if rel is not None and self.rel_norm is not None:
+            rel = self.rel_norm(rel)
+        for lyr in self.layers:
+            x = lyr(x, rel, mask)
+        return x
+
+
+class DebertaV2ForMaskedLM(Module):
+    def __init__(self, cfg: DebertaV2Config):
+        super().__init__()
+        self.cfg = cfg
+        self.deberta = DebertaV2Model(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    dtype=cfg.dtype)
+        self.mlm_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.mlm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq = self.deberta(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        emb = self.deberta.word_embeddings.weight
+        logits = h @ emb.T
+        if self.cfg.embedding_size != self.cfg.hidden_size:
+            raise NotImplementedError(
+                "factorized-embedding MLM head (hidden != embedding_size) "
+                "needs the embedding-space transform; classification "
+                "fine-tuning does not use the MLM head")
+        return logits + self.mlm_bias
+
+    def loss(self, input_ids, mlm_labels, token_type_ids=None,
+             attention_mask=None):
+        logits = self(input_ids, token_type_ids, attention_mask)
+        ce = F.cross_entropy(logits.astype(jnp.float32),
+                             jnp.maximum(mlm_labels, 0), reduction="none")
+        mask = (mlm_labels >= 0).astype(jnp.float32)
+        return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -814,3 +814,40 @@ def test_albert_mlm_logits_match_transformers():
     got = np.asarray(ours(jnp.asarray(ids), token_type_ids=jnp.asarray(tt)),
                      np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_deberta_v2_mlm_logits_match_transformers():
+    """DeBERTa-v2/v3 (disentangled c2c+c2p+p2c attention over
+    log-bucketed relative positions, shared rel table through the q/k
+    projections): MLM logits match HF."""
+    import torch
+    from transformers import DebertaV2Config as HFConfig
+    from transformers import DebertaV2ForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=64, type_vocab_size=0,
+                          position_biased_input=False,
+                          relative_attention=True, position_buckets=4,
+                          pos_att_type=["p2c", "c2p"], share_att_key=True,
+                          norm_rel_ebd="layer_norm",
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_deberta_v2_state_dict
+    from paddle_tpu.models.deberta import (DebertaV2Config,
+                                           DebertaV2ForMaskedLM)
+
+    pt.seed(0)
+    cfg = DebertaV2Config.tiny(vocab_size=96)
+    ours = load_deberta_v2_state_dict(DebertaV2ForMaskedLM(cfg).eval(),
+                                      hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
