@@ -116,3 +116,33 @@ def lora_load_state_dict(lora, state: dict):
                          "b": jnp.asarray(state[path + ".lora_B"],
                                           ab["b"].dtype)}
     return new
+
+
+def make_lora_train_step(base_model, lora, optimizer, loss_fn):
+    """Optimizer-integrated adapter-only training (the reference's
+    LoRAModel + Trainer pairing): ONE jitted program computes the merged
+    forward, adapter grads, and the optimizer update — the base model is
+    a closed-over constant (frozen by construction; it is never donated
+    or rewritten).
+
+    ``loss_fn(merged_model, *batch) -> scalar``. Returns
+    ``(step, adapters, opt_state)`` with
+    ``step(adapters, opt_state, *batch) -> (adapters, opt_state, loss)``.
+    The ``_scale`` hyperparameter is excluded from the optimizer state
+    (weight decay must not shrink it)."""
+    scale = float(lora["_scale"])
+    adapters = {k: v for k, v in lora.items() if k != "_scale"}
+    opt_state = optimizer.init(adapters)
+
+    def step(adapters, opt_state, *batch):
+        def f(ad):
+            merged = lora_merge(
+                base_model,
+                {**ad, "_scale": jnp.asarray(scale, jnp.float32)})
+            return loss_fn(merged, *batch)
+
+        loss, grads = jax.value_and_grad(f)(adapters)
+        adapters, opt_state = optimizer.step(adapters, grads, opt_state)
+        return adapters, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1)), adapters, opt_state

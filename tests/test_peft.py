@@ -71,3 +71,24 @@ def test_merge_and_checkpoint_roundtrip():
                                  sd)
     np.testing.assert_allclose(np.asarray(lora_merge(m, lora2)(ids)), ref,
                                rtol=1e-6, atol=1e-6)
+
+
+def test_make_lora_train_step_with_adamw():
+    from paddle_tpu.optimizer import AdamW
+    m = _model()
+    lora = lora_init(m, jax.random.PRNGKey(3), r=4)
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(0, 64, (4, 8)))
+    labels = jnp.asarray(rs.randint(0, 64, (4, 8)))
+
+    from paddle_tpu.peft import make_lora_train_step
+    step, adapters, opt_state = make_lora_train_step(
+        m, lora, AdamW(learning_rate=1e-2),
+        lambda mm, x, y: mm.loss(x, y))
+    losses = []
+    for _ in range(5):
+        adapters, opt_state, loss = step(adapters, opt_state, ids, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # "_scale" never entered the optimizer
+    assert "_scale" not in adapters
