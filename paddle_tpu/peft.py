@@ -126,15 +126,23 @@ def make_lora_train_step(base_model, lora, optimizer, loss_fn):
     or rewritten).
 
     ``loss_fn(merged_model, *batch) -> scalar``. Returns
-    ``(step, adapters, opt_state)`` with
-    ``step(adapters, opt_state, *batch) -> (adapters, opt_state, loss)``.
-    The ``_scale`` hyperparameter is excluded from the optimizer state
-    (weight decay must not shrink it)."""
+    ``(step, lora, opt_state)`` with
+    ``step(lora, opt_state, *batch) -> (lora, opt_state, loss)`` — the
+    full adapter tree (``_scale`` included) flows in and out, so every
+    other peft helper (``lora_merge``, ``lora_state_dict``) works on the
+    trained tree directly; only the A/B leaves enter the optimizer (the
+    ``_scale`` hyperparameter must not see weight decay). The returned
+    ``lora`` is a COPY of the input leaves: the step donates its buffers,
+    and a donating loop must never invalidate the caller's original tree
+    (same rule as _pp_params(copy=True) in models/llama.py)."""
     scale = float(lora["_scale"])
-    adapters = {k: v for k, v in lora.items() if k != "_scale"}
-    opt_state = optimizer.init(adapters)
+    lora = jax.tree_util.tree_map(jnp.copy, lora)
+    opt_state = optimizer.init(
+        {k: v for k, v in lora.items() if k != "_scale"})
 
-    def step(adapters, opt_state, *batch):
+    def step(lora_tree, opt_state, *batch):
+        adapters = {k: v for k, v in lora_tree.items() if k != "_scale"}
+
         def f(ad):
             merged = lora_merge(
                 base_model,
@@ -143,6 +151,7 @@ def make_lora_train_step(base_model, lora, optimizer, loss_fn):
 
         loss, grads = jax.value_and_grad(f)(adapters)
         adapters, opt_state = optimizer.step(adapters, grads, opt_state)
-        return adapters, opt_state, loss
+        out = {**adapters, "_scale": jnp.asarray(scale, jnp.float32)}
+        return out, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1)), adapters, opt_state
+    return jax.jit(step, donate_argnums=(0, 1)), lora, opt_state

@@ -90,5 +90,11 @@ def test_make_lora_train_step_with_adamw():
         adapters, opt_state, loss = step(adapters, opt_state, ids, labels)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
-    # "_scale" never entered the optimizer
-    assert "_scale" not in adapters
+    # the full tree flows out: _scale rides along untouched (no weight
+    # decay), and the trained tree works with the other peft helpers
+    assert float(adapters["_scale"]) == float(lora["_scale"])
+    lora_merge(m, adapters)(ids)
+    rt = lora_load_state_dict(adapters, lora_state_dict(adapters))
+    assert float(rt["_scale"]) == float(lora["_scale"])
+    # the caller's ORIGINAL tree survived the donating loop
+    lora_merge(m, lora)(ids)
