@@ -5,7 +5,10 @@ from paddle_tpu.models.bert import (
     BertModel,
 )
 from paddle_tpu.models.albert import AlbertConfig, AlbertForMaskedLM
-from paddle_tpu.models.bart import BartConfig, BartForConditionalGeneration
+from paddle_tpu.models.bart import (BartConfig,
+                                    BartForConditionalGeneration,
+                                    MBartConfig,
+                                    MBartForConditionalGeneration)
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
 from paddle_tpu.models.deberta import (DebertaV2Config,
                                        DebertaV2ForMaskedLM, DebertaV2Model)
@@ -21,7 +24,8 @@ from paddle_tpu.models.falcon import FalconConfig, FalconForCausalLM
 from paddle_tpu.models.gemma import GemmaConfig, GemmaForCausalLM
 from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
 from paddle_tpu.models.glm import GlmConfig, GlmForCausalLM
-from paddle_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
+from paddle_tpu.models.gptj import (CodeGenConfig, CodeGenForCausalLM,
+                                    GPTJConfig, GPTJForCausalLM)
 from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
 from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
 from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM

@@ -87,6 +87,11 @@ def _registry():
                           C.load_electra_state_dict),
         "bart": _Entry(bart.BartConfig, bart.BartForConditionalGeneration,
                        C.load_bart_state_dict),
+        "mbart": _Entry(bart.MBartConfig,
+                        bart.MBartForConditionalGeneration,
+                        C.load_bart_state_dict),
+        "codegen": _Entry(gptj.CodeGenConfig, gptj.CodeGenForCausalLM,
+                          C.load_codegen_state_dict),
         "t5": _Entry(t5.T5Config, t5.T5ForConditionalGeneration,
                      C.load_t5_state_dict),
     }

@@ -34,6 +34,9 @@ class BartConfig:
     max_position_embeddings: int = 1024
     pad_token_id: int = 1
     scale_embedding: bool = False
+    # mBART shape: pre-LN layers + a final LN on encoder and decoder
+    normalize_before: bool = False
+    add_final_layer_norm: bool = False
     initializer_range: float = 0.02
     dtype: object = jnp.float32
 
@@ -57,8 +60,13 @@ class BartEncoderLayer(Module):
         self.fc1 = Linear(d, cfg.encoder_ffn_dim, dtype=cfg.dtype)
         self.fc2 = Linear(cfg.encoder_ffn_dim, d, dtype=cfg.dtype)
         self.final_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.pre_norm = cfg.normalize_before
 
     def __call__(self, x, attn_mask=None):
+        if self.pre_norm:                    # mBART
+            x = x + self.self_attn(self.self_attn_layer_norm(x),
+                                   attn_mask=attn_mask)
+            return x + self.fc2(F.gelu(self.fc1(self.final_layer_norm(x))))
         x = self.self_attn_layer_norm(
             x + self.self_attn(x, attn_mask=attn_mask))
         return self.final_layer_norm(x + self.fc2(F.gelu(self.fc1(x))))
@@ -78,8 +86,15 @@ class BartDecoderLayer(Module):
         self.fc1 = Linear(d, cfg.decoder_ffn_dim, dtype=cfg.dtype)
         self.fc2 = Linear(cfg.decoder_ffn_dim, d, dtype=cfg.dtype)
         self.final_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.pre_norm = cfg.normalize_before
 
     def __call__(self, x, enc, enc_mask=None):
+        if self.pre_norm:                    # mBART
+            x = x + self.self_attn(self.self_attn_layer_norm(x),
+                                   is_causal=True)
+            x = x + self.encoder_attn(self.encoder_attn_layer_norm(x),
+                                      enc, enc, attn_mask=enc_mask)
+            return x + self.fc2(F.gelu(self.fc1(self.final_layer_norm(x))))
         x = self.self_attn_layer_norm(
             x + self.self_attn(x, is_causal=True))
         x = self.encoder_attn_layer_norm(
@@ -105,6 +120,10 @@ class BartForConditionalGeneration(Module):
                                  for _ in range(cfg.encoder_layers)]
         self.decoder_layers_m = [BartDecoderLayer(cfg)
                                  for _ in range(cfg.decoder_layers)]
+        self.enc_final_norm = (LayerNorm(d, dtype=cfg.dtype)
+                               if cfg.add_final_layer_norm else None)
+        self.dec_final_norm = (LayerNorm(d, dtype=cfg.dtype)
+                               if cfg.add_final_layer_norm else None)
         self.final_logits_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
 
     def _embed(self, ids, pos_table, norm):
@@ -123,6 +142,8 @@ class BartForConditionalGeneration(Module):
                         self.enc_layernorm_embedding)
         for lyr in self.encoder_layers_m:
             x = lyr(x, attn_mask=mask)
+        if self.enc_final_norm is not None:
+            x = self.enc_final_norm(x)
         return x
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
@@ -135,6 +156,8 @@ class BartForConditionalGeneration(Module):
                         self.dec_layernorm_embedding)
         for lyr in self.decoder_layers_m:
             x = lyr(x, enc, enc_mask=enc_mask)
+        if self.dec_final_norm is not None:
+            x = self.dec_final_norm(x)
         return x @ self.shared.T + self.final_logits_bias
 
     def loss(self, input_ids, decoder_input_ids, labels,
@@ -145,3 +168,27 @@ class BartForConditionalGeneration(Module):
                              reduction="none")
         mask = (labels >= 0).astype(jnp.float32)
         return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+@dataclass
+class MBartConfig(BartConfig):
+    """mBART-50 shape: pre-LN layers, final LNs, scaled embeddings
+    (ref: PaddleNLP ``mbart``)."""
+    vocab_size: int = 250054
+    scale_embedding: bool = True
+    normalize_before: bool = True
+    add_final_layer_norm: bool = True
+
+    @staticmethod
+    def tiny(**kw):
+        return MBartConfig(**{**dict(vocab_size=128, d_model=32,
+                                     encoder_layers=2, decoder_layers=2,
+                                     encoder_attention_heads=4,
+                                     decoder_attention_heads=4,
+                                     encoder_ffn_dim=64,
+                                     decoder_ffn_dim=64,
+                                     max_position_embeddings=64), **kw})
+
+
+class MBartForConditionalGeneration(BartForConditionalGeneration):
+    pass

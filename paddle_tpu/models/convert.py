@@ -629,6 +629,9 @@ def load_bart_state_dict(model, state_dict, dtype=None):
     model.dec_positions = j(sd["decoder.embed_positions.weight"])
     ln(model.enc_layernorm_embedding, "encoder.layernorm_embedding")
     ln(model.dec_layernorm_embedding, "decoder.layernorm_embedding")
+    if model.enc_final_norm is not None:        # mBART final LNs
+        ln(model.enc_final_norm, "encoder.layer_norm")
+        ln(model.dec_final_norm, "decoder.layer_norm")
     for i, lyr in enumerate(model.encoder_layers_m):
         p = f"encoder.layers.{i}."
         attn(lyr.self_attn, p + "self_attn")
@@ -914,4 +917,41 @@ def load_deberta_v2_state_dict(model, state_dict, dtype=None):
         model.mlm_norm.bias = j(
             sp["cls.predictions.transform.LayerNorm.bias"])
         model.mlm_bias = j(sp["cls.predictions.bias"])
+    return model
+
+
+def load_codegen_state_dict(model, state_dict, dtype=None):
+    """Populate a ``CodeGenForCausalLM`` from an HF state_dict. The fused
+    qkv_proj is laid out in mp_num=4 groups of (q|v|k) columns with heads
+    group-major; unpack to separate q/k/v keeping the group-major head
+    order consistently everywhere (out_proj consumes the same order)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+    mp = 4
+    local = cfg.n_embd // mp
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    model.wte = j(sd["wte.weight"])
+    model.ln_f.weight = j(sd["ln_f.weight"])
+    model.ln_f.bias = j(sd["ln_f.bias"])
+    model.lm_head = j(sd["lm_head.weight"].T)
+    model.lm_head_bias = j(sd["lm_head.bias"])
+    for i, blk in enumerate(model.h):
+        p = f"h.{i}."
+        blk.ln_1.weight = j(sd[p + "ln_1.weight"])
+        blk.ln_1.bias = j(sd[p + "ln_1.bias"])
+        w = sd[p + "attn.qkv_proj.weight"]            # [3h, h] torch layout
+        w = w.reshape(mp, 3, local, cfg.n_embd)       # groups x (q|v|k)
+        blk.q_proj = j(w[:, 0].reshape(-1, cfg.n_embd).T)
+        blk.v_proj = j(w[:, 1].reshape(-1, cfg.n_embd).T)
+        blk.k_proj = j(w[:, 2].reshape(-1, cfg.n_embd).T)
+        blk.out_proj = j(sd[p + "attn.out_proj.weight"].T)
+        blk.fc_in = j(sd[p + "mlp.fc_in.weight"].T)
+        blk.fc_in_bias = j(sd[p + "mlp.fc_in.bias"])
+        blk.fc_out = j(sd[p + "mlp.fc_out.weight"].T)
+        blk.fc_out_bias = j(sd[p + "mlp.fc_out.bias"])
     return model

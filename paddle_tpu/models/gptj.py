@@ -117,3 +117,22 @@ class GPTJForCausalLM(Module):
                                  axis=-1)[..., 0]
         mask = (labels >= 0).astype(jnp.float32)
         return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)
+
+
+@dataclass
+class CodeGenConfig(GPTJConfig):
+    """CodeGen (ref: PaddleNLP ``codegen`` family) — the GPT-J block with
+    a TPU-core-grouped fused QKV in the checkpoint (mp_num=4 groups,
+    split order q,v,k), unpacked to separate projections at load."""
+    vocab_size: int = 50400
+
+    @staticmethod
+    def tiny(**kw):
+        return CodeGenConfig(**{**dict(vocab_size=128, n_embd=32,
+                                       n_layer=2, n_head=4, rotary_dim=4,
+                                       dtype=jnp.float32, remat=False),
+                                **kw})
+
+
+class CodeGenForCausalLM(GPTJForCausalLM):
+    pass

@@ -851,3 +851,63 @@ def test_deberta_v2_mlm_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_mbart_logits_match_transformers():
+    """mBART (pre-LN BART + final encoder/decoder LNs + scaled
+    embeddings): logits match HF through the shared BART classes."""
+    import torch
+    from transformers import MBartConfig as HFConfig
+    from transformers import MBartForConditionalGeneration as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                          decoder_layers=2, encoder_attention_heads=4,
+                          decoder_attention_heads=4, encoder_ffn_dim=64,
+                          decoder_ffn_dim=64, max_position_embeddings=64,
+                          scale_embedding=True, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.bart import (MBartConfig,
+                                        MBartForConditionalGeneration)
+    from paddle_tpu.models.convert import load_bart_state_dict
+
+    pt.seed(0)
+    cfg = MBartConfig.tiny(vocab_size=96)
+    ours = load_bart_state_dict(MBartForConditionalGeneration(cfg).eval(),
+                                hf.state_dict())
+    rs = np.random.RandomState(0)
+    src = rs.randint(2, 96, (2, 10))
+    tgt = rs.randint(2, 96, (2, 7))
+    with torch.no_grad():
+        ref = hf(torch.tensor(src),
+                 decoder_input_ids=torch.tensor(tgt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_codegen_logits_match_transformers():
+    """CodeGen (GPT-J block; mp_num-grouped fused QKV unpacked at load):
+    logits match HF."""
+    import torch
+    from transformers import CodeGenConfig as HFConfig
+    from transformers import CodeGenForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, n_embd=32, n_layer=2, n_head=4,
+                          rotary_dim=4, n_positions=64, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_codegen_state_dict
+    from paddle_tpu.models.gptj import CodeGenConfig, CodeGenForCausalLM
+
+    pt.seed(0)
+    cfg = CodeGenConfig.tiny(vocab_size=96)
+    ours = load_codegen_state_dict(CodeGenForCausalLM(cfg).eval(),
+                                   hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
