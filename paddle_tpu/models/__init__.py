@@ -14,9 +14,14 @@ from paddle_tpu.models.deberta import (DebertaV2Config,
                                        DebertaV2ForMaskedLM, DebertaV2Model)
 from paddle_tpu.models.electra import (ElectraConfig, ElectraForPreTraining,
                                        ElectraModel)
+from paddle_tpu.models.bart import (PegasusConfig,
+                                    PegasusForConditionalGeneration)
 from paddle_tpu.models.ernie import (ErnieConfig, ErnieForMaskedLM,
                                      ErnieForSequenceClassification,
                                      ErnieModel)
+from paddle_tpu.models.ernie_m import (ErnieMConfig,
+                                       ErnieMForSequenceClassification,
+                                       ErnieMModel)
 from paddle_tpu.models.roberta import (RobertaConfig, RobertaForMaskedLM,
                                        RobertaForSequenceClassification,
                                        RobertaModel)

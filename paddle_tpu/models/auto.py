@@ -33,6 +33,7 @@ class _Entry:
 def _registry():
     from paddle_tpu.models import albert, deberta
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
+    from paddle_tpu.models import ernie_m
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
     from paddle_tpu.models import mixtral, opt, qwen, qwen2_moe, roberta, t5
     from paddle_tpu.models import convert as C
@@ -90,6 +91,11 @@ def _registry():
         "mbart": _Entry(bart.MBartConfig,
                         bart.MBartForConditionalGeneration,
                         C.load_bart_state_dict),
+        "pegasus": _Entry(bart.PegasusConfig,
+                          bart.PegasusForConditionalGeneration,
+                          C.load_bart_state_dict),
+        "ernie_m": _Entry(ernie_m.ErnieMConfig, ernie_m.ErnieMModel,
+                          C.load_ernie_m_state_dict),
         "codegen": _Entry(gptj.CodeGenConfig, gptj.CodeGenForCausalLM,
                           C.load_codegen_state_dict),
         "t5": _Entry(t5.T5Config, t5.T5ForConditionalGeneration,

@@ -37,6 +37,10 @@ class BartConfig:
     # mBART shape: pre-LN layers + a final LN on encoder and decoder
     normalize_before: bool = False
     add_final_layer_norm: bool = False
+    # fairseq heritage: BART/mBART position row p+2 holds position p;
+    # Pegasus has no offset (and a STATIC sinusoidal table)
+    position_offset: int = 2
+    add_embedding_norm: bool = True      # Pegasus drops the embedding LN
     initializer_range: float = 0.02
     dtype: object = jnp.float32
 
@@ -109,13 +113,14 @@ class BartForConditionalGeneration(Module):
         init = I.Normal(0.0, cfg.initializer_range)
         d = cfg.d_model
         self.shared = init((cfg.vocab_size, d), cfg.dtype)
-        # +2: fairseq offset rows (positions p live at row p + 2)
-        self.enc_positions = init((cfg.max_position_embeddings + 2, d),
-                                  cfg.dtype)
-        self.dec_positions = init((cfg.max_position_embeddings + 2, d),
-                                  cfg.dtype)
-        self.enc_layernorm_embedding = LayerNorm(d, dtype=cfg.dtype)
-        self.dec_layernorm_embedding = LayerNorm(d, dtype=cfg.dtype)
+        # fairseq offset rows (positions p live at row p + offset)
+        rows = cfg.max_position_embeddings + cfg.position_offset
+        self.enc_positions = init((rows, d), cfg.dtype)
+        self.dec_positions = init((rows, d), cfg.dtype)
+        self.enc_layernorm_embedding = (LayerNorm(d, dtype=cfg.dtype)
+                                        if cfg.add_embedding_norm else None)
+        self.dec_layernorm_embedding = (LayerNorm(d, dtype=cfg.dtype)
+                                        if cfg.add_embedding_norm else None)
         self.encoder_layers_m = [BartEncoderLayer(cfg)
                                  for _ in range(cfg.encoder_layers)]
         self.decoder_layers_m = [BartDecoderLayer(cfg)
@@ -130,8 +135,10 @@ class BartForConditionalGeneration(Module):
         scale = (self.cfg.d_model ** 0.5 if self.cfg.scale_embedding
                  else 1.0)
         s = ids.shape[1]
+        off = self.cfg.position_offset
         x = jnp.take(self.shared, ids, axis=0) * scale
-        return norm(x + pos_table[2: s + 2][None])
+        x = x + pos_table[off: s + off][None]
+        return norm(x) if norm is not None else x
 
     def encode(self, input_ids, attention_mask=None):
         mask = None
@@ -191,4 +198,31 @@ class MBartConfig(BartConfig):
 
 
 class MBartForConditionalGeneration(BartForConditionalGeneration):
+    pass
+
+
+@dataclass
+class PegasusConfig(BartConfig):
+    """Pegasus shape (ref: PaddleNLP ``pegasus``): pre-LN layers, final
+    LNs, STATIC sinusoidal positions at offset 0, sqrt(d)-scaled
+    embeddings, NO embedding LayerNorm."""
+    vocab_size: int = 96103
+    scale_embedding: bool = True
+    normalize_before: bool = True
+    add_final_layer_norm: bool = True
+    position_offset: int = 0
+    add_embedding_norm: bool = False
+
+    @staticmethod
+    def tiny(**kw):
+        return PegasusConfig(**{**dict(vocab_size=128, d_model=32,
+                                       encoder_layers=2, decoder_layers=2,
+                                       encoder_attention_heads=4,
+                                       decoder_attention_heads=4,
+                                       encoder_ffn_dim=64,
+                                       decoder_ffn_dim=64,
+                                       max_position_embeddings=64), **kw})
+
+
+class PegasusForConditionalGeneration(BartForConditionalGeneration):
     pass

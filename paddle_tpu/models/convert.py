@@ -627,8 +627,9 @@ def load_bart_state_dict(model, state_dict, dtype=None):
         model.final_logits_bias = j(sd["final_logits_bias"].reshape(-1))
     model.enc_positions = j(sd["encoder.embed_positions.weight"])
     model.dec_positions = j(sd["decoder.embed_positions.weight"])
-    ln(model.enc_layernorm_embedding, "encoder.layernorm_embedding")
-    ln(model.dec_layernorm_embedding, "decoder.layernorm_embedding")
+    if model.enc_layernorm_embedding is not None:
+        ln(model.enc_layernorm_embedding, "encoder.layernorm_embedding")
+        ln(model.dec_layernorm_embedding, "decoder.layernorm_embedding")
     if model.enc_final_norm is not None:        # mBART final LNs
         ln(model.enc_final_norm, "encoder.layer_norm")
         ln(model.dec_final_norm, "decoder.layer_norm")
@@ -954,4 +955,43 @@ def load_codegen_state_dict(model, state_dict, dtype=None):
         blk.fc_in_bias = j(sd[p + "mlp.fc_in.bias"])
         blk.fc_out = j(sd[p + "mlp.fc_out.weight"].T)
         blk.fc_out_bias = j(sd[p + "mlp.fc_out.bias"])
+    return model
+
+
+def load_ernie_m_state_dict(model, state_dict, dtype=None):
+    """Populate an ``ErnieMModel`` from an HF state_dict
+    (``ernie_m.*`` / bare naming)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("ernie_m."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    em = model.ernie_m if hasattr(model, "ernie_m") else model
+    em.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    em.position_embeddings.weight = j(
+        sd["embeddings.position_embeddings.weight"])
+    ln(em.emb_norm, "embeddings.layer_norm")
+    for i, lyr in enumerate(em.layers):
+        p = f"encoder.layers.{i}."
+        a = lyr.self_attn
+        lin(a.q_proj, p + "self_attn.self_attn.q_proj")
+        lin(a.k_proj, p + "self_attn.self_attn.k_proj")
+        lin(a.v_proj, p + "self_attn.self_attn.v_proj")
+        lin(a.out_proj, p + "self_attn.out_proj")
+        lin(lyr.linear1, p + "linear1")
+        lin(lyr.linear2, p + "linear2")
+        ln(lyr.norm1, p + "norm1")
+        ln(lyr.norm2, p + "norm2")
+    if "pooler.dense.weight" in sd:
+        lin(em.pooler, "pooler.dense")
     return model

@@ -1,0 +1,102 @@
+"""ERNIE-M (ref: PaddleNLP ``paddlenlp/transformers/ernie_m/modeling.py``
+— Baidu's multilingual ERNIE, cross-lingual aligned pretraining).
+
+Post-LN encoder with the ERNIE-M embedding quirk: NO token-type stream,
+and positions offset by +2 (the PaddleNLP convention the HF port
+mimics). Same MultiHeadAttention blocks as the rest of the encoder zoo.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+from paddle_tpu.nn.transformer import MultiHeadAttention
+
+
+@dataclass
+class ErnieMConfig:
+    vocab_size: int = 250002
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 514
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return ErnieMConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                      num_hidden_layers=2,
+                                      num_attention_heads=2,
+                                      intermediate_size=64,
+                                      max_position_embeddings=66), **kw})
+
+
+class ErnieMLayer(Module):
+    def __init__(self, cfg: ErnieMConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.self_attn = MultiHeadAttention(h, cfg.num_attention_heads,
+                                            dtype=cfg.dtype)
+        self.norm1 = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                               dtype=cfg.dtype)
+        self.linear1 = Linear(h, cfg.intermediate_size, dtype=cfg.dtype)
+        self.linear2 = Linear(cfg.intermediate_size, h, dtype=cfg.dtype)
+        self.norm2 = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                               dtype=cfg.dtype)
+
+    def __call__(self, x, attn_mask=None):
+        x = self.norm1(x + self.self_attn(x, attn_mask=attn_mask))
+        return self.norm2(x + self.linear2(F.gelu(self.linear1(x))))
+
+
+class ErnieMModel(Module):
+    def __init__(self, cfg: ErnieMConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, h,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.layers = [ErnieMLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.pooler = Linear(h, h, dtype=cfg.dtype)
+
+    def __call__(self, input_ids, attention_mask=None):
+        s = input_ids.shape[1]
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        # the PaddleNLP +2 position offset (no token-type stream)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(jnp.arange(2, s + 2)[None, :]))
+        x = self.emb_norm(x)
+        for lyr in self.layers:
+            x = lyr(x, attn_mask=attention_mask)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieMForSequenceClassification(Module):
+    def __init__(self, cfg: ErnieMConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie_m = ErnieMModel(cfg)
+        self.classifier = Linear(cfg.hidden_size, num_classes,
+                                 dtype=cfg.dtype)
+
+    def __call__(self, input_ids, attention_mask=None):
+        _, pooled = self.ernie_m(input_ids, attention_mask)
+        return self.classifier(pooled)

@@ -911,3 +911,67 @@ def test_codegen_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_ernie_m_hidden_states_match_transformers():
+    """ERNIE-M (multilingual ERNIE: +2 position offset, no token types,
+    post-LN): hidden states match HF."""
+    import torch
+    from transformers import ErnieMConfig as HFConfig
+    from transformers import ErnieMModel as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=66,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)).eval()
+
+    from paddle_tpu.models.convert import load_ernie_m_state_dict
+    from paddle_tpu.models.ernie_m import ErnieMConfig, ErnieMModel
+
+    pt.seed(0)
+    cfg = ErnieMConfig.tiny(vocab_size=96)
+    ours = load_ernie_m_state_dict(ErnieMModel(cfg).eval(),
+                                   hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(2, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).last_hidden_state.numpy()
+    seq, _ = ours(jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(seq, np.float32), ref,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pegasus_logits_match_transformers():
+    """Pegasus (pre-LN, static sinusoidal positions, no embedding LN):
+    logits match HF through the shared BART classes."""
+    import torch
+    from transformers import PegasusConfig as HFConfig
+    from transformers import PegasusForConditionalGeneration as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                          decoder_layers=2, encoder_attention_heads=4,
+                          decoder_attention_heads=4, encoder_ffn_dim=64,
+                          decoder_ffn_dim=64, max_position_embeddings=64,
+                          scale_embedding=True, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.bart import (PegasusConfig,
+                                        PegasusForConditionalGeneration)
+    from paddle_tpu.models.convert import load_bart_state_dict
+
+    pt.seed(0)
+    cfg = PegasusConfig.tiny(vocab_size=96)
+    ours = load_bart_state_dict(
+        PegasusForConditionalGeneration(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(0)
+    src = rs.randint(2, 96, (2, 10))
+    tgt = rs.randint(2, 96, (2, 7))
+    with torch.no_grad():
+        ref = hf(torch.tensor(src),
+                 decoder_input_ids=torch.tensor(tgt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
