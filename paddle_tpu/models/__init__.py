@@ -12,6 +12,9 @@ from paddle_tpu.models.bart import (BartConfig,
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
 from paddle_tpu.models.deberta import (DebertaV2Config,
                                        DebertaV2ForMaskedLM, DebertaV2Model)
+from paddle_tpu.models.distilbert import (DistilBertConfig,
+                                          DistilBertForMaskedLM,
+                                          DistilBertModel)
 from paddle_tpu.models.electra import (ElectraConfig, ElectraForPreTraining,
                                        ElectraModel)
 from paddle_tpu.models.bart import (PegasusConfig,

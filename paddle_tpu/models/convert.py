@@ -995,3 +995,48 @@ def load_ernie_m_state_dict(model, state_dict, dtype=None):
     if "pooler.dense.weight" in sd:
         lin(em.pooler, "pooler.dense")
     return model
+
+
+def load_distilbert_state_dict(model, state_dict, dtype=None):
+    """Populate a ``DistilBertForMaskedLM``/``DistilBertModel`` from an
+    HF state_dict (``distilbert.*`` naming; projector tied)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("distilbert."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    db = model.distilbert if hasattr(model, "distilbert") else model
+    db.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    db.position_embeddings.weight = j(
+        sd["embeddings.position_embeddings.weight"])
+    ln(db.emb_norm, "embeddings.LayerNorm")
+    for i, lyr in enumerate(db.layers):
+        p = f"transformer.layer.{i}."
+        a = lyr.attention
+        lin(a.q_proj, p + "attention.q_lin")
+        lin(a.k_proj, p + "attention.k_lin")
+        lin(a.v_proj, p + "attention.v_lin")
+        lin(a.out_proj, p + "attention.out_lin")
+        ln(lyr.sa_layer_norm, p + "sa_layer_norm")
+        lin(lyr.lin1, p + "ffn.lin1")
+        lin(lyr.lin2, p + "ffn.lin2")
+        ln(lyr.output_layer_norm, p + "output_layer_norm")
+    if hasattr(model, "vocab_transform") and \
+            "vocab_transform.weight" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.vocab_transform.weight = j(sp["vocab_transform.weight"].T)
+        model.vocab_transform.bias = j(sp["vocab_transform.bias"])
+        model.vocab_norm.weight = j(sp["vocab_layer_norm.weight"])
+        model.vocab_norm.bias = j(sp["vocab_layer_norm.bias"])
+        model.vocab_bias = j(sp["vocab_projector.bias"])
+    return model

@@ -1,0 +1,96 @@
+"""DistilBERT (ref: PaddleNLP ``paddlenlp/transformers/distilbert``).
+
+The distilled 6-layer BERT shape: no token-type stream, no pooler,
+post-LN blocks, MLM head = transform + LN + tied projector.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+from paddle_tpu.nn.transformer import MultiHeadAttention
+
+
+@dataclass
+class DistilBertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 6
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_position_embeddings: int = 512
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return DistilBertConfig(**{**dict(vocab_size=128, dim=32,
+                                          n_layers=2, n_heads=2,
+                                          hidden_dim=64,
+                                          max_position_embeddings=64),
+                                   **kw})
+
+
+class DistilBertLayer(Module):
+    def __init__(self, cfg: DistilBertConfig):
+        super().__init__()
+        self.attention = MultiHeadAttention(cfg.dim, cfg.n_heads,
+                                            dtype=cfg.dtype)
+        self.sa_layer_norm = LayerNorm(cfg.dim, epsilon=1e-12,
+                                       dtype=cfg.dtype)
+        self.lin1 = Linear(cfg.dim, cfg.hidden_dim, dtype=cfg.dtype)
+        self.lin2 = Linear(cfg.hidden_dim, cfg.dim, dtype=cfg.dtype)
+        self.output_layer_norm = LayerNorm(cfg.dim, epsilon=1e-12,
+                                           dtype=cfg.dtype)
+
+    def __call__(self, x, attn_mask=None):
+        x = self.sa_layer_norm(x + self.attention(x, attn_mask=attn_mask))
+        return self.output_layer_norm(
+            x + self.lin2(F.gelu(self.lin1(x))))
+
+
+class DistilBertModel(Module):
+    def __init__(self, cfg: DistilBertConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.dim,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.dim, weight_init=init,
+                                             dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(cfg.dim, epsilon=1e-12, dtype=cfg.dtype)
+        self.layers = [DistilBertLayer(cfg) for _ in range(cfg.n_layers)]
+
+    def __call__(self, input_ids, attention_mask=None):
+        s = input_ids.shape[1]
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        x = self.emb_norm(self.word_embeddings(input_ids)
+                          + self.position_embeddings(
+                              jnp.arange(s)[None, :]))
+        for lyr in self.layers:
+            x = lyr(x, attn_mask=attention_mask)
+        return x
+
+
+class DistilBertForMaskedLM(Module):
+    def __init__(self, cfg: DistilBertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.distilbert = DistilBertModel(cfg)
+        self.vocab_transform = Linear(cfg.dim, cfg.dim, dtype=cfg.dtype)
+        self.vocab_norm = LayerNorm(cfg.dim, epsilon=1e-12, dtype=cfg.dtype)
+        self.vocab_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, attention_mask=None):
+        seq = self.distilbert(input_ids, attention_mask)
+        h = self.vocab_norm(F.gelu(self.vocab_transform(seq)))
+        return h @ self.distilbert.word_embeddings.weight.T + self.vocab_bias

@@ -975,3 +975,32 @@ def test_pegasus_logits_match_transformers():
                  decoder_input_ids=torch.tensor(tgt)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_distilbert_mlm_logits_match_transformers():
+    """DistilBERT (no token types, no pooler, tied projector): MLM
+    logits match HF."""
+    import torch
+    from transformers import DistilBertConfig as HFConfig
+    from transformers import DistilBertForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, dim=32, n_layers=2, n_heads=2,
+                          hidden_dim=64, max_position_embeddings=64,
+                          dropout=0.0, attention_dropout=0.0,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_distilbert_state_dict
+    from paddle_tpu.models.distilbert import (DistilBertConfig,
+                                              DistilBertForMaskedLM)
+
+    pt.seed(0)
+    cfg = DistilBertConfig.tiny(vocab_size=96)
+    ours = load_distilbert_state_dict(DistilBertForMaskedLM(cfg).eval(),
+                                      hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
