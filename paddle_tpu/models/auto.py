@@ -36,6 +36,7 @@ def _registry():
     from paddle_tpu.models import ernie_m
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
     from paddle_tpu.models import mixtral, opt, qwen, qwen2_moe, roberta, t5
+    from paddle_tpu.models import xlnet
     from paddle_tpu.models import convert as C
 
     return {
@@ -103,6 +104,8 @@ def _registry():
                           C.load_codegen_state_dict),
         "t5": _Entry(t5.T5Config, t5.T5ForConditionalGeneration,
                      C.load_t5_state_dict),
+        "xlnet": _Entry(xlnet.XLNetConfig, xlnet.XLNetLMHeadModel,
+                        C.load_xlnet_state_dict),
     }
 
 

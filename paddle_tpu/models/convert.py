@@ -1040,3 +1040,35 @@ def load_distilbert_state_dict(model, state_dict, dtype=None):
         model.vocab_norm.bias = j(sp["vocab_layer_norm.bias"])
         model.vocab_bias = j(sp["vocab_projector.bias"])
     return model
+
+
+def load_xlnet_state_dict(model, state_dict, dtype=None):
+    """Populate an ``XLNetLMHeadModel``/``XLNetModel`` from an HF
+    state_dict (q/k/v/o/r are [d_model, n_head, d_head] tensors, not
+    linears; lm_loss is tied + biased)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    xl = model.transformer if hasattr(model, "transformer") else model
+    xl.word_embedding.weight = j(sd["word_embedding.weight"])
+    for i, lyr in enumerate(xl.layers):
+        p = f"layer.{i}."
+        a = lyr.rel_attn
+        for name in ("q", "k", "v", "o", "r", "r_w_bias", "r_r_bias",
+                     "r_s_bias", "seg_embed"):
+            setattr(a, name, j(sd[p + f"rel_attn.{name}"]))
+        a.layer_norm.weight = j(sd[p + "rel_attn.layer_norm.weight"])
+        a.layer_norm.bias = j(sd[p + "rel_attn.layer_norm.bias"])
+        lyr.layer_1.weight = j(sd[p + "ff.layer_1.weight"].T)
+        lyr.layer_1.bias = j(sd[p + "ff.layer_1.bias"])
+        lyr.layer_2.weight = j(sd[p + "ff.layer_2.weight"].T)
+        lyr.layer_2.bias = j(sd[p + "ff.layer_2.bias"])
+        lyr.ff_norm.weight = j(sd[p + "ff.layer_norm.weight"])
+        lyr.ff_norm.bias = j(sd[p + "ff.layer_norm.bias"])
+    if hasattr(model, "lm_bias") and "lm_loss.bias" in state_dict:
+        model.lm_bias = j(_np(state_dict["lm_loss.bias"]))
+    return model

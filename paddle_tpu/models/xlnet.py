@@ -1,0 +1,155 @@
+"""XLNet (ref: PaddleNLP ``paddlenlp/transformers/xlnet/modeling.py``).
+
+The Transformer-XL-relative-attention member of the zoo: attention
+scores are content-content plus a position term computed against a
+sinusoidal RELATIVE position encoding (with the rel-shift trick aligning
+each query row's distances), each with its own learned bias vector
+(r_w_bias / r_r_bias), plus an optional segment term (r_s_bias +
+seg_embed). This implements the standard single-(content-)stream forward
+— what ``XLNetLMHeadModel`` computes without ``perm_mask``/``mems`` —
+which is bidirectional (attn_type="bi"); the two-stream permutation-LM
+machinery is a pretraining-only device.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+
+
+@dataclass
+class XLNetConfig:
+    vocab_size: int = 32000
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    d_inner: int = 3072
+    layer_norm_eps: float = 1e-12
+    clamp_len: int = -1
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @property
+    def d_head(self):
+        return self.d_model // self.n_head
+
+    @staticmethod
+    def tiny(**kw):
+        return XLNetConfig(**{**dict(vocab_size=128, d_model=32, n_layer=2,
+                                     n_head=4, d_inner=64), **kw})
+
+
+def _rel_shift(x, klen):
+    """Transformer-XL's relative-shift: [B, N, Q, Q+K] position scores
+    realigned so column j of row i holds distance i - j + ..."""
+    b, n, i, j = x.shape
+    x = x.reshape(b, n, j, i)[:, :, 1:, :].reshape(b, n, i, j - 1)
+    return x[:, :, :, :klen]
+
+
+class XLNetRelativeAttention(Module):
+    def __init__(self, cfg: XLNetConfig):
+        super().__init__()
+        d, n, dh = cfg.d_model, cfg.n_head, cfg.d_head
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.q = init((d, n, dh), cfg.dtype)
+        self.k = init((d, n, dh), cfg.dtype)
+        self.v = init((d, n, dh), cfg.dtype)
+        self.o = init((d, n, dh), cfg.dtype)
+        self.r = init((d, n, dh), cfg.dtype)
+        self.r_w_bias = jnp.zeros((n, dh), cfg.dtype)
+        self.r_r_bias = jnp.zeros((n, dh), cfg.dtype)
+        self.r_s_bias = jnp.zeros((n, dh), cfg.dtype)
+        self.seg_embed = init((2, n, dh), cfg.dtype)
+        self.layer_norm = LayerNorm(d, epsilon=cfg.layer_norm_eps,
+                                    dtype=cfg.dtype)
+        self.scale = 1.0 / (cfg.d_head ** 0.5)
+
+    def __call__(self, h, pos_emb, seg_mat=None):
+        # h: [B, S, D]; pos_emb: [P, D] (P = 2S for attn_type="bi")
+        s = h.shape[1]
+        qh = jnp.einsum("bsd,dnh->bsnh", h, self.q)
+        kh = jnp.einsum("bsd,dnh->bsnh", h, self.k)
+        vh = jnp.einsum("bsd,dnh->bsnh", h, self.v)
+        kr = jnp.einsum("pd,dnh->pnh", pos_emb, self.r)
+
+        ac = jnp.einsum("binh,bjnh->bnij", qh + self.r_w_bias, kh)
+        bd = jnp.einsum("binh,pnh->bnip", qh + self.r_r_bias, kr)
+        bd = _rel_shift(bd, klen=s)
+        score = ac + bd
+        if seg_mat is not None:
+            ef = jnp.einsum("binh,snh->bins", qh + self.r_s_bias,
+                            self.seg_embed)
+            score = score + jnp.einsum("bijs,bins->bnij", seg_mat, ef)
+        probs = jax.nn.softmax((score * self.scale).astype(jnp.float32),
+                               axis=-1).astype(h.dtype)
+        vec = jnp.einsum("bnij,bjnh->binh", probs, vh)
+        out = jnp.einsum("binh,dnh->bid", vec, self.o)
+        return self.layer_norm(h + out)
+
+
+class XLNetLayer(Module):
+    def __init__(self, cfg: XLNetConfig):
+        super().__init__()
+        self.rel_attn = XLNetRelativeAttention(cfg)
+        self.layer_1 = Linear(cfg.d_model, cfg.d_inner, dtype=cfg.dtype)
+        self.layer_2 = Linear(cfg.d_inner, cfg.d_model, dtype=cfg.dtype)
+        self.ff_norm = LayerNorm(cfg.d_model, epsilon=cfg.layer_norm_eps,
+                                 dtype=cfg.dtype)
+
+    def __call__(self, h, pos_emb, seg_mat=None):
+        h = self.rel_attn(h, pos_emb, seg_mat)
+        return self.ff_norm(h + self.layer_2(F.gelu(self.layer_1(h))))
+
+
+class XLNetModel(Module):
+    def __init__(self, cfg: XLNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embedding = Embedding(cfg.vocab_size, cfg.d_model,
+                                        weight_init=init, dtype=cfg.dtype)
+        self.layers = [XLNetLayer(cfg) for _ in range(cfg.n_layer)]
+
+    def _pos_emb(self, s):
+        cfg = self.cfg
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, cfg.d_model, 2.0)
+                                 / cfg.d_model))
+        pos = jnp.arange(s, -s, -1.0)            # attn_type="bi": [S, -S)
+        if cfg.clamp_len > 0:
+            pos = jnp.clip(pos, -cfg.clamp_len, cfg.clamp_len)
+        ang = jnp.outer(pos, inv)
+        return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)],
+                               axis=-1).astype(cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos_emb = self._pos_emb(s)
+        seg_mat = None
+        if token_type_ids is not None:
+            # HF convention: one_hot(tt_i != tt_j) — class 0 = same segment
+            diff = (token_type_ids[:, :, None]
+                    != token_type_ids[:, None, :]).astype(jnp.int32)
+            seg_mat = jax.nn.one_hot(diff, 2, dtype=self.cfg.dtype)
+        x = self.word_embedding(input_ids)
+        for lyr in self.layers:
+            x = lyr(x, pos_emb, seg_mat)
+        return x
+
+
+class XLNetLMHeadModel(Module):
+    def __init__(self, cfg: XLNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.transformer = XLNetModel(cfg)
+        self.lm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None):
+        h = self.transformer(input_ids, token_type_ids)
+        return h @ self.transformer.word_embedding.weight.T + self.lm_bias

@@ -1004,3 +1004,37 @@ def test_distilbert_mlm_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_xlnet_logits_match_transformers():
+    """XLNet (Transformer-XL relative attention with rel-shift, learned
+    r_w/r_r/r_s biases, segment term): single-stream logits match HF,
+    with and without token types."""
+    import torch
+    from transformers import XLNetConfig as HFConfig
+    from transformers import XLNetLMHeadModel as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, n_layer=2, n_head=4,
+                          d_inner=64, ff_activation="gelu",
+                          use_mems_eval=False, dropout=0.0)).eval()
+
+    from paddle_tpu.models.convert import load_xlnet_state_dict
+    from paddle_tpu.models.xlnet import XLNetConfig, XLNetLMHeadModel
+
+    pt.seed(0)
+    cfg = XLNetConfig.tiny(vocab_size=96)
+    ours = load_xlnet_state_dict(XLNetLMHeadModel(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+        ref_tt = hf(torch.tensor(ids),
+                    token_type_ids=torch.tensor(tt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    got_tt = np.asarray(ours(jnp.asarray(ids),
+                             token_type_ids=jnp.asarray(tt)), np.float32)
+    np.testing.assert_allclose(got_tt, ref_tt, rtol=2e-4, atol=2e-4)
