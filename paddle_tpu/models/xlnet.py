@@ -30,14 +30,19 @@ class XLNetConfig:
     n_layer: int = 12
     n_head: int = 12
     d_inner: int = 3072
+    d_head: int = None                   # default d_model // n_head
+    ff_activation: str = "gelu"
     layer_norm_eps: float = 1e-12
     clamp_len: int = -1
     initializer_range: float = 0.02
     dtype: object = jnp.float32
 
-    @property
-    def d_head(self):
-        return self.d_model // self.n_head
+    def __post_init__(self):
+        if self.d_head is None:
+            self.d_head = self.d_model // self.n_head
+        if self.ff_activation not in ("gelu", "relu"):
+            raise ValueError(f"ff_activation {self.ff_activation!r} not "
+                             "supported (gelu | relu)")
 
     @staticmethod
     def tiny(**kw):
@@ -71,8 +76,9 @@ class XLNetRelativeAttention(Module):
                                     dtype=cfg.dtype)
         self.scale = 1.0 / (cfg.d_head ** 0.5)
 
-    def __call__(self, h, pos_emb, seg_mat=None):
-        # h: [B, S, D]; pos_emb: [P, D] (P = 2S for attn_type="bi")
+    def __call__(self, h, pos_emb, seg_mat=None, key_mask=None):
+        # h: [B, S, D]; pos_emb: [P, D] (P = 2S for attn_type="bi");
+        # key_mask: [B, S] bool, True = real token (pad keys masked out)
         s = h.shape[1]
         qh = jnp.einsum("bsd,dnh->bsnh", h, self.q)
         kh = jnp.einsum("bsd,dnh->bsnh", h, self.k)
@@ -87,7 +93,11 @@ class XLNetRelativeAttention(Module):
             ef = jnp.einsum("binh,snh->bins", qh + self.r_s_bias,
                             self.seg_embed)
             score = score + jnp.einsum("bijs,bins->bnij", seg_mat, ef)
-        probs = jax.nn.softmax((score * self.scale).astype(jnp.float32),
+        score = score * self.scale
+        if key_mask is not None:         # HF: attn_score - 1e30 * mask
+            score = score - 1e30 * (~key_mask[:, None, None, :]).astype(
+                jnp.float32)
+        probs = jax.nn.softmax(score.astype(jnp.float32),
                                axis=-1).astype(h.dtype)
         vec = jnp.einsum("bnij,bjnh->binh", probs, vh)
         out = jnp.einsum("binh,dnh->bid", vec, self.o)
@@ -102,10 +112,11 @@ class XLNetLayer(Module):
         self.layer_2 = Linear(cfg.d_inner, cfg.d_model, dtype=cfg.dtype)
         self.ff_norm = LayerNorm(cfg.d_model, epsilon=cfg.layer_norm_eps,
                                  dtype=cfg.dtype)
+        self.act = F.gelu if cfg.ff_activation == "gelu" else F.relu
 
-    def __call__(self, h, pos_emb, seg_mat=None):
-        h = self.rel_attn(h, pos_emb, seg_mat)
-        return self.ff_norm(h + self.layer_2(F.gelu(self.layer_1(h))))
+    def __call__(self, h, pos_emb, seg_mat=None, key_mask=None):
+        h = self.rel_attn(h, pos_emb, seg_mat, key_mask)
+        return self.ff_norm(h + self.layer_2(self.act(self.layer_1(h))))
 
 
 class XLNetModel(Module):
@@ -128,7 +139,8 @@ class XLNetModel(Module):
         return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)],
                                axis=-1).astype(cfg.dtype)
 
-    def __call__(self, input_ids, token_type_ids=None):
+    def __call__(self, input_ids, token_type_ids=None,
+                 attention_mask=None):
         s = input_ids.shape[1]
         pos_emb = self._pos_emb(s)
         seg_mat = None
@@ -137,9 +149,11 @@ class XLNetModel(Module):
             diff = (token_type_ids[:, :, None]
                     != token_type_ids[:, None, :]).astype(jnp.int32)
             seg_mat = jax.nn.one_hot(diff, 2, dtype=self.cfg.dtype)
+        key_mask = (attention_mask.astype(bool)
+                    if attention_mask is not None else None)
         x = self.word_embedding(input_ids)
         for lyr in self.layers:
-            x = lyr(x, pos_emb, seg_mat)
+            x = lyr(x, pos_emb, seg_mat, key_mask)
         return x
 
 
@@ -150,6 +164,7 @@ class XLNetLMHeadModel(Module):
         self.transformer = XLNetModel(cfg)
         self.lm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
 
-    def __call__(self, input_ids, token_type_ids=None):
-        h = self.transformer(input_ids, token_type_ids)
+    def __call__(self, input_ids, token_type_ids=None,
+                 attention_mask=None):
+        h = self.transformer(input_ids, token_type_ids, attention_mask)
         return h @ self.transformer.word_embedding.weight.T + self.lm_bias

@@ -1038,3 +1038,16 @@ def test_xlnet_logits_match_transformers():
     got_tt = np.asarray(ours(jnp.asarray(ids),
                              token_type_ids=jnp.asarray(tt)), np.float32)
     np.testing.assert_allclose(got_tt, ref_tt, rtol=2e-4, atol=2e-4)
+
+    # padded batch: pad keys are masked out (real-token rows match HF)
+    mask = np.ones((2, 12), np.int64)
+    mask[1, 9:] = 0
+    with torch.no_grad():
+        ref_m = hf(torch.tensor(ids),
+                   attention_mask=torch.tensor(mask)).logits.numpy()
+    got_m = np.asarray(ours(jnp.asarray(ids),
+                            attention_mask=jnp.asarray(mask)), np.float32)
+    valid = mask[:, :, None].astype(bool)
+    np.testing.assert_allclose(np.where(valid, got_m, 0),
+                               np.where(valid, ref_m, 0),
+                               rtol=2e-4, atol=2e-4)
