@@ -10,6 +10,8 @@ from paddle_tpu.models.bart import (BartConfig,
                                     MBartConfig,
                                     MBartForConditionalGeneration)
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
+from paddle_tpu.models.clip import (CLIPConfig, CLIPModel, CLIPTextModel,
+                                    CLIPVisionModel)
 from paddle_tpu.models.deberta import (DebertaV2Config,
                                        DebertaV2ForMaskedLM, DebertaV2Model)
 from paddle_tpu.models.distilbert import (DistilBertConfig,

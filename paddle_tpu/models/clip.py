@@ -1,0 +1,219 @@
+"""CLIP (ref: PaddleNLP ``paddlenlp/transformers/clip`` / PaddleMIX —
+contrastive image-text pretraining).
+
+Dual-tower contrastive model: a ViT-style vision tower (patch conv +
+class token + learned positions, pre-LN, post-LN pooled class token) and
+a CAUSAL text tower (quick-gelu MLPs, pooled at the EOS position), each
+projected into the shared embedding space; similarity logits scale by a
+learned temperature. HF ``CLIPModel`` is the parity reference.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    eos_token_id: int = 49407
+
+
+@dataclass
+class CLIPVisionConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-5
+
+
+@dataclass
+class CLIPConfig:
+    text_config: CLIPTextConfig = None
+    vision_config: CLIPVisionConfig = None
+    projection_dim: int = 512
+    logit_scale_init_value: float = 2.6592
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    def __post_init__(self):
+        if self.text_config is None:
+            self.text_config = CLIPTextConfig()
+        if self.vision_config is None:
+            self.vision_config = CLIPVisionConfig()
+
+    @staticmethod
+    def tiny(**kw):
+        return CLIPConfig(**{**dict(
+            text_config=CLIPTextConfig(vocab_size=96, hidden_size=32,
+                                       intermediate_size=64,
+                                       num_hidden_layers=2,
+                                       num_attention_heads=4,
+                                       max_position_embeddings=16,
+                                       eos_token_id=1),
+            vision_config=CLIPVisionConfig(hidden_size=32,
+                                           intermediate_size=64,
+                                           num_hidden_layers=2,
+                                           num_attention_heads=4,
+                                           image_size=32, patch_size=8),
+            projection_dim=16), **kw})
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class CLIPEncoderLayer(Module):
+    """Pre-LN block with quick-gelu MLP, shared by both towers."""
+
+    def __init__(self, h, inter, heads, eps, dtype):
+        super().__init__()
+        self.layer_norm1 = LayerNorm(h, epsilon=eps, dtype=dtype)
+        self.q_proj = Linear(h, h, dtype=dtype)
+        self.k_proj = Linear(h, h, dtype=dtype)
+        self.v_proj = Linear(h, h, dtype=dtype)
+        self.out_proj = Linear(h, h, dtype=dtype)
+        self.layer_norm2 = LayerNorm(h, epsilon=eps, dtype=dtype)
+        self.fc1 = Linear(h, inter, dtype=dtype)
+        self.fc2 = Linear(inter, h, dtype=dtype)
+        self.heads = heads
+
+    def __call__(self, x, causal=False):
+        b, s, hd = x.shape
+        nh = self.heads
+        d = hd // nh
+        h = self.layer_norm1(x)
+        q = self.q_proj(h).reshape(b, s, nh, d)
+        k = self.k_proj(h).reshape(b, s, nh, d)
+        v = self.v_proj(h).reshape(b, s, nh, d)
+        att = A.scaled_dot_product_attention(q, k, v, is_causal=causal)
+        x = x + self.out_proj(att.reshape(b, s, hd))
+        return x + self.fc2(_quick_gelu(self.fc1(self.layer_norm2(x))))
+
+
+class CLIPTextModel(Module):
+    def __init__(self, cfg: CLIPConfig):
+        super().__init__()
+        t = cfg.text_config
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.token_embedding = Embedding(t.vocab_size, t.hidden_size,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embedding = Embedding(t.max_position_embeddings,
+                                            t.hidden_size,
+                                            weight_init=init,
+                                            dtype=cfg.dtype)
+        self.layers = [CLIPEncoderLayer(t.hidden_size, t.intermediate_size,
+                                        t.num_attention_heads,
+                                        t.layer_norm_eps, cfg.dtype)
+                       for _ in range(t.num_hidden_layers)]
+        self.final_layer_norm = LayerNorm(t.hidden_size,
+                                          epsilon=t.layer_norm_eps,
+                                          dtype=cfg.dtype)
+        self.eos_token_id = t.eos_token_id
+
+    def __call__(self, input_ids):
+        s = input_ids.shape[1]
+        x = (self.token_embedding(input_ids)
+             + self.position_embedding(jnp.arange(s)[None, :]))
+        for lyr in self.layers:
+            x = lyr(x, causal=True)           # CLIP text is CAUSAL
+        x = self.final_layer_norm(x)
+        # pooled feature = hidden state at the (first) EOS position
+        eos_pos = jnp.argmax(
+            (input_ids == self.eos_token_id).astype(jnp.int32), axis=1)
+        pooled = x[jnp.arange(x.shape[0]), eos_pos]
+        return x, pooled
+
+
+class CLIPVisionModel(Module):
+    def __init__(self, cfg: CLIPConfig):
+        super().__init__()
+        v = cfg.vision_config
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = v.hidden_size
+        self.patch_embedding = init(
+            (v.patch_size, v.patch_size, v.num_channels, h), cfg.dtype)
+        self.class_embedding = init((h,), cfg.dtype)
+        n_patches = (v.image_size // v.patch_size) ** 2
+        self.position_embedding = Embedding(n_patches + 1, h,
+                                            weight_init=init,
+                                            dtype=cfg.dtype)
+        self.pre_layrnorm = LayerNorm(h, epsilon=v.layer_norm_eps,
+                                      dtype=cfg.dtype)
+        self.layers = [CLIPEncoderLayer(h, v.intermediate_size,
+                                        v.num_attention_heads,
+                                        v.layer_norm_eps, cfg.dtype)
+                       for _ in range(v.num_hidden_layers)]
+        self.post_layernorm = LayerNorm(h, epsilon=v.layer_norm_eps,
+                                        dtype=cfg.dtype)
+        self.patch = v.patch_size
+
+    def __call__(self, pixel_values):
+        """pixel_values: [B, C, H, W] (the reference layout)."""
+        b = pixel_values.shape[0]
+        x = jnp.transpose(pixel_values, (0, 2, 3, 1))       # NHWC
+        x = jax.lax.conv_general_dilated(
+            x, self.patch_embedding, (self.patch, self.patch), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = x.reshape(b, -1, x.shape[-1])                   # [B, P, H]
+        cls = jnp.broadcast_to(self.class_embedding[None, None],
+                               (b, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        x = x + self.position_embedding(
+            jnp.arange(x.shape[1])[None, :])
+        x = self.pre_layrnorm(x)
+        for lyr in self.layers:
+            x = lyr(x)
+        pooled = self.post_layernorm(x[:, 0])
+        return x, pooled
+
+
+class CLIPModel(Module):
+    def __init__(self, cfg: CLIPConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.text_model = CLIPTextModel(cfg)
+        self.vision_model = CLIPVisionModel(cfg)
+        self.visual_projection = Linear(cfg.vision_config.hidden_size,
+                                        cfg.projection_dim,
+                                        bias_attr=False, dtype=cfg.dtype)
+        self.text_projection = Linear(cfg.text_config.hidden_size,
+                                      cfg.projection_dim,
+                                      bias_attr=False, dtype=cfg.dtype)
+        self.logit_scale = jnp.asarray(cfg.logit_scale_init_value,
+                                       cfg.dtype)
+
+    def get_text_features(self, input_ids):
+        _, pooled = self.text_model(input_ids)
+        return self.text_projection(pooled)
+
+    def get_image_features(self, pixel_values):
+        _, pooled = self.vision_model(pixel_values)
+        return self.visual_projection(pooled)
+
+    def __call__(self, input_ids, pixel_values):
+        """Returns (logits_per_image, logits_per_text)."""
+        te = self.get_text_features(input_ids)
+        ie = self.get_image_features(pixel_values)
+        te = te / jnp.linalg.norm(te, axis=-1, keepdims=True)
+        ie = ie / jnp.linalg.norm(ie, axis=-1, keepdims=True)
+        scale = jnp.exp(self.logit_scale)
+        logits_per_text = (te @ ie.T) * scale
+        return logits_per_text.T, logits_per_text

@@ -1072,3 +1072,60 @@ def load_xlnet_state_dict(model, state_dict, dtype=None):
     if hasattr(model, "lm_bias") and "lm_loss.bias" in state_dict:
         model.lm_bias = j(_np(state_dict["lm_loss.bias"]))
     return model
+
+
+def load_clip_state_dict(model, state_dict, dtype=None):
+    """Populate a ``CLIPModel`` from an HF state_dict (both towers +
+    projections + logit_scale)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix, bias=True):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        if bias:
+            layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def tower(layers, prefix):
+        for i, lyr in enumerate(layers):
+            p = f"{prefix}.encoder.layers.{i}."
+            lin(lyr.q_proj, p + "self_attn.q_proj")
+            lin(lyr.k_proj, p + "self_attn.k_proj")
+            lin(lyr.v_proj, p + "self_attn.v_proj")
+            lin(lyr.out_proj, p + "self_attn.out_proj")
+            ln(lyr.layer_norm1, p + "layer_norm1")
+            ln(lyr.layer_norm2, p + "layer_norm2")
+            lin(lyr.fc1, p + "mlp.fc1")
+            lin(lyr.fc2, p + "mlp.fc2")
+
+    tm = model.text_model
+    tm.token_embedding.weight = j(
+        sd["text_model.embeddings.token_embedding.weight"])
+    tm.position_embedding.weight = j(
+        sd["text_model.embeddings.position_embedding.weight"])
+    tower(tm.layers, "text_model")
+    ln(tm.final_layer_norm, "text_model.final_layer_norm")
+
+    vm = model.vision_model
+    vm.class_embedding = j(sd["vision_model.embeddings.class_embedding"])
+    # [h, c, p, p] -> HWIO [p, p, c, h]
+    vm.patch_embedding = j(np.transpose(
+        sd["vision_model.embeddings.patch_embedding.weight"],
+        (2, 3, 1, 0)))
+    vm.position_embedding.weight = j(
+        sd["vision_model.embeddings.position_embedding.weight"])
+    ln(vm.pre_layrnorm, "vision_model.pre_layrnorm")
+    tower(vm.layers, "vision_model")
+    ln(vm.post_layernorm, "vision_model.post_layernorm")
+
+    lin(model.visual_projection, "visual_projection", bias=False)
+    lin(model.text_projection, "text_projection", bias=False)
+    model.logit_scale = j(sd["logit_scale"])
+    return model

@@ -1051,3 +1051,46 @@ def test_xlnet_logits_match_transformers():
     np.testing.assert_allclose(np.where(valid, got_m, 0),
                                np.where(valid, ref_m, 0),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_clip_logits_match_transformers():
+    """CLIP (causal quick-gelu text tower pooled at EOS + ViT tower,
+    learned-temperature contrastive logits): matches HF CLIPModel."""
+    import torch
+    from transformers import CLIPConfig as HFConfig
+    from transformers import CLIPModel as HFModel
+    from transformers import CLIPTextConfig as HFText
+    from transformers import CLIPVisionConfig as HFVision
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig.from_text_vision_configs(
+        HFText(vocab_size=96, hidden_size=32, intermediate_size=64,
+               num_hidden_layers=2, num_attention_heads=4,
+               max_position_embeddings=16, eos_token_id=1,
+               attn_implementation="eager"),
+        HFVision(hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, image_size=32, patch_size=8,
+                 attn_implementation="eager"),
+        projection_dim=16)).eval()
+
+    from paddle_tpu.models.clip import CLIPConfig, CLIPModel
+    from paddle_tpu.models.convert import load_clip_state_dict
+
+    pt.seed(0)
+    ours = load_clip_state_dict(CLIPModel(CLIPConfig.tiny()).eval(),
+                                hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(3, 96, (3, 12))
+    ids[:, -1] = 1                         # EOS-terminated prompts
+    ids[1, 7] = 1                          # one early EOS (pooling pos)
+    px = rs.randn(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids),
+                 pixel_values=torch.tensor(px))
+    li, lt = ours(jnp.asarray(ids), jnp.asarray(px))
+    np.testing.assert_allclose(np.asarray(li, np.float32),
+                               out.logits_per_image.numpy(),
+                               rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(lt, np.float32),
+                               out.logits_per_text.numpy(),
+                               rtol=3e-4, atol=3e-4)
