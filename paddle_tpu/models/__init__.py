@@ -38,6 +38,8 @@ from paddle_tpu.models.gptj import (CodeGenConfig, CodeGenForCausalLM,
                                     GPTJConfig, GPTJForCausalLM)
 from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
 from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
+from paddle_tpu.models.whisper import (WhisperConfig,
+                                       WhisperForConditionalGeneration)
 from paddle_tpu.models.xlnet import (XLNetConfig, XLNetLMHeadModel,
                                      XLNetModel)
 from paddle_tpu.models.opt import OPTConfig, OPTForCausalLM

@@ -1129,3 +1129,58 @@ def load_clip_state_dict(model, state_dict, dtype=None):
     lin(model.text_projection, "text_projection", bias=False)
     model.logit_scale = j(sd["logit_scale"])
     return model
+
+
+def load_whisper_state_dict(model, state_dict, dtype=None):
+    """Populate a ``WhisperForConditionalGeneration`` from an HF
+    state_dict (k_proj's missing bias loads as zeros; proj_out tied)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("model."): _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix, bias=True):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        if bias and prefix + ".bias" in sd:
+            layer.bias = j(sd[prefix + ".bias"])
+        elif layer.bias is not None:
+            layer.bias = jnp.zeros_like(layer.bias)
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def attn(a, prefix):
+        for name in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            lin(getattr(a, name), f"{prefix}.{name}")
+
+    # encoder conv: torch [out, in, k] -> WIO [k, in, out]
+    model.conv1 = j(np.transpose(sd["encoder.conv1.weight"], (2, 1, 0)))
+    model.conv1_bias = j(sd["encoder.conv1.bias"])
+    model.conv2 = j(np.transpose(sd["encoder.conv2.weight"], (2, 1, 0)))
+    model.conv2_bias = j(sd["encoder.conv2.bias"])
+    model.enc_positions = j(sd["encoder.embed_positions.weight"])
+    ln(model.enc_final_norm, "encoder.layer_norm")
+    for i, lyr in enumerate(model.encoder_layers_m):
+        p = f"encoder.layers.{i}."
+        attn(lyr.self_attn, p + "self_attn")
+        ln(lyr.self_attn_layer_norm, p + "self_attn_layer_norm")
+        lin(lyr.fc1, p + "fc1")
+        lin(lyr.fc2, p + "fc2")
+        ln(lyr.final_layer_norm, p + "final_layer_norm")
+
+    model.embed_tokens = j(sd["decoder.embed_tokens.weight"])
+    model.dec_positions = j(sd["decoder.embed_positions.weight"])
+    ln(model.dec_final_norm, "decoder.layer_norm")
+    for i, lyr in enumerate(model.decoder_layers_m):
+        p = f"decoder.layers.{i}."
+        attn(lyr.self_attn, p + "self_attn")
+        ln(lyr.self_attn_layer_norm, p + "self_attn_layer_norm")
+        attn(lyr.encoder_attn, p + "encoder_attn")
+        ln(lyr.encoder_attn_layer_norm, p + "encoder_attn_layer_norm")
+        lin(lyr.fc1, p + "fc1")
+        lin(lyr.fc2, p + "fc2")
+        ln(lyr.final_layer_norm, p + "final_layer_norm")
+    return model

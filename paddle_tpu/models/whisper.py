@@ -1,0 +1,143 @@
+"""Whisper (ref: PaddleSpeech/PaddleNLP ``whisper`` — speech-to-text
+seq2seq over log-mel spectrograms).
+
+Encoder: two gelu Conv1Ds (the second stride-2) over the [B, mels, T]
+input, fixed sinusoidal positions (stored as weights), pre-LN blocks,
+final LN. Decoder: learned positions, pre-LN blocks with cross-attention
+over the audio memory, final LN, head tied to the token embeddings.
+Whisper's attention quirk — k_proj has no bias — loads as a zero bias.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm, Linear
+from paddle_tpu.nn.transformer import MultiHeadAttention
+
+
+@dataclass
+class WhisperConfig:
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    d_model: int = 384
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    encoder_attention_heads: int = 6
+    decoder_attention_heads: int = 6
+    encoder_ffn_dim: int = 1536
+    decoder_ffn_dim: int = 1536
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return WhisperConfig(**{**dict(vocab_size=96, num_mel_bins=8,
+                                       d_model=32, encoder_layers=2,
+                                       decoder_layers=2,
+                                       encoder_attention_heads=4,
+                                       decoder_attention_heads=4,
+                                       encoder_ffn_dim=64,
+                                       decoder_ffn_dim=64,
+                                       max_source_positions=16,
+                                       max_target_positions=32), **kw})
+
+
+class WhisperEncoderLayer(Module):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        d = cfg.d_model
+        self.self_attn = MultiHeadAttention(d, cfg.encoder_attention_heads,
+                                            dtype=cfg.dtype)
+        self.self_attn_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.fc1 = Linear(d, cfg.encoder_ffn_dim, dtype=cfg.dtype)
+        self.fc2 = Linear(cfg.encoder_ffn_dim, d, dtype=cfg.dtype)
+        self.final_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+
+    def __call__(self, x):
+        x = x + self.self_attn(self.self_attn_layer_norm(x))
+        return x + self.fc2(F.gelu(self.fc1(self.final_layer_norm(x))))
+
+
+class WhisperDecoderLayer(Module):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        d = cfg.d_model
+        self.self_attn = MultiHeadAttention(d, cfg.decoder_attention_heads,
+                                            dtype=cfg.dtype)
+        self.self_attn_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.encoder_attn = MultiHeadAttention(d,
+                                               cfg.decoder_attention_heads,
+                                               dtype=cfg.dtype)
+        self.encoder_attn_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+        self.fc1 = Linear(d, cfg.decoder_ffn_dim, dtype=cfg.dtype)
+        self.fc2 = Linear(cfg.decoder_ffn_dim, d, dtype=cfg.dtype)
+        self.final_layer_norm = LayerNorm(d, dtype=cfg.dtype)
+
+    def __call__(self, x, enc):
+        x = x + self.self_attn(self.self_attn_layer_norm(x), is_causal=True)
+        x = x + self.encoder_attn(self.encoder_attn_layer_norm(x), enc, enc)
+        return x + self.fc2(F.gelu(self.fc1(self.final_layer_norm(x))))
+
+
+class WhisperForConditionalGeneration(Module):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        d = cfg.d_model
+        # encoder conv front-end: [k, in, out] (NWC/WIO)
+        self.conv1 = init((3, cfg.num_mel_bins, d), cfg.dtype)
+        self.conv1_bias = jnp.zeros((d,), cfg.dtype)
+        self.conv2 = init((3, d, d), cfg.dtype)
+        self.conv2_bias = jnp.zeros((d,), cfg.dtype)
+        self.enc_positions = init((cfg.max_source_positions, d), cfg.dtype)
+        self.encoder_layers_m = [WhisperEncoderLayer(cfg)
+                                 for _ in range(cfg.encoder_layers)]
+        self.enc_final_norm = LayerNorm(d, dtype=cfg.dtype)
+
+        self.embed_tokens = init((cfg.vocab_size, d), cfg.dtype)
+        self.dec_positions = init((cfg.max_target_positions, d), cfg.dtype)
+        self.decoder_layers_m = [WhisperDecoderLayer(cfg)
+                                 for _ in range(cfg.decoder_layers)]
+        self.dec_final_norm = LayerNorm(d, dtype=cfg.dtype)
+
+    def encode(self, input_features):
+        """input_features: [B, mels, T] (the reference layout)."""
+        x = jnp.transpose(input_features, (0, 2, 1))        # NWC
+        x = jax.lax.conv_general_dilated(
+            x, self.conv1, (1,), [(1, 1)],
+            dimension_numbers=("NWC", "WIO", "NWC")) + self.conv1_bias
+        x = jax.nn.gelu(x)
+        x = jax.lax.conv_general_dilated(
+            x, self.conv2, (2,), [(1, 1)],
+            dimension_numbers=("NWC", "WIO", "NWC")) + self.conv2_bias
+        x = jax.nn.gelu(x)
+        x = x + self.enc_positions[: x.shape[1]][None]
+        for lyr in self.encoder_layers_m:
+            x = lyr(x)
+        return self.enc_final_norm(x)
+
+    def __call__(self, input_features, decoder_input_ids):
+        enc = self.encode(input_features)
+        s = decoder_input_ids.shape[1]
+        x = (jnp.take(self.embed_tokens, decoder_input_ids, axis=0)
+             + self.dec_positions[:s][None])
+        for lyr in self.decoder_layers_m:
+            x = lyr(x, enc)
+        x = self.dec_final_norm(x)
+        return x @ self.embed_tokens.T       # proj_out tied
+
+    def loss(self, input_features, decoder_input_ids, labels):
+        logits = self(input_features, decoder_input_ids).astype(jnp.float32)
+        ce = F.cross_entropy(logits, jnp.maximum(labels, 0),
+                             reduction="none")
+        mask = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1094,3 +1094,40 @@ def test_clip_logits_match_transformers():
     np.testing.assert_allclose(np.asarray(lt, np.float32),
                                out.logits_per_text.numpy(),
                                rtol=3e-4, atol=3e-4)
+
+
+def test_whisper_logits_match_transformers():
+    """Whisper (conv front-end over mels, sinusoidal encoder positions,
+    pre-LN seq2seq, tied proj_out): logits match HF."""
+    import torch
+    from transformers import WhisperConfig as HFConfig
+    from transformers import WhisperForConditionalGeneration as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, num_mel_bins=8, d_model=32,
+                          encoder_layers=2, decoder_layers=2,
+                          encoder_attention_heads=4,
+                          decoder_attention_heads=4, encoder_ffn_dim=64,
+                          decoder_ffn_dim=64, max_source_positions=16,
+                          max_target_positions=32, use_cache=False,
+                          pad_token_id=0, bos_token_id=1, eos_token_id=2,
+                          decoder_start_token_id=1, suppress_tokens=None,
+                          begin_suppress_tokens=None,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_whisper_state_dict
+    from paddle_tpu.models.whisper import (WhisperConfig,
+                                           WhisperForConditionalGeneration)
+
+    pt.seed(0)
+    cfg = WhisperConfig.tiny(vocab_size=96)
+    ours = load_whisper_state_dict(
+        WhisperForConditionalGeneration(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(0)
+    mel = rs.randn(2, 8, 32).astype(np.float32)   # T=32 -> 16 frames
+    tgt = rs.randint(0, 96, (2, 7))
+    with torch.no_grad():
+        ref = hf(input_features=torch.tensor(mel),
+                 decoder_input_ids=torch.tensor(tgt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(mel), jnp.asarray(tgt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
