@@ -480,3 +480,43 @@ def generic_generate(model, input_ids, max_new_tokens=32, temperature=0.0,
         return state[1]
 
     return run(model, jnp.asarray(input_ids), rng)
+
+
+def generic_seq2seq_generate(model, encoder_inputs, max_new_tokens=20,
+                             decoder_start_token_id=0, eos_token_id=None,
+                             attention_mask=None):
+    """Greedy decode for ANY encoder-decoder whose
+    ``__call__(encoder_inputs, decoder_input_ids[, attention_mask])``
+    returns [B, L, vocab] logits — BART/mBART/Pegasus, Whisper, custom
+    (T5 ships its own encode-once ``generate``). Full re-forward per
+    step (causal decoder masking makes the zero-padded future inert);
+    one jitted fori_loop, fixed shapes. Returns [B, max_new_tokens]
+    (EOS-filled after a row finishes)."""
+    b = encoder_inputs.shape[0]
+
+    @jax.jit
+    def run(model, encoder_inputs, attention_mask):
+        tokens = jnp.full((b, max_new_tokens + 1), decoder_start_token_id,
+                          jnp.int32)
+
+        def fwd(dec):
+            if attention_mask is not None:
+                return model(encoder_inputs, dec, attention_mask)
+            return model(encoder_inputs, dec)
+
+        def body(i, state):
+            tokens, done = state
+            logits = fwd(tokens).astype(jnp.float32)
+            step = lax.dynamic_index_in_dim(logits, i, 1, keepdims=False)
+            nxt = jnp.argmax(step, axis=-1).astype(jnp.int32)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            tokens = tokens.at[:, i + 1].set(nxt)
+            return tokens, done
+
+        done = jnp.zeros((b,), bool)
+        tokens, _ = lax.fori_loop(0, max_new_tokens, body, (tokens, done))
+        return tokens[:, 1:]
+
+    return run(model, jnp.asarray(encoder_inputs), attention_mask)

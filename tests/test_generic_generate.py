@@ -73,3 +73,76 @@ def test_generic_generate_matches_hf_greedy(family):
     got = np.asarray(generic_generate(ours, jnp.asarray(ids),
                                       max_new_tokens=new))
     np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("family", ["bart", "whisper"])
+def test_generic_seq2seq_matches_hf_greedy(family):
+    import torch
+    from paddle_tpu.models.decoding import generic_seq2seq_generate
+
+    if family == "bart":
+        from transformers import BartConfig as HFConfig
+        from transformers import BartForConditionalGeneration as HFModel
+        from paddle_tpu.models.bart import (BartConfig,
+                                            BartForConditionalGeneration)
+        from paddle_tpu.models.convert import load_bart_state_dict
+        torch.manual_seed(0)
+        hf = HFModel(HFConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                              decoder_layers=2, encoder_attention_heads=4,
+                              decoder_attention_heads=4,
+                              encoder_ffn_dim=64, decoder_ffn_dim=64,
+                              max_position_embeddings=64, pad_token_id=1,
+                              use_cache=False,
+                              attn_implementation="eager")).eval()
+        pt.seed(0)
+        ours = load_bart_state_dict(
+            BartForConditionalGeneration(BartConfig.tiny(vocab_size=96)),
+            hf.state_dict())
+        rs = np.random.RandomState(0)
+        enc_in = rs.randint(2, 96, (2, 9))
+        enc_t = torch.tensor(enc_in)
+
+        def hf_step(dec):
+            return hf(enc_t, decoder_input_ids=dec).logits
+    else:
+        from transformers import WhisperConfig as HFConfig
+        from transformers import WhisperForConditionalGeneration as HFModel
+        from paddle_tpu.models.convert import load_whisper_state_dict
+        from paddle_tpu.models.whisper import (
+            WhisperConfig, WhisperForConditionalGeneration)
+        torch.manual_seed(0)
+        hf = HFModel(HFConfig(vocab_size=96, num_mel_bins=8, d_model=32,
+                              encoder_layers=2, decoder_layers=2,
+                              encoder_attention_heads=4,
+                              decoder_attention_heads=4,
+                              encoder_ffn_dim=64, decoder_ffn_dim=64,
+                              max_source_positions=16,
+                              max_target_positions=32, use_cache=False,
+                              pad_token_id=0, bos_token_id=1,
+                              eos_token_id=2, decoder_start_token_id=1,
+                              suppress_tokens=None,
+                              begin_suppress_tokens=None,
+                              attn_implementation="eager")).eval()
+        pt.seed(0)
+        ours = load_whisper_state_dict(
+            WhisperForConditionalGeneration(
+                WhisperConfig.tiny(vocab_size=96)), hf.state_dict())
+        rs = np.random.RandomState(0)
+        enc_in = rs.randn(2, 8, 32).astype(np.float32)
+        enc_t = torch.tensor(enc_in)
+
+        def hf_step(dec):
+            return hf(input_features=enc_t, decoder_input_ids=dec).logits
+
+    new, start = 6, 1
+    # manual HF greedy loop (no forced-token machinery)
+    dec = torch.full((2, 1), start, dtype=torch.long)
+    with torch.no_grad():
+        for _ in range(new):
+            nxt = hf_step(dec)[:, -1].argmax(-1, keepdim=True)
+            dec = torch.cat([dec, nxt], dim=1)
+    ref = dec[:, 1:].numpy()
+    got = np.asarray(generic_seq2seq_generate(
+        ours, jnp.asarray(enc_in), max_new_tokens=new,
+        decoder_start_token_id=start))
+    np.testing.assert_array_equal(got, ref)
