@@ -36,6 +36,8 @@ from paddle_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXForCausalLM
 from paddle_tpu.models.glm import GlmConfig, GlmForCausalLM
 from paddle_tpu.models.gptj import (CodeGenConfig, CodeGenForCausalLM,
                                     GPTJConfig, GPTJForCausalLM)
+from paddle_tpu.models.layoutlm import (LayoutLMConfig,
+                                        LayoutLMForMaskedLM, LayoutLMModel)
 from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
 from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
 from paddle_tpu.models.whisper import (WhisperConfig,

@@ -31,7 +31,7 @@ class _Entry:
 
 
 def _registry():
-    from paddle_tpu.models import albert, deberta, distilbert
+    from paddle_tpu.models import albert, deberta, distilbert, layoutlm
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
     from paddle_tpu.models import ernie_m
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
@@ -48,6 +48,9 @@ def _registry():
         "distilbert": _Entry(distilbert.DistilBertConfig,
                              distilbert.DistilBertForMaskedLM,
                              C.load_distilbert_state_dict),
+        "layoutlm": _Entry(layoutlm.LayoutLMConfig,
+                           layoutlm.LayoutLMForMaskedLM,
+                           C.load_layoutlm_state_dict),
         "glm": _Entry(glm.GlmConfig, glm.GlmForCausalLM,
                       C.load_glm_state_dict),
         "mixtral": _Entry(mixtral.MixtralConfig, mixtral.MixtralForCausalLM,

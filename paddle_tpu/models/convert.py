@@ -1184,3 +1184,61 @@ def load_whisper_state_dict(model, state_dict, dtype=None):
         lin(lyr.fc2, p + "fc2")
         ln(lyr.final_layer_norm, p + "final_layer_norm")
     return model
+
+
+def load_layoutlm_state_dict(model, state_dict, dtype=None):
+    """Populate a ``LayoutLMForMaskedLM``/``LayoutLMModel`` from an HF
+    state_dict (BERT encoder + the six 2-D layout tables)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("layoutlm."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    lm = model.layoutlm if hasattr(model, "layoutlm") else model
+    emb = "embeddings."
+    lm.word_embeddings.weight = j(sd[emb + "word_embeddings.weight"])
+    lm.position_embeddings.weight = j(
+        sd[emb + "position_embeddings.weight"])
+    for name in ("x_position_embeddings", "y_position_embeddings",
+                 "h_position_embeddings", "w_position_embeddings",
+                 "token_type_embeddings"):
+        getattr(lm, name).weight = j(sd[emb + name + ".weight"])
+    lm.emb_norm.weight = j(sd[emb + "LayerNorm.weight"])
+    lm.emb_norm.bias = j(sd[emb + "LayerNorm.bias"])
+    # encoder blocks are BERT's layout
+    remapped = {"bert." + k: v for k, v in sd.items()
+                if k.startswith("encoder.") or k.startswith("pooler.")}
+    # load_bert_state_dict also wants embeddings keys; give it ours
+    for k, v in sd.items():
+        if k.startswith("embeddings.word") or \
+                k.startswith("embeddings.position_embeddings") or \
+                k.startswith("embeddings.token_type") or \
+                k.startswith("embeddings.LayerNorm"):
+            remapped["bert." + k] = v
+
+    class _Shim:
+        class bert:
+            embeddings = type("E", (), {})()
+            layers = lm.layers
+            pooler = lm.pooler
+    # reuse only the per-layer loop: temporary emb holder with .weight attrs
+    e = _Shim.bert.embeddings
+    for name in ("word_embeddings", "position_embeddings",
+                 "token_type_embeddings", "layer_norm"):
+        setattr(e, name, type("W", (), {"weight": None, "bias": None})())
+    load_bert_state_dict(_Shim(), remapped, dtype=dtype)
+    if hasattr(model, "mlm_transform") and "cls.predictions.bias" in \
+            state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.mlm_transform.weight = j(
+            sp["cls.predictions.transform.dense.weight"].T)
+        model.mlm_transform.bias = j(
+            sp["cls.predictions.transform.dense.bias"])
+        model.mlm_norm.weight = j(
+            sp["cls.predictions.transform.LayerNorm.weight"])
+        model.mlm_norm.bias = j(
+            sp["cls.predictions.transform.LayerNorm.bias"])
+        model.mlm_bias = j(sp["cls.predictions.bias"])
+    return model

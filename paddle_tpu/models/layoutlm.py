@@ -1,0 +1,113 @@
+"""LayoutLM (ref: PaddleNLP ``paddlenlp/transformers/layoutlm`` — the
+document-AI encoder behind the PaddleOCR/ERNIE-Layout ecosystem).
+
+BERT encoder + 2-D LAYOUT embeddings: each token carries its bounding
+box (x0, y0, x1, y1 on a 0..1023 grid) and the embedding sum adds
+x/y position tables for all four coordinates plus width/height... (v1
+uses the four corner tables; the HF reference is ``LayoutLMModel``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.bert import BertConfig, BertLayer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
+
+
+@dataclass
+class LayoutLMConfig(BertConfig):
+    vocab_size: int = 30522
+    max_2d_position_embeddings: int = 1024
+
+    @staticmethod
+    def tiny(**kw):
+        return LayoutLMConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                        num_hidden_layers=2,
+                                        num_attention_heads=2,
+                                        intermediate_size=64,
+                                        max_position_embeddings=64,
+                                        max_2d_position_embeddings=128),
+                                 **kw})
+
+
+class LayoutLMModel(Module):
+    def __init__(self, cfg: LayoutLMConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, h,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        p2 = cfg.max_2d_position_embeddings
+        self.x_position_embeddings = Embedding(p2, h, weight_init=init,
+                                               dtype=cfg.dtype)
+        self.y_position_embeddings = Embedding(p2, h, weight_init=init,
+                                               dtype=cfg.dtype)
+        self.h_position_embeddings = Embedding(p2, h, weight_init=init,
+                                               dtype=cfg.dtype)
+        self.w_position_embeddings = Embedding(p2, h, weight_init=init,
+                                               dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, h,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.layers = [BertLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.pooler = Linear(h, h, dtype=cfg.dtype)
+
+    def __call__(self, input_ids, bbox, token_type_ids=None,
+                 attention_mask=None, rng=None):
+        """bbox: [B, S, 4] int (x0, y0, x1, y1) on the 2-D grid."""
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(jnp.arange(s)[None, :])
+             + self.x_position_embeddings(bbox[..., 0])
+             + self.y_position_embeddings(bbox[..., 1])
+             + self.x_position_embeddings(bbox[..., 2])
+             + self.y_position_embeddings(bbox[..., 3])
+             + self.h_position_embeddings(bbox[..., 3] - bbox[..., 1])
+             + self.w_position_embeddings(bbox[..., 2] - bbox[..., 0])
+             + self.token_type_embeddings(token_type_ids))
+        x = self.dropout(self.emb_norm(x), rng=rng)
+        for i, lyr in enumerate(self.layers):
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = lyr(x, attn_mask=attention_mask, rng=sub)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class LayoutLMForMaskedLM(Module):
+    def __init__(self, cfg: LayoutLMConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.layoutlm = LayoutLMModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    dtype=cfg.dtype)
+        self.mlm_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.mlm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, bbox, token_type_ids=None,
+                 attention_mask=None):
+        seq, _ = self.layoutlm(input_ids, bbox, token_type_ids,
+                               attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        return (h @ self.layoutlm.word_embeddings.weight.T
+                + self.mlm_bias)

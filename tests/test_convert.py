@@ -1131,3 +1131,37 @@ def test_whisper_logits_match_transformers():
                  decoder_input_ids=torch.tensor(tgt)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(mel), jnp.asarray(tgt)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_layoutlm_mlm_logits_match_transformers():
+    """LayoutLM (BERT + 2-D bounding-box embeddings): MLM logits match
+    HF given token boxes."""
+    import torch
+    from transformers import LayoutLMConfig as HFConfig
+    from transformers import LayoutLMForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=64,
+                          max_2d_position_embeddings=128,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_layoutlm_state_dict
+    from paddle_tpu.models.layoutlm import (LayoutLMConfig,
+                                            LayoutLMForMaskedLM)
+
+    pt.seed(0)
+    cfg = LayoutLMConfig.tiny(vocab_size=96)
+    ours = load_layoutlm_state_dict(LayoutLMForMaskedLM(cfg).eval(),
+                                    hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 10))
+    x0 = rs.randint(0, 60, (2, 10)); y0 = rs.randint(0, 60, (2, 10))
+    bbox = np.stack([x0, y0, x0 + rs.randint(1, 60, (2, 10)),
+                     y0 + rs.randint(1, 60, (2, 10))], axis=-1)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids), bbox=torch.tensor(bbox)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids), jnp.asarray(bbox)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
