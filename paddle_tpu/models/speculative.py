@@ -350,3 +350,116 @@ def speculative_generate_batched(target, draft, input_ids, prompt_lens=None,
              "accepted": accepted_total,
              "acceptance_rate": accepted_total / max(proposed_total, 1)}
     return jnp.asarray(out), stats
+
+
+def speculative_sample(target, draft, input_ids, max_new_tokens: int = 32,
+                       gamma: int = 4, temperature: float = 1.0,
+                       eos_token_id=None, seed: int = 0):
+    """STOCHASTIC speculative decoding (the original speculative-sampling
+    acceptance rule; ref: the serving predictor's sampling decode with a
+    draft model). The draft proposes gamma tokens BY SAMPLING from its own
+    distribution q; the target verifies the chunk once and accepts token
+    x_i with probability ``min(1, p_i(x_i) / q_i(x_i))``; the first
+    rejection resamples from the residual ``norm(max(0, p_i - q_i))``.
+    The emitted token stream is distributed EXACTLY as sampling from the
+    target alone (Leviathan et al. / Chen et al.) — verified
+    statistically in tests.
+
+    input_ids: [1, S]. Returns (tokens [1, S + max_new_tokens], stats).
+    ``temperature`` scales BOTH models' logits (0 falls back to the
+    lossless greedy path)."""
+    if temperature == 0.0:
+        return speculative_generate(target, draft, input_ids,
+                                    max_new_tokens=max_new_tokens,
+                                    gamma=gamma, eos_token_id=eos_token_id)
+    t_cfg, d_cfg = target.cfg, draft.cfg
+    if input_ids.shape[0] != 1:
+        raise ValueError("speculative_sample is single-sequence (B == 1)")
+    rs = np.random.RandomState(seed)
+    prompt_len = input_ids.shape[1]
+    max_len = prompt_len + max_new_tokens + gamma + 2
+
+    def make_cache(cfg):
+        return KVCache.init(cfg.num_hidden_layers, 1, max_len,
+                            cfg.num_key_value_heads,
+                            cfg.hidden_size // cfg.num_attention_heads,
+                            cfg.dtype)
+
+    fwd = jax.jit(llama_forward_with_cache, static_argnums=())
+
+    def probs(logits):
+        return np.asarray(jax.nn.softmax(
+            logits.astype(jnp.float32) / temperature, axis=-1)).reshape(-1)
+
+    cache_t, cache_d = make_cache(t_cfg), make_cache(d_cfg)
+    ids = jnp.asarray(input_ids)
+    logits_t, cache_t = fwd(target, ids, cache_t, 0)
+    _, cache_d = fwd(draft, ids, cache_d, 0)
+
+    committed: list[int] = []
+    p0 = probs(logits_t[:, -1])
+    c = int(rs.choice(p0.size, p=p0))
+    committed.append(c)
+    pos = prompt_len
+    draft_pos = prompt_len
+    rounds = accepted_total = 0
+
+    def done():
+        return (len(committed) >= max_new_tokens
+                or (eos_token_id is not None and eos_token_id in committed))
+
+    while not done():
+        rounds += 1
+        pending = committed[draft_pos - prompt_len:]
+        dl, cache_d = fwd(draft, jnp.asarray([pending], jnp.int32),
+                          cache_d, draft_pos)
+        draft_pos += len(pending)
+        props, qs = [], []
+        q = probs(dl[:, -1])
+        for _ in range(gamma):
+            x = int(rs.choice(q.size, p=q))
+            props.append(x)
+            qs.append(q)
+            dl, cache_d = fwd(draft, jnp.asarray([[x]], jnp.int32),
+                              cache_d, draft_pos)
+            draft_pos += 1
+            q = probs(dl[:, -1])
+
+        chunk_t = jnp.asarray([[c] + props], jnp.int32)
+        tl, cache_t = fwd(target, chunk_t, cache_t, pos)
+        ps = [probs(tl[:, i]) for i in range(gamma + 1)]
+
+        n_acc = 0
+        new: list[int] = []
+        for i, x in enumerate(props):
+            if rs.uniform() < min(1.0, ps[i][x] / max(qs[i][x], 1e-20)):
+                new.append(x)
+                n_acc += 1
+            else:
+                resid = np.maximum(ps[i] - qs[i], 0.0)
+                z = resid.sum()
+                resid = resid / z if z > 0 else ps[i]
+                new.append(int(rs.choice(resid.size, p=resid)))
+                break
+        else:
+            # every proposal accepted: bonus token from the target's
+            # distribution at the chunk end
+            new.append(int(rs.choice(ps[gamma].size, p=ps[gamma])))
+        committed.extend(new)
+        accepted_total += n_acc
+        pos += len(new)
+        c = committed[-1]
+        draft_pos = min(draft_pos, pos)
+
+    committed = committed[:max_new_tokens]
+    if eos_token_id is not None and eos_token_id in committed:
+        committed = committed[: committed.index(eos_token_id) + 1]
+    out = np.concatenate(
+        [np.asarray(ids)[0],
+         np.asarray(committed, np.asarray(ids).dtype),
+         np.zeros((max_new_tokens - len(committed),),
+                  np.asarray(ids).dtype)])
+    stats = {"rounds": rounds, "proposed": rounds * gamma,
+             "accepted": accepted_total,
+             "acceptance_rate": accepted_total / max(rounds * gamma, 1)}
+    return jnp.asarray(out[None]), stats
