@@ -661,3 +661,118 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
             if not active[sid]:
                 tokens[sid, int(cur[sid]) + 1:] = eos_token_id
     return jnp.asarray(tokens), cache
+
+
+def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
+                              cache: PagedKVCache, slot_ids, table_rows):
+    """CONTINUE a prefill: write chunk tokens at positions
+    ``offsets[a] .. offsets[a]+chunk_lens[a]-1`` of their slots and attend
+    each chunk query over the slot's WHOLE pool prefix (gather-based) —
+    the vLLM-style chunked prefill that lets prompts longer than the
+    prefill window stream in across engine ticks while other slots keep
+    decoding. Returns (last_logits, cache); ``last_logits`` at each row's
+    final chunk position (only meaningful on a request's last chunk).
+
+    input_ids [A, C] (zero-padded), chunk_lens [A], offsets [A] (tokens
+    already in the pool), slot_ids [A] (sentinel >= num_slots drops the
+    row), table_rows [A, max_blocks] CURRENT tables covering
+    offset+chunk. Dynamic-NTK rope is refused (chunk-end bases would
+    desync across chunks)."""
+    cfg = model.cfg
+    if (getattr(cfg, "rope_scaling", None) or {}).get("type") == "dynamic":
+        raise NotImplementedError(
+            "chunked prefill with dynamic-NTK rope is not supported "
+            "(per-chunk bases would desync from the one-shot prefill)")
+    a, c = input_ids.shape
+    nb, bs = cache.num_blocks, cache.block_size
+    chunk_lens = jnp.asarray(chunk_lens, jnp.int32)
+    offsets = jnp.asarray(offsets, jnp.int32)
+    slot_ids = jnp.asarray(slot_ids, jnp.int32)
+    tables = jnp.asarray(table_rows, jnp.int32)
+    new_tables = cache.block_tables.at[slot_ids].set(tables, mode="drop")
+    new_lens = cache.lens.at[slot_ids].set(offsets + chunk_lens,
+                                           mode="drop")
+    window = getattr(cfg, "sliding_window", None)
+
+    x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
+    d = cfg.hidden_size // cfg.num_attention_heads
+    positions = offsets[:, None] + jnp.arange(c, dtype=jnp.int32)  # [A, C]
+    base, pos_div = A.resolve_rope_scaling(
+        cfg.rope_theta, d, getattr(cfg, "rope_scaling", None),
+        allow_dynamic=False,
+        max_position_embeddings=getattr(cfg, "max_position_embeddings",
+                                        None))
+    inv = 1.0 / (jnp.asarray(base, jnp.float32)
+                 ** (jnp.arange(0, d, 2, jnp.float32) / d))
+    f = (positions.astype(jnp.float32) / pos_div)[:, :, None] * inv
+    cos, sin = jnp.cos(f)[:, :, None, :], jnp.sin(f)[:, :, None, :]
+
+    def rope(t):
+        d2 = t.shape[-1] // 2
+        t1, t2 = t[..., :d2], t[..., d2:]
+        return jnp.concatenate([t1 * cos - t2 * sin, t2 * cos + t1 * sin],
+                               axis=-1).astype(t.dtype)
+
+    max_blocks = tables.shape[1]
+    pool_pos = jnp.arange(max_blocks * bs)[None, None, :]   # [1, 1, MBbs]
+    q_pos = positions[:, :, None]                           # [A, C, 1]
+    keep = (pool_pos <= q_pos) & (pool_pos < new_lens[:, None, None])
+    if window is not None:
+        keep &= (q_pos - pool_pos) < window
+    mask = keep[:, None]                                    # [A,1,C,MBbs]
+    tbl = jnp.minimum(tables, nb - 1)
+
+    k_pools, v_pools = [], []
+    for li, lyr in enumerate(model.model.layers):
+        h = lyr.input_layernorm(x)
+        att = lyr.self_attn
+        qkv = _wo(h, att.qkv_proj)
+        if getattr(att, "qkv_bias", None) is not None:
+            qkv = qkv + att.qkv_bias
+        nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
+        q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = rope(q.reshape(a, c, nh, hd))
+        k = rope(k.reshape(a, c, nkv, hd))
+        v = v.reshape(a, c, nkv, hd)
+        # scatter the chunk FIRST so the gathered view holds prefix+chunk
+        k_pool = _scatter_decode_chunk(cache.k_pools[li], k, tables,
+                                       offsets, chunk_lens, nb, bs)
+        v_pool = _scatter_decode_chunk(cache.v_pools[li], v, tables,
+                                       offsets, chunk_lens, nb, bs)
+        k_pools.append(k_pool)
+        v_pools.append(v_pool)
+        kg = jnp.take(k_pool, tbl, axis=0).reshape(a, max_blocks * bs,
+                                                   nkv, hd)
+        vg = jnp.take(v_pool, tbl, axis=0).reshape(a, max_blocks * bs,
+                                                   nkv, hd)
+        out = A.xla_attention(q, kg, vg, attn_mask=mask)
+        x = x + _wo(out.reshape(a, c, nh * hd), att.o_proj)
+        x = x + lyr.mlp(lyr.post_attention_layernorm(x))
+    x = model.model.norm(x)
+    logits = model.logits(x)
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(chunk_lens - 1, 0)[:, None, None].astype(
+            jnp.int32), axis=1)[:, 0]
+    return last, PagedKVCache(k_pools, v_pools, new_tables, new_lens)
+
+
+def _scatter_decode_chunk(pool, vals, tables, offsets, chunk_lens, nb, bs):
+    """Scatter [A, C] chunk K/V at positions offset..offset+len-1 into the
+    pool via each row's table; padding (i >= chunk_lens) scatters OOB."""
+    a, c = vals.shape[:2]
+    pos = offsets[:, None] + jnp.arange(c)[None, :]          # [A, C]
+    blk_idx = pos // bs
+    blk = jnp.take_along_axis(tables, jnp.minimum(blk_idx,
+                                                  tables.shape[1] - 1),
+                              axis=1)
+    dest = blk * bs + pos % bs
+    dest = jnp.where(jnp.arange(c)[None, :] < chunk_lens[:, None],
+                     dest, nb * bs)                          # OOB drop
+    flat = pool.reshape(nb * bs, *pool.shape[2:])
+    flat = flat.at[dest.reshape(-1)].set(
+        vals.reshape(a * c, *vals.shape[2:]), mode="drop")
+    return flat.reshape(pool.shape)
+
+
+_PREFILL_CHUNK_JIT = jax.jit(llama_prefill_chunk_paged,
+                             donate_argnums=(4,))

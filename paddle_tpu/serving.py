@@ -33,8 +33,8 @@ import numpy as np
 from paddle_tpu.models.decoding import _sample_rows
 from paddle_tpu.models.paged import (PagedKVCache, RefBlockManager,
                                      _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
-                                     _BEAM_SELECT_JIT, _PREFILL_JIT,
-                                     _TICK_JIT)
+                                     _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
+                                     _PREFILL_JIT, _TICK_JIT)
 
 # module-level so its compile cache persists across admissions
 _SAMPLE_ROWS_JIT = jax.jit(_sample_rows, static_argnums=(4,))
@@ -141,6 +141,9 @@ class LLMEngine:
         self.is_beam = np.zeros(num_slots, bool)
         self.groups: dict[int, _BeamGroup] = {}
         self._sid_counter = 0        # unique fork keys: (req_id, counter)
+        # chunked prefill (prompts > max_prompt_len): rid -> (slot,
+        # tokens consumed); slots stay inactive until the last chunk
+        self.prefilling: dict[int, tuple] = {}
 
         self.queue: deque[Request] = deque()
         self.requests: dict[int, Request] = {}
@@ -176,9 +179,14 @@ class LLMEngine:
         if len(req.prompt) < 1:
             raise ValueError("prompt must contain at least one token "
                              "(an empty row has no logit to sample from)")
-        if len(req.prompt) > self.max_prompt_len:
+        if len(req.prompt) > self.max_prompt_len and req.num_beams > 1:
             raise ValueError(f"prompt length {len(req.prompt)} exceeds "
-                             f"max_prompt_len={self.max_prompt_len}")
+                             f"max_prompt_len={self.max_prompt_len} "
+                             "(chunked prefill does not combine with "
+                             "beam search)")
+        if len(req.prompt) > self.max_prompt_len and self.window is not None:
+            raise NotImplementedError(
+                "chunked prefill + sliding-window recycling not combined")
         if len(req.prompt) + req.max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         if self._worst_case_blocks(req) > self.mgr.num_blocks:
@@ -213,7 +221,7 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         return (bool(self.queue) or bool(self.active.any())
-                or bool(self.groups))
+                or bool(self.groups) or bool(self.prefilling))
 
     def _worst_case_blocks(self, req) -> int:
         """Blocks a request can ever hold at once. Windowed models recycle
@@ -256,6 +264,14 @@ class LLMEngine:
             self._resv[req.req_id] = 0
             if k == 1:
                 slot = int(free_slots.pop(0))
+                if len(req.prompt) > self.max_prompt_len:
+                    # chunked prefill: claim the slot INACTIVE; blocks
+                    # allocate chunk-by-chunk against the reservation
+                    self._reserved += need
+                    self._resv[req.req_id] = need
+                    self.slot_req[slot] = req.req_id
+                    self.prefilling[req.req_id] = (slot, 0)
+                    continue
                 self.mgr.allocate(req.req_id, len(req.prompt))
                 self._update_resv(req.req_id)
                 admits.append((slot, req))
@@ -496,6 +512,72 @@ class LLMEngine:
         del self.groups[rid]
         return [(rid, t) for t in req.tokens]
 
+    def _prefill_chunks(self):
+        """One chunk (≤ max_prompt_len tokens) for every in-flight
+        chunked prefill — vLLM-style: long prompts stream in across
+        ticks while other slots keep decoding. The final chunk samples
+        the request's first token and activates its slot."""
+        if not self.prefilling:
+            return []
+        a_cap = self.num_slots
+        cap = self.max_prompt_len
+        nb, max_b = self.mgr.num_blocks, self.max_blocks_per_seq
+        ids = np.zeros((a_cap, cap), np.int32)
+        lens = np.zeros(a_cap, np.int32)
+        offs = np.zeros(a_cap, np.int32)
+        slots = np.full(a_cap, self.num_slots, np.int32)
+        rows = np.full((a_cap, max_b), nb, np.int32)
+        batch = list(self.prefilling.items())[:a_cap]
+        for i, (rid, (slot, consumed)) in enumerate(batch):
+            req = self.requests[rid]
+            chunk = req.prompt[consumed: consumed + cap]
+            t = self.mgr.allocate(rid, consumed + len(chunk))
+            self._update_resv(rid)
+            ids[i, :len(chunk)] = chunk
+            lens[i] = len(chunk)
+            offs[i] = consumed
+            slots[i] = slot
+            rows[i, :len(t)] = t
+        logits, self.cache = _PREFILL_CHUNK_JIT(
+            self.model, jnp.asarray(ids), jnp.asarray(lens),
+            jnp.asarray(offs), self.cache, jnp.asarray(slots),
+            jnp.asarray(rows))
+        emitted = []
+        done_rows = []
+        for i, (rid, (slot, consumed)) in enumerate(batch):
+            req = self.requests[rid]
+            consumed += int(lens[i])
+            if consumed < len(req.prompt):
+                self.prefilling[rid] = (slot, consumed)
+                continue
+            done_rows.append((i, rid, slot))
+        if done_rows:
+            self.rng, sub = jax.random.split(self.rng)
+            row_t = np.zeros(a_cap, np.float32)
+            row_p = np.ones(a_cap, np.float32)
+            for i, rid, slot in done_rows:
+                req = self.requests[rid]
+                row_t[i] = (self.default_temp if req.temperature is None
+                            else req.temperature)
+                row_p[i] = (self.default_top_p if req.top_p is None
+                            else req.top_p)
+            first = np.asarray(_SAMPLE_ROWS_JIT(
+                logits.astype(jnp.float32), sub, jnp.asarray(row_t),
+                jnp.asarray(row_p), self.top_k))
+            for i, rid, slot in done_rows:
+                req = self.requests[rid]
+                del self.prefilling[rid]
+                t = self.mgr.tables[rid]
+                self.active[slot] = True
+                self.cur[slot] = len(req.prompt)
+                self.gen[slot] = 0
+                self.max_gen[slot] = req.max_new_tokens
+                self.table_len[slot] = len(t)
+                self.temps[slot] = row_t[i]
+                self.top_ps[slot] = row_p[i]
+                emitted += self._emit(slot, int(first[i]))
+        return emitted
+
     # ------------------------------------------------------------- decode
     def _grow_tables(self):
         """At most one new block per slot per tick; returns the incremental
@@ -551,6 +633,7 @@ class LLMEngine:
         admits, beam_admits = self._admit()
         if admits or beam_admits:
             emitted += self._prefill(admits, beam_admits)
+        emitted += self._prefill_chunks()
         if not self.active.any():
             return emitted
         t0 = perf_counter()
