@@ -683,6 +683,10 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         raise NotImplementedError(
             "chunked prefill with dynamic-NTK rope is not supported "
             "(per-chunk bases would desync from the one-shot prefill)")
+    if getattr(cfg, "fp8", False):
+        raise NotImplementedError(
+            "paged serving ignores the fp8 training path (see "
+            "llama_prefill_paged); serve with fp8=False weights")
     a, c = input_ids.shape
     nb, bs = cache.num_blocks, cache.block_size
     chunk_lens = jnp.asarray(chunk_lens, jnp.int32)
@@ -716,7 +720,10 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
     max_blocks = tables.shape[1]
     pool_pos = jnp.arange(max_blocks * bs)[None, None, :]   # [1, 1, MBbs]
     q_pos = positions[:, :, None]                           # [A, C, 1]
-    keep = (pool_pos <= q_pos) & (pool_pos < new_lens[:, None, None])
+    # per-ROW valid length (new_lens is per-SLOT — indexing it by batch
+    # row would borrow another sequence's length whenever row != slot)
+    row_lens = offsets + chunk_lens                         # [A]
+    keep = (pool_pos <= q_pos) & (pool_pos < row_lens[:, None, None])
     if window is not None:
         keep &= (q_pos - pool_pos) < window
     mask = keep[:, None]                                    # [A,1,C,MBbs]

@@ -187,6 +187,13 @@ class LLMEngine:
         if len(req.prompt) > self.max_prompt_len and self.window is not None:
             raise NotImplementedError(
                 "chunked prefill + sliding-window recycling not combined")
+        if len(req.prompt) > self.max_prompt_len and \
+                (getattr(self.model.cfg, "rope_scaling", None)
+                 or {}).get("type") == "dynamic":
+            # refuse HERE: a trace-time raise inside step() would leave
+            # the slot claimed and the request wedged in self.prefilling
+            raise NotImplementedError(
+                "chunked prefill with dynamic-NTK rope is not supported")
         if len(req.prompt) + req.max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         if self._worst_case_blocks(req) > self.mgr.num_blocks:

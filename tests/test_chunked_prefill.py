@@ -75,3 +75,58 @@ def test_beam_plus_long_prompt_refused(model):
                     max_seq_len=32)
     with pytest.raises(ValueError, match="chunked prefill"):
         eng.add_request(Request(np.arange(12), num_beams=2))
+
+
+def test_chunk_kernel_logits_equal_one_shot_prefill(model):
+    """Numeric (not just argmax) equivalence: chunk-prefilling a prompt
+    into slot 1 — batch ROW 0 targeting SLOT 1, the row != slot case —
+    yields the same final logits and pool contents as one-shot
+    prefilling the same prompt, while slot 0 holds a SHORTER sequence
+    whose lens must not bleed into the chunk mask."""
+    from paddle_tpu.models.paged import (PagedKVCache, RefBlockManager,
+                                         llama_prefill_chunk_paged,
+                                         llama_prefill_paged)
+    cfg = model.cfg
+    rs = np.random.RandomState(7)
+    short_p = rs.randint(0, 64, (5,))
+    long_p = rs.randint(0, 64, (14,))
+    bs, nb, mb, slots = 4, 16, 8, 2
+
+    def fresh():
+        return PagedKVCache.init(cfg.num_hidden_layers, nb, bs,
+                                 cfg.num_key_value_heads,
+                                 cfg.hidden_size // cfg.num_attention_heads,
+                                 slots, mb, cfg.dtype)
+
+    # reference: one-shot prefill of the long prompt alone
+    mgr_r = RefBlockManager(nb, bs)
+    t_ref = mgr_r.allocate("x", len(long_p))
+    rows_r = np.full((1, mb), nb, np.int32)
+    rows_r[0, :len(t_ref)] = t_ref
+    ref_logits, _ = llama_prefill_paged(
+        model, jnp.asarray(long_p[None]), jnp.asarray([len(long_p)]),
+        fresh(), jnp.asarray([0], jnp.int32), jnp.asarray(rows_r))
+
+    # engine-shaped: short seq occupies slot 0, long chunks into slot 1
+    mgr = RefBlockManager(nb, bs)
+    cache = fresh()
+    t0 = mgr.allocate("s", len(short_p))
+    rows0 = np.full((1, mb), nb, np.int32)
+    rows0[0, :len(t0)] = t0
+    _, cache = llama_prefill_paged(
+        model, jnp.asarray(short_p[None]), jnp.asarray([len(short_p)]),
+        cache, jnp.asarray([0], jnp.int32), jnp.asarray(rows0))
+    off = 0
+    for chunk in (long_p[:8], long_p[8:]):
+        t1 = mgr.allocate("l", off + len(chunk))
+        rows1 = np.full((1, mb), nb, np.int32)
+        rows1[0, :len(t1)] = t1
+        last, cache = llama_prefill_chunk_paged(
+            model, jnp.asarray(chunk[None]),
+            jnp.asarray([len(chunk)], jnp.int32),
+            jnp.asarray([off], jnp.int32), cache,
+            jnp.asarray([1], jnp.int32), jnp.asarray(rows1))
+        off += len(chunk)
+    np.testing.assert_allclose(np.asarray(last, np.float32),
+                               np.asarray(ref_logits, np.float32),
+                               rtol=2e-4, atol=2e-5)
