@@ -520,3 +520,67 @@ def generic_seq2seq_generate(model, encoder_inputs, max_new_tokens=20,
         return tokens[:, 1:]
 
     return run(model, jnp.asarray(encoder_inputs), attention_mask)
+
+
+def generic_seq2seq_beam_search(model, encoder_inputs, max_new_tokens=20,
+                                num_beams=4, decoder_start_token_id=0,
+                                eos_token_id=None, length_penalty=1.0,
+                                attention_mask=None):
+    """Beam search for ANY encoder-decoder ``__call__(enc, dec[, mask])``
+    family — the same ``beam_select`` math as the causal-LM and paged
+    beams, over full decoder re-forwards (beams ride a [B*K] leading dim;
+    one batched forward per step). Returns
+    (sequences [B, max_new_tokens], scores [B])."""
+    enc = jnp.asarray(encoder_inputs)
+    b = enc.shape[0]
+    K = num_beams
+    L = max_new_tokens + 1
+    enc_t = jnp.repeat(enc, K, axis=0)
+    mask_t = (None if attention_mask is None
+              else jnp.repeat(jnp.asarray(attention_mask), K, axis=0))
+
+    @jax.jit
+    def run(model, enc_t, mask_t):
+        NEG = jnp.float32(-1e9)
+        seqs = jnp.full((b, K, L), decoder_start_token_id, jnp.int32)
+        running_lp = jnp.broadcast_to(
+            jnp.asarray([0.0] + [NEG] * (K - 1)), (b, K)).astype(jnp.float32)
+        fin_seqs = jnp.zeros_like(seqs)
+        fin_scores = jnp.full((b, K), NEG)
+
+        def fwd(dec):
+            if mask_t is not None:
+                return model(enc_t, dec, mask_t)
+            return model(enc_t, dec)
+
+        def body(i, state):
+            running_lp, seqs, fin_seqs, fin_scores = state
+            logits = fwd(seqs.reshape(b * K, L)).astype(jnp.float32)
+            step = lax.dynamic_index_in_dim(logits, i, 1, keepdims=False)
+            logp = jax.nn.log_softmax(step, axis=-1).reshape(b, K, -1)
+            running_lp, seqs, fin_seqs, fin_scores, _, _ = beam_select(
+                running_lp, seqs, fin_seqs, fin_scores, logp, i, 1,
+                eos_token_id, length_penalty)
+            return running_lp, seqs, fin_seqs, fin_scores
+
+        state = (running_lp, seqs, fin_seqs, fin_scores)
+        running_lp, seqs, fin_seqs, fin_scores = lax.fori_loop(
+            0, max_new_tokens, body, state)
+
+        run_score = running_lp / (float(max_new_tokens) ** length_penalty)
+        all_scores = jnp.concatenate([fin_scores, run_score], axis=1)
+        all_seqs = jnp.concatenate([fin_seqs, seqs], axis=1)
+        best = jnp.argmax(all_scores, axis=1)
+        best_seq = jnp.take_along_axis(all_seqs, best[:, None, None],
+                                       axis=1)[:, 0]
+        best_score = jnp.take_along_axis(all_scores, best[:, None],
+                                         axis=1)[:, 0]
+        gen = best_seq[:, 1:]
+        if eos_token_id is not None:
+            seen = jnp.cumsum(gen == eos_token_id, axis=1)
+            after = jnp.concatenate(
+                [jnp.zeros((b, 1), bool), (seen > 0)[:, :-1]], axis=1)
+            gen = jnp.where(after, eos_token_id, gen)
+        return gen, best_score
+
+    return run(model, enc_t, mask_t)

@@ -146,3 +146,57 @@ def test_generic_seq2seq_matches_hf_greedy(family):
         ours, jnp.asarray(enc_in), max_new_tokens=new,
         decoder_start_token_id=start))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_generic_seq2seq_beam_search_bart():
+    """Seq2seq beam: beam-1 == greedy; the beam-K winner's EXACT sequence
+    log-probability (recomputed independently) is >= greedy's."""
+    import torch
+    from transformers import BartConfig as HFConfig
+    from transformers import BartForConditionalGeneration as HFModel
+    from paddle_tpu.models.bart import (BartConfig,
+                                        BartForConditionalGeneration)
+    from paddle_tpu.models.convert import load_bart_state_dict
+    from paddle_tpu.models.decoding import (generic_seq2seq_beam_search,
+                                            generic_seq2seq_generate)
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                          decoder_layers=2, encoder_attention_heads=4,
+                          decoder_attention_heads=4, encoder_ffn_dim=64,
+                          decoder_ffn_dim=64, max_position_embeddings=64,
+                          pad_token_id=1, use_cache=False,
+                          attn_implementation="eager")).eval()
+    pt.seed(0)
+    ours = load_bart_state_dict(
+        BartForConditionalGeneration(BartConfig.tiny(vocab_size=96)),
+        hf.state_dict())
+    rs = np.random.RandomState(0)
+    enc_in = jnp.asarray(rs.randint(2, 96, (2, 9)))
+    new, start = 5, 1
+
+    greedy = np.asarray(generic_seq2seq_generate(
+        ours, enc_in, max_new_tokens=new, decoder_start_token_id=start))
+    b1, _ = generic_seq2seq_beam_search(
+        ours, enc_in, max_new_tokens=new, num_beams=1,
+        decoder_start_token_id=start)
+    np.testing.assert_array_equal(np.asarray(b1), greedy)
+
+    bk, scores = generic_seq2seq_beam_search(
+        ours, enc_in, max_new_tokens=new, num_beams=4,
+        decoder_start_token_id=start)
+
+    def seq_logprob(row, gen):
+        dec = np.concatenate([[start], gen])
+        lg = np.asarray(ours(enc_in[row: row + 1], jnp.asarray(dec[None])),
+                        np.float32)[0]
+        lp = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(
+            -1, keepdims=True)) - lg.max(-1, keepdims=True)
+        return sum(lp[t, int(dec[t + 1])] for t in range(len(gen)))
+
+    for row in range(2):
+        s_beam = seq_logprob(row, np.asarray(bk)[row])
+        s_greedy = seq_logprob(row, greedy[row])
+        np.testing.assert_allclose(float(scores[row]) * new, s_beam,
+                                   rtol=1e-4, atol=1e-4)
+        assert s_beam >= s_greedy - 1e-5
