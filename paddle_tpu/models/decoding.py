@@ -484,7 +484,8 @@ def generic_generate(model, input_ids, max_new_tokens=32, temperature=0.0,
 
 def generic_seq2seq_generate(model, encoder_inputs, max_new_tokens=20,
                              decoder_start_token_id=0, eos_token_id=None,
-                             attention_mask=None):
+                             attention_mask=None, temperature=0.0,
+                             top_k=None, top_p=None, rng=None):
     """Greedy decode for ANY encoder-decoder whose
     ``__call__(encoder_inputs, decoder_input_ids[, attention_mask])``
     returns [B, L, vocab] logits — BART/mBART/Pegasus, Whisper, custom
@@ -493,9 +494,10 @@ def generic_seq2seq_generate(model, encoder_inputs, max_new_tokens=20,
     one jitted fori_loop, fixed shapes. Returns [B, max_new_tokens]
     (EOS-filled after a row finishes)."""
     b = encoder_inputs.shape[0]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     @jax.jit
-    def run(model, encoder_inputs, attention_mask):
+    def run(model, encoder_inputs, attention_mask, rng):
         tokens = jnp.full((b, max_new_tokens + 1), decoder_start_token_id,
                           jnp.int32)
 
@@ -505,21 +507,24 @@ def generic_seq2seq_generate(model, encoder_inputs, max_new_tokens=20,
             return model(encoder_inputs, dec)
 
         def body(i, state):
-            tokens, done = state
+            tokens, done, rng = state
+            rng, sub = jax.random.split(rng)
             logits = fwd(tokens).astype(jnp.float32)
             step = lax.dynamic_index_in_dim(logits, i, 1, keepdims=False)
-            nxt = jnp.argmax(step, axis=-1).astype(jnp.int32)
+            nxt = _sample(step, sub, temperature, top_k,
+                          top_p).astype(jnp.int32)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
                 done = done | (nxt == eos_token_id)
             tokens = tokens.at[:, i + 1].set(nxt)
-            return tokens, done
+            return tokens, done, rng
 
         done = jnp.zeros((b,), bool)
-        tokens, _ = lax.fori_loop(0, max_new_tokens, body, (tokens, done))
+        tokens, _, _ = lax.fori_loop(0, max_new_tokens, body,
+                                     (tokens, done, rng))
         return tokens[:, 1:]
 
-    return run(model, jnp.asarray(encoder_inputs), attention_mask)
+    return run(model, jnp.asarray(encoder_inputs), attention_mask, rng)
 
 
 def generic_seq2seq_beam_search(model, encoder_inputs, max_new_tokens=20,
