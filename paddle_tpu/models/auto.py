@@ -35,7 +35,8 @@ def _registry():
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
     from paddle_tpu.models import ernie_m
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
-    from paddle_tpu.models import mixtral, opt, qwen, qwen2_moe, roberta, t5
+    from paddle_tpu.models import mixtral, opt, phi, qwen, qwen2_moe
+    from paddle_tpu.models import roberta, t5
     from paddle_tpu.models import xlnet
     from paddle_tpu.models import convert as C
 
@@ -77,6 +78,8 @@ def _registry():
                        C.load_gptj_state_dict),
         "opt": _Entry(opt.OPTConfig, opt.OPTForCausalLM,
                       C.load_opt_state_dict),
+        "phi": _Entry(phi.PhiConfig, phi.PhiForCausalLM,
+                      C.load_phi_state_dict),
         "gpt2": _Entry(gpt.GPTConfig, gpt.GPTForCausalLM,
                        C.load_gpt2_state_dict,
                        remap=(("n_embd", "hidden_size"),

@@ -1242,3 +1242,39 @@ def load_layoutlm_state_dict(model, state_dict, dtype=None):
             sp["cls.predictions.transform.LayerNorm.bias"])
         model.mlm_bias = j(sp["cls.predictions.bias"])
     return model
+
+
+def load_phi_state_dict(model, state_dict, dtype=None):
+    """Populate a ``PhiForCausalLM`` from an HF state_dict (separate
+    biased q/k/v packed into the fused projection; untied biased head)."""
+    cfg = model.cfg
+    dtype = dtype or cfg.dtype
+    sd = {k.removeprefix("model."): _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    model.embed_tokens = j(sd["embed_tokens.weight"])
+    model.final_layernorm.weight = j(sd["final_layernorm.weight"])
+    model.final_layernorm.bias = j(sd["final_layernorm.bias"])
+    model.lm_head = j(_np(state_dict["lm_head.weight"]).T)
+    model.lm_head_bias = j(_np(state_dict["lm_head.bias"]))
+    for i, lyr in enumerate(model.layers):
+        p = f"layers.{i}."
+        lyr.input_layernorm.weight = j(sd[p + "input_layernorm.weight"])
+        lyr.input_layernorm.bias = j(sd[p + "input_layernorm.bias"])
+        q = sd[p + "self_attn.q_proj.weight"].T
+        k = sd[p + "self_attn.k_proj.weight"].T
+        v = sd[p + "self_attn.v_proj.weight"].T
+        lyr.qkv_proj = j(np.concatenate([q, k, v], axis=1))
+        lyr.qkv_bias = j(np.concatenate(
+            [sd[p + "self_attn.q_proj.bias"],
+             sd[p + "self_attn.k_proj.bias"],
+             sd[p + "self_attn.v_proj.bias"]]))
+        lyr.dense = j(sd[p + "self_attn.dense.weight"].T)
+        lyr.dense_bias = j(sd[p + "self_attn.dense.bias"])
+        lyr.fc1 = j(sd[p + "mlp.fc1.weight"].T)
+        lyr.fc1_bias = j(sd[p + "mlp.fc1.bias"])
+        lyr.fc2 = j(sd[p + "mlp.fc2.weight"].T)
+        lyr.fc2_bias = j(sd[p + "mlp.fc2.bias"])
+    return model

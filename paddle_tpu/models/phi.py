@@ -1,0 +1,127 @@
+"""Phi-1/1.5/2 decoder LM (ref capability: PaddleNLP ``phi`` family).
+
+Single-LN parallel block (attention and MLP both read
+``input_layernorm(x)`` and sum into one residual), LLaMA-style
+rotate-half rope over the first ``partial_rotary_factor`` of each head
+dim (GPT-NeoX pairing — unlike GPT-J's interleave), biased q/k/v/dense,
+tanh-gelu MLP, untied biased head over a final LayerNorm.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_default_dtype
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.gpt_neox import _rope_partial
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import LayerNorm
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class PhiConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 2560
+    intermediate_size: int = 10240
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = None
+    partial_rotary_factor: float = 0.4
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: object = None
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = get_default_dtype()
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw):
+        return PhiConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                   intermediate_size=64,
+                                   num_hidden_layers=2,
+                                   num_attention_heads=4,
+                                   num_key_value_heads=2,
+                                   partial_rotary_factor=0.5,
+                                   max_position_embeddings=64,
+                                   dtype=jnp.float32, remat=False), **kw})
+
+
+class PhiDecoderLayer(Module):
+    def __init__(self, cfg: PhiConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+        d = h // nh
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.input_layernorm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                         dtype=cfg.dtype)
+        self.qkv_proj = init((h, (nh + 2 * nkv) * d), cfg.dtype)
+        self.qkv_bias = jnp.zeros(((nh + 2 * nkv) * d,), cfg.dtype)
+        self.dense = init((h, h), cfg.dtype)
+        self.dense_bias = jnp.zeros((h,), cfg.dtype)
+        self.fc1 = init((h, cfg.intermediate_size), cfg.dtype)
+        self.fc1_bias = jnp.zeros((cfg.intermediate_size,), cfg.dtype)
+        self.fc2 = init((cfg.intermediate_size, h), cfg.dtype)
+        self.fc2_bias = jnp.zeros((h,), cfg.dtype)
+        self.dims = (nh, nkv, d, int(d * cfg.partial_rotary_factor))
+
+    def __call__(self, x, cos, sin):
+        b, s, hd = x.shape
+        nh, nkv, d, rot = self.dims
+        h = self.input_layernorm(x)          # ONE LN feeds attn AND mlp
+        qkv = h @ self.qkv_proj + self.qkv_bias
+        q, k, v = jnp.split(qkv, [nh * d, (nh + nkv) * d], axis=-1)
+        q = _rope_partial(q.reshape(b, s, nh, d), cos, sin, rot)
+        k = _rope_partial(k.reshape(b, s, nkv, d), cos, sin, rot)
+        att = A.scaled_dot_product_attention(q, k, v.reshape(b, s, nkv, d),
+                                             is_causal=True)
+        att = att.reshape(b, s, hd) @ self.dense + self.dense_bias
+        m = jax.nn.gelu(h @ self.fc1 + self.fc1_bias, approximate=True)
+        return x + att + (m @ self.fc2 + self.fc2_bias)
+
+
+class PhiForCausalLM(Module):
+    def __init__(self, cfg: PhiConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.embed_tokens = init((cfg.vocab_size, cfg.hidden_size),
+                                 cfg.dtype)
+        self.layers = [PhiDecoderLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.final_layernorm = LayerNorm(cfg.hidden_size,
+                                         epsilon=cfg.layer_norm_eps,
+                                         dtype=cfg.dtype)
+        self.lm_head = init((cfg.hidden_size, cfg.vocab_size), cfg.dtype)
+        self.lm_head_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        d = cfg.hidden_size // cfg.num_attention_heads
+        rot = int(d * cfg.partial_rotary_factor)
+        cos, sin = A.rope_cos_sin(s, rot, base=cfg.rope_theta)
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        blk = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin))
+               if cfg.remat else (lambda lyr, h: lyr(h, cos, sin)))
+        for lyr in self.layers:
+            x = blk(lyr, x)
+        x = self.final_layernorm(x)
+        return x @ self.lm_head + self.lm_head_bias
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1.0)

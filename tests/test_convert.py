@@ -1165,3 +1165,32 @@ def test_layoutlm_mlm_logits_match_transformers():
         ref = hf(torch.tensor(ids), bbox=torch.tensor(bbox)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids), jnp.asarray(bbox)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_phi_logits_match_transformers():
+    """Phi (single-LN parallel block, llama-pairing partial rotary,
+    biased projections, untied biased head): logits match HF."""
+    import torch
+    from transformers import PhiConfig as HFConfig
+    from transformers import PhiForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          partial_rotary_factor=0.5,
+                          max_position_embeddings=64, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_phi_state_dict
+    from paddle_tpu.models.phi import PhiConfig, PhiForCausalLM
+
+    pt.seed(0)
+    cfg = PhiConfig.tiny(vocab_size=96)
+    ours = load_phi_state_dict(PhiForCausalLM(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
