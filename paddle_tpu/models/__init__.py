@@ -24,9 +24,14 @@ from paddle_tpu.models.bart import (PegasusConfig,
 from paddle_tpu.models.ernie import (ErnieConfig, ErnieForMaskedLM,
                                      ErnieForSequenceClassification,
                                      ErnieModel)
+from paddle_tpu.models.bart import (BlenderbotConfig,
+                                    BlenderbotForConditionalGeneration)
 from paddle_tpu.models.ernie_m import (ErnieMConfig,
                                        ErnieMForSequenceClassification,
                                        ErnieMModel)
+from paddle_tpu.models.fnet import FNetConfig, FNetForMaskedLM, FNetModel
+from paddle_tpu.models.roformer import (RoFormerConfig,
+                                        RoFormerForMaskedLM, RoFormerModel)
 from paddle_tpu.models.roberta import (RobertaConfig, RobertaForMaskedLM,
                                        RobertaForSequenceClassification,
                                        RobertaModel)

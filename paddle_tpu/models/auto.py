@@ -33,7 +33,7 @@ class _Entry:
 def _registry():
     from paddle_tpu.models import albert, deberta, distilbert, layoutlm
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
-    from paddle_tpu.models import ernie_m
+    from paddle_tpu.models import ernie_m, fnet, roformer
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
     from paddle_tpu.models import mixtral, opt, phi, qwen, qwen2_moe
     from paddle_tpu.models import roberta, t5
@@ -106,6 +106,14 @@ def _registry():
                           C.load_bart_state_dict),
         "ernie_m": _Entry(ernie_m.ErnieMConfig, ernie_m.ErnieMModel,
                           C.load_ernie_m_state_dict),
+        "roformer": _Entry(roformer.RoFormerConfig,
+                           roformer.RoFormerForMaskedLM,
+                           C.load_roformer_state_dict),
+        "fnet": _Entry(fnet.FNetConfig, fnet.FNetForMaskedLM,
+                       C.load_fnet_state_dict),
+        "blenderbot": _Entry(bart.BlenderbotConfig,
+                             bart.BlenderbotForConditionalGeneration,
+                             C.load_bart_state_dict),
         "codegen": _Entry(gptj.CodeGenConfig, gptj.CodeGenForCausalLM,
                           C.load_codegen_state_dict),
         "t5": _Entry(t5.T5Config, t5.T5ForConditionalGeneration,

@@ -226,3 +226,31 @@ class PegasusConfig(BartConfig):
 
 class PegasusForConditionalGeneration(BartForConditionalGeneration):
     pass
+
+
+@dataclass
+class BlenderbotConfig(BartConfig):
+    """Blenderbot shape (ref: PaddleNLP ``blenderbot``): pre-LN layers,
+    final LNs, learned positions at offset 0, no embedding LN — the
+    Pegasus flag set with a learned (not sinusoidal) position table."""
+    vocab_size: int = 8008
+    normalize_before: bool = True
+    add_final_layer_norm: bool = True
+    position_offset: int = 0
+    add_embedding_norm: bool = False
+
+    @staticmethod
+    def tiny(**kw):
+        return BlenderbotConfig(**{**dict(vocab_size=128, d_model=32,
+                                          encoder_layers=2,
+                                          decoder_layers=2,
+                                          encoder_attention_heads=4,
+                                          decoder_attention_heads=4,
+                                          encoder_ffn_dim=64,
+                                          decoder_ffn_dim=64,
+                                          max_position_embeddings=64),
+                                   **kw})
+
+
+class BlenderbotForConditionalGeneration(BartForConditionalGeneration):
+    pass

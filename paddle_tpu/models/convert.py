@@ -1278,3 +1278,97 @@ def load_phi_state_dict(model, state_dict, dtype=None):
         lyr.fc2 = j(sd[p + "mlp.fc2.weight"].T)
         lyr.fc2_bias = j(sd[p + "mlp.fc2.bias"])
     return model
+
+
+def load_roformer_state_dict(model, state_dict, dtype=None):
+    """Populate a ``RoFormerForMaskedLM``/``RoFormerModel`` from an HF
+    state_dict (``roformer.*`` naming; rotary has no weights)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("roformer."): _np(v)
+          for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    rf = model.roformer if hasattr(model, "roformer") else model
+    rf.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    rf.token_type_embeddings.weight = j(
+        sd["embeddings.token_type_embeddings.weight"])
+    ln(rf.emb_norm, "embeddings.LayerNorm")
+    for i, lyr in enumerate(rf.layers):
+        p = f"encoder.layer.{i}."
+        lin(lyr.q_proj, p + "attention.self.query")
+        lin(lyr.k_proj, p + "attention.self.key")
+        lin(lyr.v_proj, p + "attention.self.value")
+        lin(lyr.out_proj, p + "attention.output.dense")
+        ln(lyr.attn_norm, p + "attention.output.LayerNorm")
+        lin(lyr.intermediate, p + "intermediate.dense")
+        lin(lyr.output, p + "output.dense")
+        ln(lyr.out_norm, p + "output.LayerNorm")
+    if hasattr(model, "mlm_transform") and \
+            "cls.predictions.bias" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.mlm_transform.weight = j(
+            sp["cls.predictions.transform.dense.weight"].T)
+        model.mlm_transform.bias = j(
+            sp["cls.predictions.transform.dense.bias"])
+        model.mlm_norm.weight = j(
+            sp["cls.predictions.transform.LayerNorm.weight"])
+        model.mlm_norm.bias = j(
+            sp["cls.predictions.transform.LayerNorm.bias"])
+        model.mlm_bias = j(sp["cls.predictions.bias"])
+    return model
+
+
+def load_fnet_state_dict(model, state_dict, dtype=None):
+    """Populate an ``FNetForMaskedLM``/``FNetModel`` from an HF
+    state_dict (no attention weights — Fourier mixing is parameterless)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("fnet."): _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    fn = model.fnet if hasattr(model, "fnet") else model
+    fn.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    fn.position_embeddings.weight = j(
+        sd["embeddings.position_embeddings.weight"])
+    fn.token_type_embeddings.weight = j(
+        sd["embeddings.token_type_embeddings.weight"])
+    ln(fn.emb_norm, "embeddings.LayerNorm")
+    lin(fn.projection, "embeddings.projection")
+    for i, lyr in enumerate(fn.layers):
+        p = f"encoder.layer.{i}."
+        ln(lyr.fourier_norm, p + "fourier.output.LayerNorm")
+        lin(lyr.intermediate, p + "intermediate.dense")
+        lin(lyr.output, p + "output.dense")
+        ln(lyr.out_norm, p + "output.LayerNorm")
+    if hasattr(model, "mlm_transform") and \
+            "cls.predictions.bias" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.mlm_transform.weight = j(
+            sp["cls.predictions.transform.dense.weight"].T)
+        model.mlm_transform.bias = j(
+            sp["cls.predictions.transform.dense.bias"])
+        model.mlm_norm.weight = j(
+            sp["cls.predictions.transform.LayerNorm.weight"])
+        model.mlm_norm.bias = j(
+            sp["cls.predictions.transform.LayerNorm.bias"])
+        model.mlm_bias = j(sp["cls.predictions.bias"])
+    return model

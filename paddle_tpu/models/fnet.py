@@ -1,0 +1,107 @@
+"""FNet (ref: PaddleNLP ``paddlenlp/transformers/fnet``).
+
+The attention-free encoder: token mixing is a 2-D Fourier transform
+(real part of an FFT over sequence and hidden axes) — no attention
+weights at all — followed by the usual post-LN feed-forward. A natural
+fit for TPU (the FFT is one fused XLA op).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+
+
+@dataclass
+class FNetConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    intermediate_size: int = 3072
+    type_vocab_size: int = 4
+    max_position_embeddings: int = 512
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return FNetConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                    num_hidden_layers=2,
+                                    intermediate_size=64,
+                                    max_position_embeddings=64), **kw})
+
+
+class FNetLayer(Module):
+    def __init__(self, cfg: FNetConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.fourier_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                      dtype=cfg.dtype)
+        self.intermediate = Linear(h, cfg.intermediate_size, dtype=cfg.dtype)
+        self.output = Linear(cfg.intermediate_size, h, dtype=cfg.dtype)
+        self.out_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+
+    def __call__(self, x):
+        four = jnp.fft.fftn(x.astype(jnp.complex64), axes=(1, 2)).real
+        x = self.fourier_norm(x + four.astype(x.dtype))
+        m = self.output(jax.nn.gelu(self.intermediate(x), approximate=True))
+        return self.out_norm(x + m)
+
+
+class FNetModel(Module):
+    def __init__(self, cfg: FNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, h,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, h,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.projection = Linear(h, h, dtype=cfg.dtype)
+        self.layers = [FNetLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+
+    def __call__(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(jnp.arange(s)[None, :])
+             + self.token_type_embeddings(token_type_ids))
+        x = self.projection(self.emb_norm(x))
+        for lyr in self.layers:
+            x = lyr(x)
+        return x
+
+
+class FNetForMaskedLM(Module):
+    def __init__(self, cfg: FNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.fnet = FNetModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    dtype=cfg.dtype)
+        self.mlm_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.mlm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None):
+        seq = self.fnet(input_ids, token_type_ids)
+        h = self.mlm_norm(jax.nn.gelu(self.mlm_transform(seq),
+                                      approximate=True))
+        return h @ self.fnet.word_embeddings.weight.T + self.mlm_bias

@@ -1,0 +1,123 @@
+"""RoFormer (ref: PaddleNLP ``paddlenlp/transformers/roformer`` — the
+rotary-position BERT, a Chinese-NLP staple).
+
+Post-LN BERT blocks whose attention rotates q/k with INTERLEAVED rotary
+embeddings over the full head dim (the paper that introduced RoPE);
+embeddings carry word + token-type only (no position table). MLM head =
+transform + LN + tied decoder.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class RoFormerConfig:
+    vocab_size: int = 50000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    type_vocab_size: int = 2
+    max_position_embeddings: int = 1536
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return RoFormerConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                        num_hidden_layers=2,
+                                        num_attention_heads=2,
+                                        intermediate_size=64,
+                                        max_position_embeddings=64), **kw})
+
+
+class RoFormerLayer(Module):
+    def __init__(self, cfg: RoFormerConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.q_proj = Linear(h, h, dtype=cfg.dtype)
+        self.k_proj = Linear(h, h, dtype=cfg.dtype)
+        self.v_proj = Linear(h, h, dtype=cfg.dtype)
+        self.out_proj = Linear(h, h, dtype=cfg.dtype)
+        self.attn_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                   dtype=cfg.dtype)
+        self.intermediate = Linear(h, cfg.intermediate_size, dtype=cfg.dtype)
+        self.output = Linear(cfg.intermediate_size, h, dtype=cfg.dtype)
+        self.out_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.heads = cfg.num_attention_heads
+
+    def __call__(self, x, cos, sin, attn_mask=None):
+        b, s, hd = x.shape
+        nh = self.heads
+        d = hd // nh
+        q = A.apply_rope_interleaved(
+            self.q_proj(x).reshape(b, s, nh, d), cos, sin)
+        k = A.apply_rope_interleaved(
+            self.k_proj(x).reshape(b, s, nh, d), cos, sin)
+        v = self.v_proj(x).reshape(b, s, nh, d)
+        att = A.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+        x = self.attn_norm(x + self.out_proj(att.reshape(b, s, hd)))
+        return self.out_norm(x + self.output(F.gelu(self.intermediate(x))))
+
+
+class RoFormerModel(Module):
+    def __init__(self, cfg: RoFormerConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, h,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.layers = [RoFormerLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        d = cfg.hidden_size // cfg.num_attention_heads
+        cos, sin = A.rope_cos_sin(s, d)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        x = self.emb_norm(self.word_embeddings(input_ids)
+                          + self.token_type_embeddings(token_type_ids))
+        for lyr in self.layers:
+            x = lyr(x, cos, sin, attn_mask=attention_mask)
+        return x
+
+
+class RoFormerForMaskedLM(Module):
+    def __init__(self, cfg: RoFormerConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.roformer = RoFormerModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    dtype=cfg.dtype)
+        self.mlm_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.mlm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq = self.roformer(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        return h @ self.roformer.word_embeddings.weight.T + self.mlm_bias

@@ -1194,3 +1194,100 @@ def test_phi_logits_match_transformers():
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(ids)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_roformer_mlm_logits_match_transformers():
+    """RoFormer (rotary BERT — interleaved RoPE inside post-LN blocks,
+    no position table): MLM logits match HF."""
+    import torch
+    from transformers import RoFormerConfig as HFConfig
+    from transformers import RoFormerForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64, embedding_size=32,
+                          max_position_embeddings=64,
+                          rotary_value=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_roformer_state_dict
+    from paddle_tpu.models.roformer import (RoFormerConfig,
+                                            RoFormerForMaskedLM)
+
+    pt.seed(0)
+    cfg = RoFormerConfig.tiny(vocab_size=96)
+    ours = load_roformer_state_dict(RoFormerForMaskedLM(cfg).eval(),
+                                    hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 token_type_ids=torch.tensor(tt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids),
+                          token_type_ids=jnp.asarray(tt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fnet_mlm_logits_match_transformers():
+    """FNet (attention-free Fourier mixing): MLM logits match HF."""
+    import torch
+    from transformers import FNetConfig as HFConfig
+    from transformers import FNetForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, intermediate_size=64,
+                          max_position_embeddings=64, type_vocab_size=4,
+                          use_tpu_fourier_optimizations=False)).eval()
+
+    from paddle_tpu.models.convert import load_fnet_state_dict
+    from paddle_tpu.models.fnet import FNetConfig, FNetForMaskedLM
+
+    pt.seed(0)
+    cfg = FNetConfig.tiny(vocab_size=96, type_vocab_size=4)
+    ours = load_fnet_state_dict(FNetForMaskedLM(cfg).eval(),
+                                hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 4, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 token_type_ids=torch.tensor(tt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids),
+                          token_type_ids=jnp.asarray(tt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_blenderbot_logits_match_transformers():
+    """Blenderbot (conversational seq2seq: pre-LN, final LNs, learned
+    offset-0 positions, no embedding LN) through the BART classes."""
+    import torch
+    from transformers import BlenderbotConfig as HFConfig
+    from transformers import BlenderbotForConditionalGeneration as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                          decoder_layers=2, encoder_attention_heads=4,
+                          decoder_attention_heads=4, encoder_ffn_dim=64,
+                          decoder_ffn_dim=64, max_position_embeddings=64,
+                          scale_embedding=False, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.bart import (BlenderbotConfig,
+                                        BlenderbotForConditionalGeneration)
+    from paddle_tpu.models.convert import load_bart_state_dict
+
+    pt.seed(0)
+    cfg = BlenderbotConfig.tiny(vocab_size=96)
+    ours = load_bart_state_dict(
+        BlenderbotForConditionalGeneration(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(0)
+    src = rs.randint(2, 96, (2, 10))
+    tgt = rs.randint(2, 96, (2, 7))
+    with torch.no_grad():
+        ref = hf(torch.tensor(src),
+                 decoder_input_ids=torch.tensor(tgt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
