@@ -44,6 +44,10 @@ from paddle_tpu.models.gptj import (CodeGenConfig, CodeGenForCausalLM,
 from paddle_tpu.models.layoutlm import (LayoutLMConfig,
                                         LayoutLMForMaskedLM, LayoutLMModel)
 from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+from paddle_tpu.models.mpnet import (MPNetConfig, MPNetForMaskedLM,
+                                     MPNetModel)
+from paddle_tpu.models.nezha import (NezhaConfig, NezhaForMaskedLM,
+                                     NezhaModel)
 from paddle_tpu.models.phi import PhiConfig, PhiForCausalLM
 from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
 from paddle_tpu.models.whisper import (WhisperConfig,

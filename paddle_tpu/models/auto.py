@@ -33,7 +33,7 @@ class _Entry:
 def _registry():
     from paddle_tpu.models import albert, deberta, distilbert, layoutlm
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
-    from paddle_tpu.models import ernie_m, fnet, roformer
+    from paddle_tpu.models import ernie_m, fnet, mpnet, nezha, roformer
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
     from paddle_tpu.models import mixtral, opt, phi, qwen, qwen2_moe
     from paddle_tpu.models import roberta, t5
@@ -111,6 +111,10 @@ def _registry():
                            C.load_roformer_state_dict),
         "fnet": _Entry(fnet.FNetConfig, fnet.FNetForMaskedLM,
                        C.load_fnet_state_dict),
+        "mpnet": _Entry(mpnet.MPNetConfig, mpnet.MPNetForMaskedLM,
+                        C.load_mpnet_state_dict),
+        "nezha": _Entry(nezha.NezhaConfig, nezha.NezhaForMaskedLM,
+                        C.load_nezha_state_dict),
         "blenderbot": _Entry(bart.BlenderbotConfig,
                              bart.BlenderbotForConditionalGeneration,
                              C.load_bart_state_dict),

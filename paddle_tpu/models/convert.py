@@ -1372,3 +1372,96 @@ def load_fnet_state_dict(model, state_dict, dtype=None):
             sp["cls.predictions.transform.LayerNorm.bias"])
         model.mlm_bias = j(sp["cls.predictions.bias"])
     return model
+
+
+def load_mpnet_state_dict(model, state_dict, dtype=None):
+    """Populate an ``MPNetForMaskedLM``/``MPNetModel`` from an HF
+    state_dict (shared relative_attention_bias table; lm_head tied)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("mpnet."): _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    mp = model.mpnet if hasattr(model, "mpnet") else model
+    mp.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    mp.position_embeddings.weight = j(
+        sd["embeddings.position_embeddings.weight"])
+    ln(mp.emb_norm, "embeddings.LayerNorm")
+    mp.relative_attention_bias.weight = j(
+        sd["encoder.relative_attention_bias.weight"])
+    for i, lyr in enumerate(mp.layers):
+        p = f"encoder.layer.{i}."
+        lin(lyr.q_proj, p + "attention.attn.q")
+        lin(lyr.k_proj, p + "attention.attn.k")
+        lin(lyr.v_proj, p + "attention.attn.v")
+        lin(lyr.o_proj, p + "attention.attn.o")
+        ln(lyr.attn_norm, p + "attention.LayerNorm")
+        lin(lyr.intermediate, p + "intermediate.dense")
+        lin(lyr.output, p + "output.dense")
+        ln(lyr.out_norm, p + "output.LayerNorm")
+    if hasattr(model, "lm_dense") and "lm_head.bias" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.lm_dense.weight = j(sp["lm_head.dense.weight"].T)
+        model.lm_dense.bias = j(sp["lm_head.dense.bias"])
+        model.lm_norm.weight = j(sp["lm_head.layer_norm.weight"])
+        model.lm_norm.bias = j(sp["lm_head.layer_norm.bias"])
+        model.lm_bias = j(sp["lm_head.bias"])
+    return model
+
+
+def load_nezha_state_dict(model, state_dict, dtype=None):
+    """Populate a ``NezhaForMaskedLM``/``NezhaModel`` from an HF
+    state_dict (functional positions — no position table to load)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("nezha."): _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    nz = model.nezha if hasattr(model, "nezha") else model
+    nz.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    nz.token_type_embeddings.weight = j(
+        sd["embeddings.token_type_embeddings.weight"])
+    ln(nz.emb_norm, "embeddings.LayerNorm")
+    for i, lyr in enumerate(nz.layers):
+        p = f"encoder.layer.{i}."
+        lin(lyr.q_proj, p + "attention.self.query")
+        lin(lyr.k_proj, p + "attention.self.key")
+        lin(lyr.v_proj, p + "attention.self.value")
+        lin(lyr.o_proj, p + "attention.output.dense")
+        ln(lyr.attn_norm, p + "attention.output.LayerNorm")
+        lin(lyr.intermediate, p + "intermediate.dense")
+        lin(lyr.output, p + "output.dense")
+        ln(lyr.out_norm, p + "output.LayerNorm")
+    if "pooler.dense.weight" in sd:
+        lin(nz.pooler, "pooler.dense")
+    if hasattr(model, "mlm_transform") and \
+            "cls.predictions.bias" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.mlm_transform.weight = j(
+            sp["cls.predictions.transform.dense.weight"].T)
+        model.mlm_transform.bias = j(
+            sp["cls.predictions.transform.dense.bias"])
+        model.mlm_norm.weight = j(
+            sp["cls.predictions.transform.LayerNorm.weight"])
+        model.mlm_norm.bias = j(
+            sp["cls.predictions.transform.LayerNorm.bias"])
+        model.mlm_bias = j(sp["cls.predictions.bias"])
+    return model

@@ -1,0 +1,151 @@
+"""MPNet (ref: PaddleNLP ``paddlenlp/transformers/mpnet``).
+
+Masked-and-permuted pretraining encoder: post-LN BERT blocks whose
+attention adds a SHARED T5-style bucketed relative position bias
+(one [num_buckets, heads] table for the whole stack), RoBERTa-style
+position ids computed from the pad mask.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.models.roberta import roberta_position_ids
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+
+
+@dataclass
+class MPNetConfig:
+    vocab_size: int = 30527
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 514
+    relative_attention_num_buckets: int = 32
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 1
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return MPNetConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                     num_hidden_layers=2,
+                                     num_attention_heads=2,
+                                     intermediate_size=64,
+                                     max_position_embeddings=66), **kw})
+
+
+def _relative_position_bucket(rel, num_buckets=32, max_distance=128):
+    """MPNet/T5 bidirectional log-bucket (HF convention: n = -rel)."""
+    n = -rel
+    num_buckets //= 2
+    ret = (n < 0).astype(jnp.int32) * num_buckets
+    n = jnp.abs(n)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class MPNetLayer(Module):
+    def __init__(self, cfg: MPNetConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.q_proj = Linear(h, h, dtype=cfg.dtype)
+        self.k_proj = Linear(h, h, dtype=cfg.dtype)
+        self.v_proj = Linear(h, h, dtype=cfg.dtype)
+        self.o_proj = Linear(h, h, dtype=cfg.dtype)
+        self.attn_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                   dtype=cfg.dtype)
+        self.intermediate = Linear(h, cfg.intermediate_size, dtype=cfg.dtype)
+        self.output = Linear(cfg.intermediate_size, h, dtype=cfg.dtype)
+        self.out_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.heads = cfg.num_attention_heads
+
+    def __call__(self, x, position_bias, attn_mask=None):
+        b, s, hd = x.shape
+        nh = self.heads
+        d = hd // nh
+        q = self.q_proj(x).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+        k = self.k_proj(x).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+        v = self.v_proj(x).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+        scores = (jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+                  + position_bias)
+        if attn_mask is not None:
+            scores = scores + attn_mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, hd)
+        x = self.attn_norm(x + self.o_proj(out))
+        return self.out_norm(x + self.output(F.gelu(self.intermediate(x))))
+
+
+class MPNetModel(Module):
+    def __init__(self, cfg: MPNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, h,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.relative_attention_bias = Embedding(
+            cfg.relative_attention_num_buckets, cfg.num_attention_heads,
+            weight_init=init, dtype=cfg.dtype)
+        self.layers = [MPNetLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+
+    def __call__(self, input_ids, attention_mask=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        pos = roberta_position_ids(input_ids, cfg.pad_token_id)
+        x = self.emb_norm(self.word_embeddings(input_ids)
+                          + self.position_embeddings(pos))
+        rel = jnp.arange(s)[None, :] - jnp.arange(s)[:, None]
+        buckets = _relative_position_bucket(
+            rel, cfg.relative_attention_num_buckets)
+        bias = self.relative_attention_bias(buckets)      # [S, S, H]
+        bias = bias.transpose(2, 0, 1)[None]              # [1, H, S, S]
+        mask = None
+        if attention_mask is not None:
+            mask = (1.0 - attention_mask[:, None, None, :]
+                    .astype(jnp.float32)) * -1e9
+        for lyr in self.layers:
+            x = lyr(x, bias, attn_mask=mask)
+        return x
+
+
+class MPNetForMaskedLM(Module):
+    def __init__(self, cfg: MPNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.mpnet = MPNetModel(cfg)
+        self.lm_dense = Linear(cfg.hidden_size, cfg.hidden_size,
+                               dtype=cfg.dtype)
+        self.lm_norm = LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_eps,
+                                 dtype=cfg.dtype)
+        self.lm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, attention_mask=None):
+        seq = self.mpnet(input_ids, attention_mask)
+        h = self.lm_norm(F.gelu(self.lm_dense(seq)))
+        return h @ self.mpnet.word_embeddings.weight.T + self.lm_bias

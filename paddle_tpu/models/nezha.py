@@ -1,0 +1,147 @@
+"""NeZha (ref: PaddleNLP ``paddlenlp/transformers/nezha`` — the
+Chinese-NLP BERT variant with FUNCTIONAL relative positions).
+
+No position table at all: every layer's attention adds sinusoidal
+relative-distance encodings (clipped at ±max_relative_position) to BOTH
+the key scores and the value aggregation — parameter-free positions that
+extrapolate past the training length. Everything else is post-LN BERT.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+
+
+@dataclass
+class NezhaConfig:
+    vocab_size: int = 21128
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    type_vocab_size: int = 2
+    max_relative_position: int = 64
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return NezhaConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                     num_hidden_layers=2,
+                                     num_attention_heads=2,
+                                     intermediate_size=64,
+                                     max_relative_position=8), **kw})
+
+
+def relative_positions_encoding(s, depth, max_rel):
+    """[S, S, depth] sinusoidal encodings of clip(j - i, ±max_rel)."""
+    pos = np.arange(2 * max_rel + 1, dtype=np.float32)[:, None]
+    div = np.exp(np.arange(0, depth, 2, dtype=np.float32)
+                 * (-math.log(10000.0) / depth))
+    table = np.zeros((2 * max_rel + 1, depth), np.float32)
+    table[:, 0::2] = np.sin(pos * div)
+    table[:, 1::2] = np.cos(pos * div)
+    d = np.clip(np.arange(s)[None, :] - np.arange(s)[:, None],
+                -max_rel, max_rel) + max_rel
+    return jnp.asarray(table[d])
+
+
+class NezhaLayer(Module):
+    def __init__(self, cfg: NezhaConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.q_proj = Linear(h, h, dtype=cfg.dtype)
+        self.k_proj = Linear(h, h, dtype=cfg.dtype)
+        self.v_proj = Linear(h, h, dtype=cfg.dtype)
+        self.o_proj = Linear(h, h, dtype=cfg.dtype)
+        self.attn_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                   dtype=cfg.dtype)
+        self.intermediate = Linear(h, cfg.intermediate_size, dtype=cfg.dtype)
+        self.output = Linear(cfg.intermediate_size, h, dtype=cfg.dtype)
+        self.out_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.heads = cfg.num_attention_heads
+
+    def __call__(self, x, rel, attn_mask=None):
+        b, s, hd = x.shape
+        nh = self.heads
+        d = hd // nh
+        q = self.q_proj(x).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+        k = self.k_proj(x).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+        v = self.v_proj(x).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+        scores = (jnp.einsum("bhid,bhjd->bhij", q, k)
+                  + jnp.einsum("bhid,ijd->bhij", q, rel)) / math.sqrt(d)
+        if attn_mask is not None:
+            scores = scores + attn_mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(v.dtype)
+        ctx = (jnp.einsum("bhij,bhjd->bhid", probs, v)
+               + jnp.einsum("bhij,ijd->bhid", probs, rel.astype(v.dtype)))
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hd)
+        x = self.attn_norm(x + self.o_proj(ctx))
+        return self.out_norm(x + self.output(F.gelu(self.intermediate(x))))
+
+
+class NezhaModel(Module):
+    def __init__(self, cfg: NezhaConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, h,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.emb_norm = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.layers = [NezhaLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.pooler = Linear(h, h, dtype=cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        rel = relative_positions_encoding(
+            s, cfg.hidden_size // cfg.num_attention_heads,
+            cfg.max_relative_position)
+        mask = None
+        if attention_mask is not None:
+            mask = (1.0 - attention_mask[:, None, None, :]
+                    .astype(jnp.float32)) * -1e9
+        x = self.emb_norm(self.word_embeddings(input_ids)
+                          + self.token_type_embeddings(token_type_ids))
+        for lyr in self.layers:
+            x = lyr(x, rel, attn_mask=mask)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class NezhaForMaskedLM(Module):
+    def __init__(self, cfg: NezhaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.nezha = NezhaModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    dtype=cfg.dtype)
+        self.mlm_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.mlm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.nezha(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        return h @ self.nezha.word_embeddings.weight.T + self.mlm_bias

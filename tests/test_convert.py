@@ -1291,3 +1291,65 @@ def test_blenderbot_logits_match_transformers():
                  decoder_input_ids=torch.tensor(tgt)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_mpnet_mlm_logits_match_transformers():
+    """MPNet (shared T5-style bucketed relative bias inside post-LN
+    blocks, roberta position ids): MLM logits match HF."""
+    import torch
+    from transformers import MPNetConfig as HFConfig
+    from transformers import MPNetForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=66,
+                          relative_attention_num_buckets=32,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_mpnet_state_dict
+    from paddle_tpu.models.mpnet import MPNetConfig, MPNetForMaskedLM
+
+    pt.seed(0)
+    cfg = MPNetConfig.tiny(vocab_size=96)
+    ours = load_mpnet_state_dict(MPNetForMaskedLM(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(2, 96, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_nezha_mlm_logits_match_transformers():
+    """NeZha (parameter-free sinusoidal RELATIVE positions added to key
+    scores AND value aggregation in every layer): MLM logits match HF."""
+    import torch
+    from transformers import NezhaConfig as HFConfig
+    from transformers import NezhaForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64, max_relative_position=8,
+                          max_position_embeddings=64,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_nezha_state_dict
+    from paddle_tpu.models.nezha import NezhaConfig, NezhaForMaskedLM
+
+    pt.seed(0)
+    cfg = NezhaConfig.tiny(vocab_size=96)
+    ours = load_nezha_state_dict(NezhaForMaskedLM(cfg).eval(),
+                                 hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 token_type_ids=torch.tensor(tt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids),
+                          token_type_ids=jnp.asarray(tt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
