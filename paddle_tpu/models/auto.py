@@ -118,6 +118,10 @@ def _registry():
         "blenderbot": _Entry(bart.BlenderbotConfig,
                              bart.BlenderbotForConditionalGeneration,
                              C.load_bart_state_dict),
+        "blenderbot-small": _Entry(
+            bart.BlenderbotSmallConfig,
+            bart.BlenderbotSmallForConditionalGeneration,
+            C.load_bart_state_dict),
         "codegen": _Entry(gptj.CodeGenConfig, gptj.CodeGenForCausalLM,
                           C.load_codegen_state_dict),
         "t5": _Entry(t5.T5Config, t5.T5ForConditionalGeneration,

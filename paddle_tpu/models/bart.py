@@ -41,6 +41,9 @@ class BartConfig:
     # Pegasus has no offset (and a STATIC sinusoidal table)
     position_offset: int = 2
     add_embedding_norm: bool = True      # Pegasus drops the embedding LN
+    # Blenderbot-small quirk: the DECODER norms token embeds BEFORE
+    # adding positions (encoder norms after, like BART)
+    decoder_norm_before_pos: bool = False
     initializer_range: float = 0.02
     dtype: object = jnp.float32
 
@@ -131,13 +134,16 @@ class BartForConditionalGeneration(Module):
                                if cfg.add_final_layer_norm else None)
         self.final_logits_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
 
-    def _embed(self, ids, pos_table, norm):
+    def _embed(self, ids, pos_table, norm, norm_before_pos=False):
         scale = (self.cfg.d_model ** 0.5 if self.cfg.scale_embedding
                  else 1.0)
         s = ids.shape[1]
         off = self.cfg.position_offset
         x = jnp.take(self.shared, ids, axis=0) * scale
-        x = x + pos_table[off: s + off][None]
+        pos = pos_table[off: s + off][None]
+        if norm_before_pos and norm is not None:
+            return norm(x) + pos
+        x = x + pos
         return norm(x) if norm is not None else x
 
     def encode(self, input_ids, attention_mask=None):
@@ -160,7 +166,8 @@ class BartForConditionalGeneration(Module):
             enc_mask = (1.0 - attention_mask[:, None, None, :]
                         .astype(jnp.float32)) * -1e9
         x = self._embed(decoder_input_ids, self.dec_positions,
-                        self.dec_layernorm_embedding)
+                        self.dec_layernorm_embedding,
+                        norm_before_pos=self.cfg.decoder_norm_before_pos)
         for lyr in self.decoder_layers_m:
             x = lyr(x, enc, enc_mask=enc_mask)
         if self.dec_final_norm is not None:
@@ -253,4 +260,30 @@ class BlenderbotConfig(BartConfig):
 
 
 class BlenderbotForConditionalGeneration(BartForConditionalGeneration):
+    pass
+
+
+@dataclass
+class BlenderbotSmallConfig(BartConfig):
+    """Blenderbot-small (90M) shape: plain BART post-LN blocks with
+    offset-0 learned positions; the decoder norms embeds BEFORE adding
+    positions (HF quirk, reproduced)."""
+    vocab_size: int = 54944
+    position_offset: int = 0
+    decoder_norm_before_pos: bool = True
+
+    @staticmethod
+    def tiny(**kw):
+        return BlenderbotSmallConfig(**{**dict(vocab_size=128, d_model=32,
+                                               encoder_layers=2,
+                                               decoder_layers=2,
+                                               encoder_attention_heads=4,
+                                               decoder_attention_heads=4,
+                                               encoder_ffn_dim=64,
+                                               decoder_ffn_dim=64,
+                                               max_position_embeddings=64),
+                                        **kw})
+
+
+class BlenderbotSmallForConditionalGeneration(BartForConditionalGeneration):
     pass

@@ -1353,3 +1353,37 @@ def test_nezha_mlm_logits_match_transformers():
     got = np.asarray(ours(jnp.asarray(ids),
                           token_type_ids=jnp.asarray(tt)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_blenderbot_small_logits_match_transformers():
+    """Blenderbot-small (BART post-LN with offset-0 positions)."""
+    import torch
+    from transformers import BlenderbotSmallConfig as HFConfig
+    from transformers import (
+        BlenderbotSmallForConditionalGeneration as HFModel)
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, d_model=32, encoder_layers=2,
+                          decoder_layers=2, encoder_attention_heads=4,
+                          decoder_attention_heads=4, encoder_ffn_dim=64,
+                          decoder_ffn_dim=64, max_position_embeddings=64,
+                          scale_embedding=False, use_cache=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.bart import (
+        BlenderbotSmallConfig, BlenderbotSmallForConditionalGeneration)
+    from paddle_tpu.models.convert import load_bart_state_dict
+
+    pt.seed(0)
+    cfg = BlenderbotSmallConfig.tiny(vocab_size=96)
+    ours = load_bart_state_dict(
+        BlenderbotSmallForConditionalGeneration(cfg).eval(),
+        hf.state_dict())
+    rs = np.random.RandomState(0)
+    src = rs.randint(2, 96, (2, 10))
+    tgt = rs.randint(2, 96, (2, 7))
+    with torch.no_grad():
+        ref = hf(torch.tensor(src),
+                 decoder_input_ids=torch.tensor(tgt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
