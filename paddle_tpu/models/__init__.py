@@ -9,6 +9,8 @@ from paddle_tpu.models.bart import (BartConfig,
                                     BartForConditionalGeneration,
                                     MBartConfig,
                                     MBartForConditionalGeneration)
+from paddle_tpu.models.big_bird import (BigBirdConfig, BigBirdForMaskedLM,
+                                        BigBirdModel)
 from paddle_tpu.models.bloom import BloomConfig, BloomForCausalLM
 from paddle_tpu.models.clip import (CLIPConfig, CLIPModel, CLIPTextModel,
                                     CLIPVisionModel)

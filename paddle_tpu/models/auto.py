@@ -31,7 +31,8 @@ class _Entry:
 
 
 def _registry():
-    from paddle_tpu.models import albert, deberta, distilbert, layoutlm
+    from paddle_tpu.models import albert, big_bird, deberta, distilbert
+    from paddle_tpu.models import layoutlm
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
     from paddle_tpu.models import ernie_m, fnet, mpnet, nezha, roformer
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
@@ -43,6 +44,9 @@ def _registry():
     return {
         "albert": _Entry(albert.AlbertConfig, albert.AlbertForMaskedLM,
                          C.load_albert_state_dict),
+        "big_bird": _Entry(big_bird.BigBirdConfig,
+                           big_bird.BigBirdForMaskedLM,
+                           C.load_big_bird_state_dict),
         "deberta-v2": _Entry(deberta.DebertaV2Config,
                              deberta.DebertaV2ForMaskedLM,
                              C.load_deberta_v2_state_dict),

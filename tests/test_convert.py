@@ -1387,3 +1387,37 @@ def test_blenderbot_small_logits_match_transformers():
                  decoder_input_ids=torch.tensor(tgt)).logits.numpy()
     got = np.asarray(ours(jnp.asarray(src), jnp.asarray(tgt)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_big_bird_mlm_logits_match_transformers():
+    """BigBird in original_full mode (dense attention, gelu_new): MLM
+    logits match HF."""
+    import torch
+    from transformers import BigBirdConfig as HFConfig
+    from transformers import BigBirdForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=64,
+                          attention_type="original_full",
+                          rescale_embeddings=False,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.big_bird import BigBirdConfig, BigBirdForMaskedLM
+    from paddle_tpu.models.convert import load_big_bird_state_dict
+
+    pt.seed(0)
+    cfg = BigBirdConfig.tiny(vocab_size=96)
+    ours = load_big_bird_state_dict(BigBirdForMaskedLM(cfg).eval(),
+                                    hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 token_type_ids=torch.tensor(tt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids),
+                          token_type_ids=jnp.asarray(tt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
