@@ -46,6 +46,9 @@ from paddle_tpu.models.gptj import (CodeGenConfig, CodeGenForCausalLM,
 from paddle_tpu.models.layoutlm import (LayoutLMConfig,
                                         LayoutLMForMaskedLM, LayoutLMModel)
 from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+from paddle_tpu.models.megatron_bert import (MegatronBertConfig,
+                                             MegatronBertForMaskedLM,
+                                             MegatronBertModel)
 from paddle_tpu.models.mpnet import (MPNetConfig, MPNetForMaskedLM,
                                      MPNetModel)
 from paddle_tpu.models.nezha import (NezhaConfig, NezhaForMaskedLM,

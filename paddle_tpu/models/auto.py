@@ -34,7 +34,8 @@ def _registry():
     from paddle_tpu.models import albert, big_bird, deberta, distilbert
     from paddle_tpu.models import layoutlm
     from paddle_tpu.models import bart, bert, bloom, electra, ernie, falcon
-    from paddle_tpu.models import ernie_m, fnet, mpnet, nezha, roformer
+    from paddle_tpu.models import ernie_m, fnet, megatron_bert, mpnet
+    from paddle_tpu.models import nezha, roformer
     from paddle_tpu.models import gemma, glm, gpt, gpt_neox, gptj, llama
     from paddle_tpu.models import mixtral, opt, phi, qwen, qwen2_moe
     from paddle_tpu.models import roberta, t5
@@ -115,6 +116,9 @@ def _registry():
                            C.load_roformer_state_dict),
         "fnet": _Entry(fnet.FNetConfig, fnet.FNetForMaskedLM,
                        C.load_fnet_state_dict),
+        "megatron-bert": _Entry(megatron_bert.MegatronBertConfig,
+                                megatron_bert.MegatronBertForMaskedLM,
+                                C.load_megatron_bert_state_dict),
         "mpnet": _Entry(mpnet.MPNetConfig, mpnet.MPNetForMaskedLM,
                         C.load_mpnet_state_dict),
         "nezha": _Entry(nezha.NezhaConfig, nezha.NezhaForMaskedLM,

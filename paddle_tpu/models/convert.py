@@ -1514,3 +1514,55 @@ def load_big_bird_state_dict(model, state_dict, dtype=None):
             sp["cls.predictions.transform.LayerNorm.bias"])
         model.mlm_bias = j(sp["cls.predictions.bias"])
     return model
+
+
+def load_megatron_bert_state_dict(model, state_dict, dtype=None):
+    """Populate a ``MegatronBertForMaskedLM``/``MegatronBertModel`` from
+    an HF state_dict (pre-LN layout: attention.ln / layer.ln / final
+    encoder.ln)."""
+    dtype = dtype or jnp.float32
+    sd = {k.removeprefix("bert."): _np(v) for k, v in state_dict.items()}
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def lin(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"].T)
+        layer.bias = j(sd[prefix + ".bias"])
+
+    def ln(layer, prefix):
+        layer.weight = j(sd[prefix + ".weight"])
+        layer.bias = j(sd[prefix + ".bias"])
+
+    mb = model.bert if hasattr(model, "bert") else model
+    mb.word_embeddings.weight = j(sd["embeddings.word_embeddings.weight"])
+    mb.position_embeddings.weight = j(
+        sd["embeddings.position_embeddings.weight"])
+    mb.token_type_embeddings.weight = j(
+        sd["embeddings.token_type_embeddings.weight"])
+    ln(mb.final_ln, "encoder.ln")
+    for i, lyr in enumerate(mb.layers):
+        p = f"encoder.layer.{i}."
+        ln(lyr.attn_ln, p + "attention.ln")
+        lin(lyr.q_proj, p + "attention.self.query")
+        lin(lyr.k_proj, p + "attention.self.key")
+        lin(lyr.v_proj, p + "attention.self.value")
+        lin(lyr.out_proj, p + "attention.output.dense")
+        ln(lyr.ff_ln, p + "ln")
+        lin(lyr.intermediate, p + "intermediate.dense")
+        lin(lyr.output, p + "output.dense")
+    if "pooler.dense.weight" in sd:
+        lin(mb.pooler, "pooler.dense")
+    if hasattr(model, "mlm_transform") and \
+            "cls.predictions.bias" in state_dict:
+        sp = {k: _np(v) for k, v in state_dict.items()}
+        model.mlm_transform.weight = j(
+            sp["cls.predictions.transform.dense.weight"].T)
+        model.mlm_transform.bias = j(
+            sp["cls.predictions.transform.dense.bias"])
+        model.mlm_norm.weight = j(
+            sp["cls.predictions.transform.LayerNorm.weight"])
+        model.mlm_norm.bias = j(
+            sp["cls.predictions.transform.LayerNorm.bias"])
+        model.mlm_bias = j(sp["cls.predictions.bias"])
+    return model

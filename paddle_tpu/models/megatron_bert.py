@@ -1,0 +1,127 @@
+"""MegatronBERT (ref: PaddleNLP ``paddlenlp/transformers/megatronbert``).
+
+The PRE-LN BERT: every sublayer norms its INPUT (residual stays on the
+raw stream), embeddings carry no LayerNorm (the first block's pre-LN
+covers it), and the encoder ends with a final LN — the arrangement that
+made large-scale BERT training stable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layers import Embedding, LayerNorm, Linear
+from paddle_tpu.ops import attention as A
+
+
+@dataclass
+class MegatronBertConfig:
+    vocab_size: int = 29056
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    type_vocab_size: int = 2
+    max_position_embeddings: int = 512
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(**kw):
+        return MegatronBertConfig(**{**dict(vocab_size=128, hidden_size=32,
+                                            num_hidden_layers=2,
+                                            num_attention_heads=2,
+                                            intermediate_size=64,
+                                            max_position_embeddings=64),
+                                     **kw})
+
+
+class MegatronBertLayer(Module):
+    def __init__(self, cfg: MegatronBertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.attn_ln = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                 dtype=cfg.dtype)
+        self.q_proj = Linear(h, h, dtype=cfg.dtype)
+        self.k_proj = Linear(h, h, dtype=cfg.dtype)
+        self.v_proj = Linear(h, h, dtype=cfg.dtype)
+        self.out_proj = Linear(h, h, dtype=cfg.dtype)
+        self.ff_ln = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                               dtype=cfg.dtype)
+        self.intermediate = Linear(h, cfg.intermediate_size, dtype=cfg.dtype)
+        self.output = Linear(cfg.intermediate_size, h, dtype=cfg.dtype)
+        self.heads = cfg.num_attention_heads
+
+    def __call__(self, x, attn_mask=None):
+        b, s, hd = x.shape
+        nh = self.heads
+        d = hd // nh
+        hin = self.attn_ln(x)
+        q = self.q_proj(hin).reshape(b, s, nh, d)
+        k = self.k_proj(hin).reshape(b, s, nh, d)
+        v = self.v_proj(hin).reshape(b, s, nh, d)
+        att = A.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+        x = x + self.out_proj(att.reshape(b, s, hd))
+        return x + self.output(F.gelu(self.intermediate(self.ff_ln(x))))
+
+
+class MegatronBertModel(Module):
+    def __init__(self, cfg: MegatronBertConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        h = cfg.hidden_size
+        self.word_embeddings = Embedding(cfg.vocab_size, h,
+                                         weight_init=init, dtype=cfg.dtype)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, h,
+                                             weight_init=init,
+                                             dtype=cfg.dtype)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, h,
+                                               weight_init=init,
+                                               dtype=cfg.dtype)
+        self.layers = [MegatronBertLayer(cfg)
+                       for _ in range(cfg.num_hidden_layers)]
+        self.final_ln = LayerNorm(h, epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.pooler = Linear(h, h, dtype=cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is not None:
+            attention_mask = (1.0 - attention_mask[:, None, None, :]
+                              .astype(jnp.float32)) * -1e9
+        # NO embedding LayerNorm — pre-LN blocks norm their own input
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(jnp.arange(s)[None, :])
+             + self.token_type_embeddings(token_type_ids))
+        for lyr in self.layers:
+            x = lyr(x, attn_mask=attention_mask)
+        x = self.final_ln(x)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class MegatronBertForMaskedLM(Module):
+    def __init__(self, cfg: MegatronBertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = MegatronBertModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    dtype=cfg.dtype)
+        self.mlm_norm = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps,
+                                  dtype=cfg.dtype)
+        self.mlm_bias = jnp.zeros((cfg.vocab_size,), cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        return h @ self.bert.word_embeddings.weight.T + self.mlm_bias

@@ -1421,3 +1421,36 @@ def test_big_bird_mlm_logits_match_transformers():
     got = np.asarray(ours(jnp.asarray(ids),
                           token_type_ids=jnp.asarray(tt)), np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_megatron_bert_mlm_logits_match_transformers():
+    """MegatronBERT (pre-LN BERT, no embedding LN, final encoder LN):
+    MLM logits match HF."""
+    import torch
+    from transformers import MegatronBertConfig as HFConfig
+    from transformers import MegatronBertForMaskedLM as HFModel
+
+    torch.manual_seed(0)
+    hf = HFModel(HFConfig(vocab_size=96, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=64,
+                          attn_implementation="eager")).eval()
+
+    from paddle_tpu.models.convert import load_megatron_bert_state_dict
+    from paddle_tpu.models.megatron_bert import (MegatronBertConfig,
+                                                 MegatronBertForMaskedLM)
+
+    pt.seed(0)
+    cfg = MegatronBertConfig.tiny(vocab_size=96)
+    ours = load_megatron_bert_state_dict(
+        MegatronBertForMaskedLM(cfg).eval(), hf.state_dict())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (2, 12))
+    tt = rs.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 token_type_ids=torch.tensor(tt)).logits.numpy()
+    got = np.asarray(ours(jnp.asarray(ids),
+                          token_type_ids=jnp.asarray(tt)), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
