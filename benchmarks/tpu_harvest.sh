@@ -1,0 +1,139 @@
+#!/bin/bash
+# Self-harvesting TPU-tunnel watcher (VERDICT r3 item #1).
+#
+# Replaces the passive probe loop: every POLL seconds, probe the tunnel with
+# a real matmul; the moment a probe succeeds, execute the run-FIRST list
+# unattended, in order, each step with its own timeout and a persistent
+# done-marker so an interrupted window resumes where it left off:
+#
+#   1. benchmarks/tpu_probe.py      — Mosaic validation of every Pallas leg
+#   2. benchmarks/_perf_banded.py   — banded-grid experiment matrix
+#   3. python bench.py              — headline MFU (artifact replayed by
+#                                     bench.py if the tunnel later dies)
+#   4. benchmarks/_perf_attn.py     — flash-vs-XLA microbench
+#   5. benchmarks/_perf_sweep2.py   — remat/scan step sweeps
+#
+# Known tunnel hazards handled (NOTES_ROUND4): a hung client wedges the
+# tunnel for later processes -> stragglers are killed before every probe;
+# block_until_ready is a no-op over the tunnel -> the scripts sync via
+# float() fetch themselves.  Artifacts land in benchmarks/artifacts/.
+set -u
+REPO=/root/repo
+ART=$REPO/benchmarks/artifacts
+STATE=$ART/state
+LOG=$REPO/.tpu_watch.log
+HLOG=$ART/harvest.log
+POLL=${POLL:-120}
+mkdir -p "$STATE"
+
+# single-instance guard
+PIDFILE=$ART/harvest.pid
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+  echo "harvester already running (pid $(cat "$PIDFILE"))" >&2
+  exit 0
+fi
+echo $$ > "$PIDFILE"
+
+note() { echo "$(date +%F' '%H:%M:%S) $*" >> "$HLOG"; }
+
+kill_stragglers() {
+  # any leftover python running our bench/probe scripts can wedge the tunnel
+  for pat in tpu_probe.py _perf_banded.py _perf_attn.py _perf_sweep2.py \
+             _perf_breakdown.py _perf_experiment.py "bench.py"; do
+    pgrep -f "python.*$pat" | while read -r p; do
+      [ "$p" != "$$" ] && kill -9 "$p" 2>/dev/null
+    done
+  done
+}
+
+probe() {
+  out=$(timeout 75 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256,256)); v = float(jnp.sum(x@x))
+print('UP', d[0].platform, d[0])" 2>/dev/null | tail -1)
+  case "$out" in
+    UP\ tpu*) echo "UP $out"; return 0 ;;
+    UP*)      echo "NONTPU $out"; return 1 ;;
+    *)        echo "DOWN"; return 1 ;;
+  esac
+}
+
+# run_step <name> <timeout_s> <max_attempts> <cmd...>
+run_step() {
+  name=$1; tmo=$2; maxtry=$3; shift 3
+  [ -f "$STATE/$name.done" ] && return 0
+  tries=$(cat "$STATE/$name.attempts" 2>/dev/null || echo 0)
+  if [ "$tries" -ge "$maxtry" ]; then return 0; fi
+  echo $((tries + 1)) > "$STATE/$name.attempts"
+  ts=$(date +%m%d_%H%M%S)
+  out="$ART/${name}_${ts}.log"
+  note "step $name attempt $((tries + 1)) -> $out"
+  ( cd "$REPO" && timeout "$tmo" "$@" ) > "$out" 2>&1
+  rc=$?
+  note "step $name rc=$rc"
+  if [ "$rc" -eq 0 ]; then
+    touch "$STATE/$name.done"
+    cp "$out" "$ART/${name}_LAST_GOOD.log"
+  fi
+  return "$rc"
+}
+
+harvest() {
+  # steps in the VERDICT's priority order; a failing step never blocks
+  # the later ones. Between steps, re-probe cheaply: if the tunnel died
+  # mid-window, bail out and resume at the next UP.
+  run_step probe_quick 420 4 python benchmarks/tpu_probe.py --quick
+  probe >/dev/null || return
+  run_step probe_full 900 4 python benchmarks/tpu_probe.py
+  probe >/dev/null || return
+  run_step banded 1200 3 python benchmarks/_perf_banded.py
+  probe >/dev/null || return
+  if [ ! -f "$STATE/bench.done" ]; then
+    tries=$(cat "$STATE/bench.attempts" 2>/dev/null || echo 0)
+    if [ "$tries" -lt 4 ]; then
+      echo $((tries + 1)) > "$STATE/bench.attempts"
+      ts=$(date +%m%d_%H%M%S)
+      out="$ART/bench_${ts}.log"
+      note "step bench attempt $((tries + 1)) -> $out"
+      ( cd "$REPO" && timeout 2400 python bench.py ) > "$out" 2>&1
+      # success = last line parses as JSON without "degraded": true
+      if tail -1 "$out" | python -c "
+import json, sys
+d = json.loads(sys.stdin.readline())
+sys.exit(1 if d.get('degraded') else 0)" 2>/dev/null; then
+        tail -1 "$out" > "$ART/bench_onchip.json"
+        touch "$STATE/bench.done"
+        note "step bench SUCCESS (on-chip result saved)"
+      else
+        note "step bench degraded/failed"
+      fi
+    fi
+  fi
+  probe >/dev/null || return
+  run_step perf_attn 900 3 python benchmarks/_perf_attn.py
+  probe >/dev/null || return
+  run_step perf_sweep 1800 2 python benchmarks/_perf_sweep2.py
+}
+
+note "harvester start (pid $$, poll ${POLL}s)"
+while true; do
+  ts=$(date +%H:%M:%S)
+  kill_stragglers
+  if st=$(probe); then
+    echo "$ts $st" >> "$LOG"
+    if ls "$STATE"/*.done >/dev/null 2>&1 \
+       && [ -f "$STATE/probe_full.done" ] && [ -f "$STATE/banded.done" ] \
+       && [ -f "$STATE/bench.done" ] && [ -f "$STATE/perf_attn.done" ] \
+       && [ -f "$STATE/perf_sweep.done" ]; then
+      : # everything harvested; stay as a plain watcher
+    else
+      note "tunnel UP -> harvesting"
+      harvest
+      note "harvest pass done"
+    fi
+  else
+    echo "$ts $st" >> "$LOG"
+  fi
+  sleep "$POLL"
+done
