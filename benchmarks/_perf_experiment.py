@@ -1,3 +1,5 @@
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 """Perf sweep on the real chip: remat policy x batch size."""
 import time, json, sys
 import jax, jax.numpy as jnp, numpy as np

@@ -11,8 +11,11 @@ exactly what this script exists to catch (see .claude/skills/verify).
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -34,6 +34,15 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 _float0 = jax.dtypes.float0
 
+# Declaring the (batch-head, major, minor) grid as (parallel, parallel,
+# arbitrary) lets Mosaic pipeline DMAs across grid steps instead of
+# serialising them. Measured on v5e (benchmarks/_perf_banded.py, S=4096
+# w=1024, dispatch floor subtracted): full causal 3.25ms -> 0.92ms, banded
+# 2.12ms -> 0.77ms — and only WITH this declared does the banded O(S*W)
+# grid actually beat full causal on-chip (r3 finding: 6.5x slower without).
+_GRID_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY))
+
 
 def _band_mask(s, i, j, block_q, block_k, causal, window, q_off, klen=None,
                sk=None):
@@ -245,6 +254,7 @@ def _flash_fwd(q, k, v, lens, slopes, *, scale, causal, window, kv_rep,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(*args)
     return out, lse
@@ -416,6 +426,7 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(*dq_args)
 
@@ -455,6 +466,7 @@ def _flash_bwd(res, g, *, scale, causal, window, kv_rep, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(*dkv_args)
     if kv_rep > 1:
