@@ -403,10 +403,15 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # remat=False + unrolled layers: the r4 on-chip sweep
+        # (benchmarks/_perf_sweep2.py) measured 36.5% MFU vs 30.6% for
+        # remat+scan at this size — the 0.7B model's activations fit v5e
+        # HBM without remat, and scan_layers hit an axon remote-compile
+        # bug on-chip (HTTP 500, logged in benchmarks/artifacts/sweep2_*)
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
                           num_hidden_layers=12, num_attention_heads=16,
                           num_key_value_heads=16, max_position_embeddings=2048,
-                          dtype=jnp.bfloat16, remat=True, scan_layers=True)
+                          dtype=jnp.bfloat16, remat=False, scan_layers=False)
         batch, seq, iters = 4, 2048, 20
     else:  # CPU smoke: same code path, tiny shapes
         cfg = LlamaConfig.tiny()
@@ -471,6 +476,12 @@ def main():
     # the other four BASELINE configs (one JSON line total — they ride in
     # extra.configs; the LLaMA MFU stays the headline). A config that
     # fails records its error and never takes the others down.
+    # Free the headline model first: its AdamW fp32-master state is ~10.5GB
+    # of the 16GB v5e HBM, which starved the gpt3/moe configs into
+    # RESOURCE_EXHAUSTED (r3 harvest finding).
+    n_params = model.num_parameters()
+    device_str = str(jax.devices()[0])
+    del state, model, step
     configs = {}
     for name, fn in (("resnet50", bench_resnet50),
                      ("bert_base_dp", bench_bert_dp),
@@ -484,7 +495,6 @@ def main():
 
     # honest config label: the CPU-smoke fallback runs LlamaConfig.tiny(),
     # not the 0.8B geometry — name the metric by what actually ran
-    n_params = model.num_parameters()
     size_tag = f"{n_params / 1e9:.1f}b" if n_params >= 5e7 else f"{n_params:,}-param smoke"
     print(json.dumps({
         "metric": f"llama-{size_tag} bf16 train step tokens/sec/chip (MFU in extra)",
@@ -498,7 +508,7 @@ def main():
             "params": n_params,
             "batch": batch, "seq": seq,
             "loss": loss_val,
-            "device": str(jax.devices()[0]),
+            "device": device_str,
             "configs": configs,
         },
     }))

@@ -1,0 +1,83 @@
+"""On-chip diagnostic: WHERE does the mainline banded slowness live?
+
+_perf_banded.py (round-3) proved the standalone banded grid variants are
+fast (band_arith_par 0.77ms net at S=4096/w=1024) once dimension_semantics
+is declared — and that declaration is now in the mainline kernel. Yet
+tpu_probe still measures mainline banded at 57ms vs 2.9ms full (S=1024,
+w=256, fwd-only). This script times the MAINLINE flash_attention at the
+probe's exact shapes, fwd and fwd+bwd separately, against full causal,
+plus the no-op dispatch floor — to localise the regression (fwd grid?
+dq grid? dkv grid? dispatch?).
+"""
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+ITERS = 20
+
+
+def timeit(f, *args):
+    out = f(*args)
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(x.astype(jnp.float32))), out)  # compile+sync
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = f(*args)
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(x.astype(jnp.float32))), out)
+    return (time.perf_counter() - t0) / ITERS
+
+
+def run(tag, b, h, s, w):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, s, h, 128), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, s, h, 128), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, s, h, 128), jnp.bfloat16)
+
+    fwd_full = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    fwd_band = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                       window=w))
+
+    def loss_full(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True
+                                       ).astype(jnp.float32) ** 2)
+
+    def loss_band(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, window=w
+                                       ).astype(jnp.float32) ** 2)
+
+    bwd_full = jax.jit(jax.grad(loss_full, argnums=(0, 1, 2)))
+    bwd_band = jax.jit(jax.grad(loss_band, argnums=(0, 1, 2)))
+
+    t_ff = timeit(fwd_full, q, k, v)
+    t_fb = timeit(fwd_band, q, k, v)
+    t_bf = timeit(bwd_full, q, k, v)
+    t_bb = timeit(bwd_band, q, k, v)
+    print(f"{tag}: fwd full {t_ff*1e3:8.3f}  fwd band {t_fb*1e3:8.3f}  "
+          f"bwd full {t_bf*1e3:8.3f}  bwd band {t_bb*1e3:8.3f}  (ms)",
+          flush=True)
+
+
+def main():
+    nop = jax.jit(lambda x: x + 1)
+    x0 = jnp.zeros((8, 128), jnp.bfloat16)
+    t = timeit(nop, x0)
+    print(f"dispatch/no-op: {t*1e3:.3f} ms", flush=True)
+    run("probe-shape  B1 H2 S1024 w256 ", 1, 2, 1024, 256)
+    run("probe-full   B1 H2 S4096 w1024", 1, 2, 4096, 1024)
+    run("bigger       B4 H8 S4096 w1024", 4, 8, 4096, 1024)
+
+
+if __name__ == "__main__":
+    main()

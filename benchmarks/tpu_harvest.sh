@@ -113,7 +113,8 @@ sys.exit(1 if d.get('degraded') else 0)" 2>/dev/null; then
   probe >/dev/null || return
   run_step perf_attn 900 3 python benchmarks/_perf_attn.py
   probe >/dev/null || return
-  run_step perf_sweep 1800 2 python benchmarks/_perf_sweep2.py
+  run_step perf_sweep 1800 2 python benchmarks/_perf_sweep2.py \
+    noremat_scan noremat_unroll remat_unroll noremat_scan_b8
 }
 
 note "harvester start (pid $$, poll ${POLL}s)"

@@ -87,17 +87,29 @@ def flash_banded(s=4096, w=1024):
 
     # the banded grid must beat full-causal on wall clock at w << S
     def timeit(f):
-        f().block_until_ready()
+        # warmup MUST sync via a host fetch: block_until_ready is a no-op
+        # over the axon tunnel, and without the fetch the banded variant's
+        # Mosaic compile lands inside the timed loop (the r3 "6.5x slower"
+        # and r4 "653ms" findings were THIS, not kernel slowness —
+        # benchmarks/_perf_banded2.py times the same kernels at 1.7-1.8x
+        # FASTER than full causal once warmed correctly)
+        float(jnp.sum(f().astype(jnp.float32)))
         t0 = time.perf_counter()
         for _ in range(10):
             out = f()
         float(jnp.sum(out.astype(jnp.float32)))  # tunnel-safe sync
         return (time.perf_counter() - t0) / 10
 
-    t_band = timeit(jax.jit(lambda: flash_attention(q, k, v, causal=True,
-                                                    window=w)))
-    t_full = timeit(jax.jit(lambda: flash_attention(q, k, v, causal=True)))
-    print(f"   banded {t_band*1e3:.2f}ms vs full {t_full*1e3:.2f}ms")
+    # time at compute-dominated shapes (B4/H8): at B1/H2 both variants sit
+    # on the ~3.4ms tunnel dispatch floor and the comparison is noise
+    st, wt = (2048, 512) if s < 4096 else (s, w)
+    rs2 = np.random.RandomState(9)
+    qt, kt, vt = _qkv(rs2, 4, st, 8, 128)
+    t_band = timeit(jax.jit(lambda: flash_attention(qt, kt, vt, causal=True,
+                                                    window=wt)))
+    t_full = timeit(jax.jit(lambda: flash_attention(qt, kt, vt, causal=True)))
+    print(f"   banded {t_band*1e3:.2f}ms vs full {t_full*1e3:.2f}ms "
+          f"(B4 H8 S{st} w{wt})")
     assert t_band < t_full, "banded grid is not faster than full causal"
 
 

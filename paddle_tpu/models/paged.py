@@ -90,13 +90,19 @@ class BlockManager:
         """Ensure seq_id owns enough blocks for n_tokens; grow as needed."""
         table = self.tables.setdefault(seq_id, [])
         need = self.blocks_needed(n_tokens) - len(table)
-        if need > len(self._free):
+        if need > self.free_blocks:
             raise MemoryError(
                 f"paged cache out of blocks: need {need}, "
-                f"free {len(self._free)} (of {self.num_blocks})")
+                f"free {self.free_blocks} (of {self.num_blocks})")
         for _ in range(max(need, 0)):
-            table.append(self._free.pop())
+            table.append(self._pop_free())
         return table
+
+    def _pop_free(self) -> int:
+        """Take one block off the free list (prefix-cache eviction hook)."""
+        if not self._free:
+            raise MemoryError("paged cache out of blocks")
+        return self._free.pop()
 
     def free(self, seq_id: int):
         self._free.extend(b for b in reversed(self.tables.pop(seq_id, []))
@@ -174,15 +180,15 @@ class RefBlockManager(BlockManager):
         for blk in (table[:-1] if partial else table):
             if blk is None:   # window-recycled placeholder: nothing shared
                 continue
-            self._rc[blk] += 1
+            self._retain(blk)
         # the fork inherits the recycled-prefix marker: table_array's fast
         # path and future free_prefix scans key on it
         if src_id in self._prefix_done:
             self._prefix_done[dst_id] = self._prefix_done[src_id]
         if partial:
-            if not self._free:
+            if not self.free_blocks:
                 raise MemoryError("paged cache out of blocks for beam fork")
-            fresh = self._free.pop()
+            fresh = self._pop_free()
             self._rc[fresh] = 1
             copy = (table[-1], fresh)
             table[-1] = fresh
@@ -204,6 +210,121 @@ class RefBlockManager(BlockManager):
         if self._rc[blk] == 0:
             del self._rc[blk]
             self._free.append(blk)
+
+    def _retain(self, blk):
+        """Take one more reference on a live block (beam fork sharing)."""
+        self._rc[blk] = self._rc.get(blk, 0) + 1
+
+
+class PrefixCachingBlockManager(RefBlockManager):
+    """RefBlockManager + cross-request prefix reuse (ref capability:
+    PaddleNLP ``llm/predict`` block-attention serving; vLLM-style
+    hash-block caching).
+
+    Every FULL block of a committed prompt gets a content chain hash
+    ``sha1(parent_digest || block token bytes)`` — the digest identifies
+    the whole prefix up to and including the block, so equal digests mean
+    equal KV contents (the pool is append-only and KV is a deterministic
+    function of the token prefix). Blocks whose refcount drops to zero
+    but that carry a digest are PARKED in an LRU ``evictable`` pool (still
+    resident in HBM) instead of the free list; a later request whose
+    prompt chain-hashes onto them re-shares the blocks (rc+1, zero
+    recompute) and prefills only the uncached suffix. When the free list
+    runs dry, allocation evicts parked blocks LRU-first — so caching
+    never reduces usable capacity."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        super().__init__(num_blocks, block_size)
+        import collections
+        self._hash_to_block: dict[bytes, int] = {}
+        self._block_hash: dict[int, bytes] = {}
+        self._evictable = collections.OrderedDict()   # blk -> None, LRU order
+        self.cache_stats = {"hit_blocks": 0, "evictions": 0}
+
+    # ---- capacity: parked blocks are reclaimable, so they count as free
+    @property
+    def free_blocks(self):
+        return len(self._free) + len(self._evictable)
+
+    def _pop_free(self):
+        if self._free:
+            return self._free.pop()
+        if self._evictable:
+            blk, _ = self._evictable.popitem(last=False)     # LRU eviction
+            h = self._block_hash.pop(blk, None)
+            if h is not None and self._hash_to_block.get(h) == blk:
+                del self._hash_to_block[h]
+            self.cache_stats["evictions"] += 1
+            return blk
+        raise MemoryError("paged cache out of blocks")
+
+    def _release(self, blk):
+        self._rc[blk] -= 1
+        if self._rc[blk] == 0:
+            del self._rc[blk]
+            if blk in self._block_hash:       # park, MRU end
+                self._evictable[blk] = None
+                self._evictable.move_to_end(blk)
+            else:
+                self._free.append(blk)
+
+    def _retain(self, blk):
+        if blk in self._evictable:            # revive a parked block
+            del self._evictable[blk]
+        super()._retain(blk)
+
+    # ------------------------------------------------------------ hashing
+    def _chain_digests(self, tokens, n_full):
+        import hashlib
+        toks = np.asarray(tokens, np.int32)
+        digest = b""
+        out = []
+        for i in range(n_full):
+            digest = hashlib.sha1(
+                digest + toks[i * self.block_size:
+                              (i + 1) * self.block_size].tobytes()).digest()
+            out.append(digest)
+        return out
+
+    def match_prefix(self, tokens) -> list[int]:
+        """Longest run of resident full-block prefix matches for this
+        prompt. Capped at (len-1)//block_size so at least the last prompt
+        token is always prefilled — its logits seed the first sample."""
+        n_full = (len(tokens) - 1) // self.block_size
+        blocks = []
+        for d in self._chain_digests(tokens, n_full):
+            blk = self._hash_to_block.get(d)
+            if blk is None:
+                break
+            blocks.append(blk)
+        return blocks
+
+    def adopt_prefix(self, seq_id, blocks):
+        """Install shared cached blocks as seq_id's table prefix (rc+1
+        each; parked blocks are revived). The caller prefills from
+        ``len(blocks) * block_size`` onward."""
+        assert seq_id not in self.tables
+        for blk in blocks:
+            self._retain(blk)
+        self.tables[seq_id] = list(blocks)
+        self.cache_stats["hit_blocks"] += len(blocks)
+        return self.tables[seq_id]
+
+    def commit_prefix(self, seq_id, tokens):
+        """Register chain digests for seq_id's full prompt blocks so later
+        requests can share them. First-writer-wins per digest; safe to call
+        before the prefill has executed on device — any matching request's
+        program consumes the pool AFTER this one's writes (jax data
+        dependency orders them)."""
+        table = self.tables.get(seq_id, [])
+        n_full = min(len(tokens) // self.block_size, len(table))
+        for i, d in enumerate(self._chain_digests(tokens, n_full)):
+            blk = table[i]
+            if blk is None:
+                break                          # window-recycled: stop
+            if d not in self._hash_to_block and blk not in self._block_hash:
+                self._hash_to_block[d] = blk
+                self._block_hash[blk] = d
 
 
 def _rope_rows(positions, head_dim, base, scaling=None, max_pos=None):

@@ -29,8 +29,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Swept on v5e (benchmarks/_perf_blocks.py, B4 S2048 H16 D128 causal):
+# 128/128 ran 9.9ms fwd / 29.6ms fwd+bwd; 512/1024 runs 4.5 / 14.0 —
+# a single 128^3 MXU issue per grid step can't hide the loop overhead.
+# (1024/1024 measured equal within noise; 512 keeps the q tile usable
+# at shorter sequence lengths.)
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 _float0 = jax.dtypes.float0
 
@@ -552,8 +557,19 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else d ** -0.5
-    bq = min(block_q, s)
-    bk = min(block_k, sk)
+
+    def _fit(blk, n):
+        # largest power-of-two divisor step down from the requested block:
+        # a non-dividing block would pad the grid and the padded key
+        # columns (k_idx in [sk, nk*bk)) pass the causal mask for late
+        # query rows — garbage would enter the softmax
+        blk = min(blk, n)
+        while n % blk:
+            blk //= 2
+        return max(blk, 1)
+
+    bq = _fit(block_q, s)
+    bk = _fit(block_k, sk)
 
     def to_bh(x):
         return jnp.swapaxes(x, 1, 2).reshape(-1, x.shape[1], d)

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.models.decoding import _sample_rows
-from paddle_tpu.models.paged import (PagedKVCache, RefBlockManager,
+from paddle_tpu.models.paged import (PagedKVCache, PrefixCachingBlockManager,
                                      _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
                                      _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
                                      _PREFILL_JIT, _TICK_JIT)
@@ -62,6 +62,10 @@ class Request:
     done: bool = False
     finish_reason: str = None
     beam_score: float = None
+    # set on preemption: prompt + tokens generated so far — the resume
+    # prefill recomputes the whole sequence (prefix-cache hits make the
+    # recompute cheap when its old blocks are still parked)
+    _resume: object = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -95,7 +99,7 @@ class LLMEngine:
     def __init__(self, model, *, num_slots=8, block_size=16,
                  max_prompt_len=128, max_seq_len=None, num_blocks=None,
                  eos_token_id=None, temperature=0.0, top_k=None, top_p=None,
-                 seed=0):
+                 seed=0, prefix_caching=True, preemption=False):
         cfg = model.cfg
         self.model = model
         self.num_slots = num_slots
@@ -105,9 +109,11 @@ class LLMEngine:
         self.max_blocks_per_seq = -(-self.max_seq_len // block_size)
         if num_blocks is None:
             num_blocks = num_slots * self.max_blocks_per_seq
-        # refcounted: beam groups share prompt blocks copy-on-write; for
-        # unforked (greedy) sequences it behaves exactly like BlockManager
-        self.mgr = RefBlockManager(num_blocks, block_size)
+        # refcounted + content-hashed: beam groups share prompt blocks
+        # copy-on-write; requests with equal prompt prefixes share the
+        # prefix blocks outright (prefill only runs on the uncached
+        # suffix); with no sharing it behaves exactly like BlockManager
+        self.mgr = PrefixCachingBlockManager(num_blocks, block_size)
         self.eos_token_id = eos_token_id
         # engine defaults; each request may override temperature/top_p
         # (top_k stays engine-global — it is a static compile parameter)
@@ -122,6 +128,18 @@ class LLMEngine:
         # >= lens - window, masking everything below) — recycle them,
         # bounding live blocks per sequence by O(window), not O(length)
         self.window = getattr(cfg, "sliding_window", None)
+        self._dyn_rope = (getattr(cfg, "rope_scaling", None)
+                          or {}).get("type") == "dynamic"
+        # prefix caching is sound only when a block's KV is a function of
+        # its token prefix alone: windowed recycling punches holes in the
+        # table, and dynamic-NTK makes KV depend on the FULL prompt length
+        self.prefix_caching = bool(prefix_caching) and self.window is None \
+            and not self._dyn_rope
+        # preemption: admit optimistically (no worst-case reservation for
+        # greedy requests; beams keep theirs) and, on out-of-blocks,
+        # preempt the youngest greedy slot — it re-queues with
+        # resume-prompt = prompt + generated-so-far and recomputes
+        self.preemption = bool(preemption)
 
         self.cache = PagedKVCache.init(
             cfg.num_hidden_layers, num_blocks, block_size,
@@ -154,7 +172,10 @@ class LLMEngine:
         # host-vs-device split of decode ticks (admission ticks excluded):
         # stats["host_s"] is scheduling/bookkeeping, stats["device_s"] the
         # jitted tick incl. the [num_slots] token fetch
-        self.stats = {"host_s": 0.0, "device_s": 0.0, "ticks": 0}
+        self.stats = {"host_s": 0.0, "device_s": 0.0, "ticks": 0,
+                      "preemptions": 0}
+        self._adm_counter = 0                # admission recency, per slot
+        self.adm_order = np.zeros(num_slots, np.int64)
 
     # ------------------------------------------------------------- intake
     def add_request(self, req: Request) -> int:
@@ -230,6 +251,15 @@ class LLMEngine:
         return (bool(self.queue) or bool(self.active.any())
                 or bool(self.groups) or bool(self.prefilling))
 
+    def _pr(self, req) -> np.ndarray:
+        """Effective prompt: the resume form (original prompt + tokens
+        generated before a preemption), the original prompt otherwise."""
+        return req.prompt if req._resume is None else req._resume
+
+    def _remaining(self, req) -> int:
+        """max_new_tokens still to generate (tokens survive preemption)."""
+        return req.max_new_tokens - len(req.tokens)
+
     def _worst_case_blocks(self, req) -> int:
         """Blocks a request can ever hold at once. Windowed models recycle
         below-window blocks, so the live span is bounded by the window
@@ -240,17 +270,18 @@ class LLMEngine:
         the generated span (straddling ≤ ceil(new/bs)+1 blocks), plus 2
         per beam for the copy-on-write partial forks (one held, one
         transient while the new fork exists before the parent is freed)."""
+        p = len(self._pr(req))
         if req.num_beams > 1:
             k = req.num_beams
-            return (self.mgr.blocks_needed(len(req.prompt))
+            return (self.mgr.blocks_needed(p)
                     + k * (self.mgr.blocks_needed(
                         req.max_new_tokens + self.block_size) + 2))
-        total = len(req.prompt) + req.max_new_tokens
+        total = p + self._remaining(req)
         if self.window is None:
             return self.mgr.blocks_needed(total)
         live = self.mgr.blocks_needed(
             min(total, self.window + 2 * self.block_size))
-        return max(self.mgr.blocks_needed(len(req.prompt)), live)
+        return max(self.mgr.blocks_needed(p), live)
 
     # ---------------------------------------------------------- admission
     def _admit(self):
@@ -262,24 +293,46 @@ class LLMEngine:
         while self.queue and free_slots:
             req = self.queue[0]
             k = req.num_beams
-            need = self._worst_case_blocks(req)
+            p = self._pr(req)
+            # prefix-cache lookup BEFORE the capacity gate: shared blocks
+            # cost nothing, so a mostly-cached prompt admits under
+            # pressure an uncached one would wait out
+            cached = (self.mgr.match_prefix(p)
+                      if self.prefix_caching and k == 1 else [])
+            ct = len(cached) * self.block_size
+            if self.preemption and k == 1:
+                # optimistic: cover only the first prefill chunk (+1
+                # decode-headroom block); out-of-blocks later preempts
+                need = (self.mgr.blocks_needed(
+                    min(len(p), ct + self.max_prompt_len)) - len(cached) + 1)
+            else:
+                need = self._worst_case_blocks(req)
             if (k > len(free_slots)
                     or need > self.mgr.free_blocks - self._reserved):
                 break                      # FCFS: do not starve the head
             self.queue.popleft()
+            if self.preemption and k == 1:
+                need = 0                   # no standing reservation
             self._need[req.req_id] = need
             self._resv[req.req_id] = 0
             if k == 1:
                 slot = int(free_slots.pop(0))
-                if len(req.prompt) > self.max_prompt_len:
-                    # chunked prefill: claim the slot INACTIVE; blocks
-                    # allocate chunk-by-chunk against the reservation
+                if cached:
+                    self.mgr.adopt_prefix(req.req_id, cached)
+                if cached or len(p) > self.max_prompt_len:
+                    # chunk-prefill path from offset ct: claims the slot
+                    # INACTIVE; blocks allocate chunk-by-chunk against
+                    # the reservation. (Cached short prompts ride it too —
+                    # the chunk program is the one that prefills from an
+                    # arbitrary offset over the slot's pool prefix.)
                     self._reserved += need
                     self._resv[req.req_id] = need
                     self.slot_req[slot] = req.req_id
-                    self.prefilling[req.req_id] = (slot, 0)
+                    self.prefilling[req.req_id] = (slot, ct)
                     continue
-                self.mgr.allocate(req.req_id, len(req.prompt))
+                self.mgr.allocate(req.req_id, len(p))
+                if self.prefix_caching:
+                    self.mgr.commit_prefix(req.req_id, p)
                 self._update_resv(req.req_id)
                 admits.append((slot, req))
             else:
@@ -326,16 +379,19 @@ class LLMEngine:
         rows = np.full((a_cap, self.max_blocks_per_seq),
                        self.mgr.num_blocks, np.int32)
         for i, (slot, req) in enumerate(admits):
-            ids[i, :len(req.prompt)] = req.prompt
-            lens[i] = len(req.prompt)
+            p = self._pr(req)
+            ids[i, :len(p)] = p
+            lens[i] = len(p)
             slots[i] = slot
             t = self.mgr.tables[req.req_id]
             rows[i, :len(t)] = t
             self.slot_req[slot] = req.req_id
             self.active[slot] = True
-            self.cur[slot] = len(req.prompt)
+            self.cur[slot] = len(p)
             self.gen[slot] = 0
-            self.max_gen[slot] = req.max_new_tokens
+            self.max_gen[slot] = self._remaining(req)
+            self._adm_counter += 1
+            self.adm_order[slot] = self._adm_counter
             self.table_len[slot] = len(t)
             self.temps[slot] = (self.default_temp if req.temperature is None
                                 else req.temperature)
@@ -537,8 +593,10 @@ class LLMEngine:
         batch = list(self.prefilling.items())[:a_cap]
         for i, (rid, (slot, consumed)) in enumerate(batch):
             req = self.requests[rid]
-            chunk = req.prompt[consumed: consumed + cap]
-            t = self.mgr.allocate(rid, consumed + len(chunk))
+            chunk = self._pr(req)[consumed: consumed + cap]
+            t = self._allocate_or_preempt(rid, consumed + len(chunk))
+            if t is None:
+                continue         # no blocks this tick: row stays queued
             self._update_resv(rid)
             ids[i, :len(chunk)] = chunk
             lens[i] = len(chunk)
@@ -554,7 +612,7 @@ class LLMEngine:
         for i, (rid, (slot, consumed)) in enumerate(batch):
             req = self.requests[rid]
             consumed += int(lens[i])
-            if consumed < len(req.prompt):
+            if consumed < len(self._pr(req)):
                 self.prefilling[rid] = (slot, consumed)
                 continue
             done_rows.append((i, rid, slot))
@@ -574,16 +632,74 @@ class LLMEngine:
             for i, rid, slot in done_rows:
                 req = self.requests[rid]
                 del self.prefilling[rid]
+                p = self._pr(req)
+                if self.prefix_caching:
+                    self.mgr.commit_prefix(rid, p)
                 t = self.mgr.tables[rid]
                 self.active[slot] = True
-                self.cur[slot] = len(req.prompt)
+                self.cur[slot] = len(p)
                 self.gen[slot] = 0
-                self.max_gen[slot] = req.max_new_tokens
+                self.max_gen[slot] = self._remaining(req)
+                self._adm_counter += 1
+                self.adm_order[slot] = self._adm_counter
                 self.table_len[slot] = len(t)
                 self.temps[slot] = row_t[i]
                 self.top_ps[slot] = row_p[i]
                 emitted += self._emit(slot, int(first[i]))
         return emitted
+
+    # --------------------------------------------------------- preemption
+    def _preempt(self, protect_rid=None) -> bool:
+        """Evict the YOUNGEST active greedy request (LIFO — vLLM's policy:
+        the oldest in-flight work is closest to completion) to free its
+        blocks. The victim re-queues at the queue head with resume-prompt
+        = prompt + generated-so-far; on re-admission the resume prefill
+        recomputes its KV (prefix-cache hits cover whatever of its old
+        blocks survived). Returns False when no preemptible slot exists."""
+        cand = [int(s) for s in np.nonzero(self.active & ~self.is_beam)[0]
+                if int(self.slot_req[s]) != protect_rid]
+        return self._preempt_from(cand)
+
+    def _preempt_from(self, cand) -> bool:
+        if self.window is not None or self._dyn_rope:
+            # the resume prefill rides the chunk path, which refuses
+            # window-recycling and dynamic-NTK for long prompts — only
+            # slots whose resume form fits one plain prefill qualify
+            cand = [s for s in cand
+                    if len(self.requests[int(self.slot_req[s])].prompt)
+                    + len(self.requests[int(self.slot_req[s])].tokens)
+                    <= self.max_prompt_len]
+        if not cand:
+            return False
+        slot = max(cand, key=lambda s: self.adm_order[s])
+        rid = int(self.slot_req[slot])
+        req = self.requests[rid]
+        req._resume = (np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+            if req.tokens else req.prompt)
+        self.mgr.free(rid)
+        self._reserved -= self._resv.pop(rid, 0)
+        self._need.pop(rid, None)
+        self.active[slot] = False
+        self.slot_req[slot] = -1
+        self.queue.appendleft(req)
+        self.stats["preemptions"] += 1
+        return True
+
+    def _allocate_or_preempt(self, rid: int, n_tokens: int):
+        """mgr.allocate with out-of-blocks recovery: preempt greedy slots
+        (never ``rid`` itself) until the allocation fits. Returns the
+        table, or None when preemption is off / nothing could be freed
+        (caller skips this row for the tick — progress resumes when
+        blocks free up)."""
+        while True:
+            try:
+                return self.mgr.allocate(rid, n_tokens)
+            except MemoryError:
+                if not self.preemption or not self._preempt(protect_rid=rid):
+                    if self.preemption:
+                        return None
+                    raise
 
     # ------------------------------------------------------------- decode
     def _grow_tables(self):
@@ -595,8 +711,19 @@ class LLMEngine:
         crossing = self.active & ~self.is_beam & (
             self.cur // self.block_size >= self.table_len)
         for slot in np.nonzero(crossing)[0]:     # ≤ once per bs ticks/slot
+            if not self.active[slot]:
+                continue                 # preempted earlier in this loop
             rid = int(self.slot_req[slot])
-            t = self.mgr.allocate(rid, int(self.cur[slot]) + 1)
+            t = self._allocate_or_preempt(rid, int(self.cur[slot]) + 1)
+            if t is None:
+                # nothing else to evict: preempt THIS slot (it re-queues
+                # with its progress and resumes when blocks free up)
+                if not self._preempt_from([int(slot)]):
+                    raise MemoryError(
+                        "paged cache out of blocks and the growing slot "
+                        "is not preemptible (windowed/dynamic-rope resume "
+                        "exceeds max_prompt_len)")
+                continue
             self._update_resv(rid)
             rows[slot] = slot
             cols[slot] = len(t) - 1
