@@ -177,6 +177,11 @@ class RefBlockManager(BlockManager):
         copy = None
         partial = (n_tokens % self.block_size != 0 and table
                    and table[-1] is not None)
+        if partial and not self.free_blocks:
+            # capacity check BEFORE the retain loop: a failed fork must
+            # leave refcounts untouched (callers retry after preempting —
+            # a leaked retain would permanently shrink the pool)
+            raise MemoryError("paged cache out of blocks for beam fork")
         for blk in (table[:-1] if partial else table):
             if blk is None:   # window-recycled placeholder: nothing shared
                 continue
@@ -186,8 +191,6 @@ class RefBlockManager(BlockManager):
         if src_id in self._prefix_done:
             self._prefix_done[dst_id] = self._prefix_done[src_id]
         if partial:
-            if not self.free_blocks:
-                raise MemoryError("paged cache out of blocks for beam fork")
             fresh = self._pop_free()
             self._rc[fresh] = 1
             copy = (table[-1], fresh)

@@ -571,6 +571,45 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     bq = _fit(block_q, s)
     bk = _fit(block_k, sk)
 
+    # Non-128-divisible lengths would otherwise step the tile down to a
+    # tiny divisor (s=1000 -> bq=8 — ~64x smaller MXU tiles than the
+    # tuned default): pad to an aligned length and mask/slice the tail
+    # instead. Padded KEY columns are masked causally (equal q/k padding
+    # keeps q_off = 0, so every real row's pad columns sit strictly above
+    # the diagonal) or by the kv_lens machinery (klen <= sk always masks
+    # them; `_q_offset`'s klen-based alignment is invariant under k-only
+    # padding). Padded QUERY rows compute junk that is sliced off — no
+    # padded row is ever fully masked, so no NaN leaks into the bwd
+    # matmuls via their zero cotangent. Skipped for windowed decode
+    # (s != sk): masking pads there needs kv_lens, a combo the banded
+    # grid refuses above.
+    pad_q = pad_k = 0
+    if (((bq < 128 and s > 128) or (bk < 128 and sk > 128))
+            and not (window is not None and s != sk)):
+        tq = min(block_q, 1 << max(7, s.bit_length() - 1))
+        tk = min(block_k, 1 << max(7, sk.bit_length() - 1))
+        if s == sk:
+            t = max(tq, tk)           # one pad aligns both (powers of 2)
+            pad_q = pad_k = (-s) % t
+            if not causal and kv_lens is None:
+                kv_lens = jnp.full((b,), sk, jnp.int32)
+        else:
+            # end-aligned query rows (decode): pad K only; bq keeps the
+            # _fit value (decode sq is small and usually aligned). If sk
+            # is already aligned (the trigger was a tiny bq) there is
+            # nothing to pad — forcing kv_lens then would buy the lens
+            # masking overhead for no tile improvement.
+            pad_k = (-sk) % tk
+            if pad_k and kv_lens is None:
+                kv_lens = jnp.full((b,), sk, jnp.int32)
+        if pad_q:
+            q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        if pad_k:
+            k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        bq = _fit(block_q, s + pad_q)
+        bk = _fit(block_k, sk + pad_k)
+
     def to_bh(x):
         return jnp.swapaxes(x, 1, 2).reshape(-1, x.shape[1], d)
 
@@ -586,4 +625,5 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                                   ).reshape(-1)[:, None]
     out = _flash(to_bh(q), to_bh(k), to_bh(v), lens, slopes, scale, causal,
                  window, kv_rep, bq, bk, interpret)
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+    out = jnp.swapaxes(out.reshape(b, h, s + pad_q, d), 1, 2)
+    return out[:, :s] if pad_q else out

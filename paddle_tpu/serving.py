@@ -167,6 +167,7 @@ class LLMEngine:
         self.requests: dict[int, Request] = {}
         self._ids = itertools.count()
         self._reserved = 0           # blocks promised to in-flight requests
+        self._staged_admits = frozenset()   # this tick's pre-scatter rows
         self._resv: dict[int, int] = {}    # req_id -> outstanding reserve
         self._need: dict[int, int] = {}    # req_id -> worst-case blocks
         # host-vs-device split of decode ticks (admission ticks excluded):
@@ -399,6 +400,7 @@ class LLMEngine:
                                  else req.top_p)
         n = len(admits)
         beams = []
+        self._staged_admits = frozenset(r.req_id for _, r in admits)
         for bi, (bslots, req) in enumerate(beam_admits):
             g, grows, csrc, cdst = self._beam_alloc(bslots, req)
             i = n + bi                   # every admit holds >= 1 slot, so
@@ -410,6 +412,7 @@ class LLMEngine:
         logits, self.cache = _PREFILL_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             self.cache, jnp.asarray(slots), jnp.asarray(rows))
+        self._staged_admits = frozenset()   # scatter landed: evictable again
         self.rng, sub = jax.random.split(self.rng)
         row_temps = np.zeros(a_cap, np.float32)
         row_tps = np.ones(a_cap, np.float32)
@@ -465,12 +468,16 @@ class LLMEngine:
         nb, max_b = self.mgr.num_blocks, self.max_blocks_per_seq
         g = _BeamGroup(req=req, slots=list(slots), s=s)
         g.sid = {j: self._new_sid(rid) for j in range(k)}
-        self.mgr.allocate(g.sid[0], s)
+        # protect same-tick greedy admits: their prefill rows are staged
+        # but the scatter hasn't run yet (this is called mid-_prefill)
+        prot = self._staged_admits
+        self._mgr_retry(self.mgr.allocate, g.sid[0], s, protect=prot)
         rows = np.full((k, max_b), nb, np.int32)
         copy_src = np.full(k, nb, np.int32)
         copy_dst = np.full(k, nb, np.int32)
         for j in range(1, k):
-            pair = self.mgr.fork(g.sid[0], g.sid[j], s)
+            pair = self._mgr_retry(self.mgr.fork, g.sid[0], g.sid[j], s,
+                                   protect=prot)
             if pair is not None:
                 copy_src[j], copy_dst[j] = pair
         for j in range(k):
@@ -534,7 +541,8 @@ class LLMEngine:
         new_sids = {}
         for j in range(k):
             dst = self._new_sid(rid)
-            pair = self.mgr.fork(g.sid[int(parents[j])], dst, cur)
+            pair = self._mgr_retry(self.mgr.fork,
+                                   g.sid[int(parents[j])], dst, cur)
             if pair is not None:
                 copy_src[j], copy_dst[j] = pair
             new_sids[j] = dst
@@ -542,7 +550,8 @@ class LLMEngine:
             self.mgr.free(g.sid[j])
         g.sid = new_sids
         for j in range(k):
-            t = self.mgr.allocate(g.sid[j], cur + 1)  # room for the write
+            t = self._mgr_retry(                      # room for the write
+                self.mgr.allocate, g.sid[j], cur + 1)
             rows[j, :len(t)] = t
         self.cache = _BEAM_GROUP_UPDATE_JIT(
             self.cache, jnp.asarray(g.slots, jnp.int32), jnp.asarray(rows),
@@ -591,18 +600,34 @@ class LLMEngine:
         slots = np.full(a_cap, self.num_slots, np.int32)
         rows = np.full((a_cap, max_b), nb, np.int32)
         batch = list(self.prefilling.items())[:a_cap]
+        progressed = False
+        staged = set()       # rows already in the jitted batch: their KV
         for i, (rid, (slot, consumed)) in enumerate(batch):
+            if rid not in self.prefilling:   # scatter is pending — a later
+                continue     # row's preemption must never evict them
             req = self.requests[rid]
             chunk = self._pr(req)[consumed: consumed + cap]
-            t = self._allocate_or_preempt(rid, consumed + len(chunk))
+            t = self._allocate_or_preempt(rid, consumed + len(chunk),
+                                          protect=staged)
             if t is None:
                 continue         # no blocks this tick: row stays queued
+            progressed = True
+            staged.add(rid)
             self._update_resv(rid)
             ids[i, :len(chunk)] = chunk
             lens[i] = len(chunk)
             offs[i] = consumed
             slots[i] = slot
             rows[i, :len(t)] = t
+        if (not progressed and not self.active.any() and not self.groups):
+            # nothing decoded this tick and no prefill row got blocks even
+            # though preemption could evict every OTHER prefill: the pool
+            # cannot fit one chunk of the sole remaining request — no
+            # future tick can differ, so raise instead of spinning
+            raise MemoryError(
+                "paged pool cannot fit one prefill chunk of the remaining "
+                "request(s) even after preemption — increase num_blocks or "
+                "reduce max_prompt_len (chunk size)")
         logits, self.cache = _PREFILL_CHUNK_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             jnp.asarray(offs), self.cache, jnp.asarray(slots),
@@ -610,6 +635,8 @@ class LLMEngine:
         emitted = []
         done_rows = []
         for i, (rid, (slot, consumed)) in enumerate(batch):
+            if rid not in self.prefilling:
+                continue     # evicted mid-batch: must not re-add its row
             req = self.requests[rid]
             consumed += int(lens[i])
             if consumed < len(self._pr(req)):
@@ -655,10 +682,50 @@ class LLMEngine:
         blocks. The victim re-queues at the queue head with resume-prompt
         = prompt + generated-so-far; on re-admission the resume prefill
         recomputes its KV (prefix-cache hits cover whatever of its old
-        blocks survived). Returns False when no preemptible slot exists."""
+        blocks survived). When no active slot qualifies, falls back to
+        evicting a CHUNK-PREFILLING request (slot inactive, blocks held):
+        without this, two long prompts mid-prefill on a dry pool would
+        spin forever — neither active nor evictable. Returns False when
+        nothing is preemptible."""
+        protect = self._protect(protect_rid)
         cand = [int(s) for s in np.nonzero(self.active & ~self.is_beam)[0]
-                if int(self.slot_req[s]) != protect_rid]
-        return self._preempt_from(cand)
+                if int(self.slot_req[s]) not in protect]
+        if self._preempt_from(cand):
+            return True
+        return self._preempt_prefilling(protect_rid)
+
+    @staticmethod
+    def _protect(protect_rid):
+        """Normalise the protect argument to a set of req_ids (a single
+        rid, an iterable of rids, or None)."""
+        if protect_rid is None:
+            return frozenset()
+        if isinstance(protect_rid, (set, frozenset, list, tuple)):
+            return frozenset(protect_rid)
+        return frozenset((protect_rid,))
+
+    def _preempt_prefilling(self, protect_rid=None) -> bool:
+        """Evict the youngest in-flight chunked prefill (req_ids are
+        monotonic, so max rid = youngest): free its blocks and re-queue it
+        at the head. Its consumed chunks are recomputed on re-admission —
+        prefill is deterministic, so this only costs work, never
+        correctness. Rows already STAGED into this tick's chunk batch must
+        ride in ``protect_rid`` — the jitted scatter would otherwise write
+        their KV into blocks just handed to someone else."""
+        protect = self._protect(protect_rid)
+        cand = [rid for rid in self.prefilling if rid not in protect]
+        if not cand:
+            return False
+        rid = max(cand)
+        slot, _ = self.prefilling.pop(rid)
+        req = self.requests[rid]
+        self.mgr.free(rid)
+        self._reserved -= self._resv.pop(rid, 0)
+        self._need.pop(rid, None)
+        self.slot_req[slot] = -1
+        self.queue.appendleft(req)
+        self.stats["preemptions"] += 1
+        return True
 
     def _preempt_from(self, cand) -> bool:
         if self.window is not None or self._dyn_rope:
@@ -686,19 +753,56 @@ class LLMEngine:
         self.stats["preemptions"] += 1
         return True
 
-    def _allocate_or_preempt(self, rid: int, n_tokens: int):
+    def _allocate_or_preempt(self, rid: int, n_tokens: int, protect=None):
         """mgr.allocate with out-of-blocks recovery: preempt greedy slots
-        (never ``rid`` itself) until the allocation fits. Returns the
-        table, or None when preemption is off / nothing could be freed
-        (caller skips this row for the tick — progress resumes when
-        blocks free up)."""
+        (never ``rid`` itself, nor anything in ``protect`` — rows already
+        staged into this tick's jitted batch) until the allocation fits.
+        Returns the table, or None when preemption is off / nothing could
+        be freed (caller skips this row for the tick — progress resumes
+        when blocks free up).
+
+        Respects OTHER requests' standing reservations: a greedy request
+        (which carries none under preemption) must preempt before dipping
+        into blocks a beam group's worst-case reservation counts on —
+        otherwise a later beam select can raise MemoryError out of
+        ``step()`` mid-update, corrupting engine state."""
+        protect = self._protect(protect) | {rid}
         while True:
+            others = self._reserved - self._resv.get(rid, 0)
+            # need mirrors mgr.allocate: table POSITIONS — including the
+            # None placeholders window recycling leaves — already cover
+            # their token span; counting only live blocks would inflate
+            # need without bound as a windowed sequence recycles
+            # (spurious preemption storm, then a crash)
+            need = (self.mgr.blocks_needed(n_tokens)
+                    - len(self.mgr.tables.get(rid, [])))
             try:
+                if need > self.mgr.free_blocks - max(0, others):
+                    raise MemoryError("allocation would dip into blocks "
+                                      "reserved by other requests")
                 return self.mgr.allocate(rid, n_tokens)
             except MemoryError:
-                if not self.preemption or not self._preempt(protect_rid=rid):
+                if not self.preemption or not self._preempt(
+                        protect_rid=protect):
                     if self.preemption:
                         return None
+                    raise
+
+    def _mgr_retry(self, fn, *a, protect=None):
+        """Beam-group block growth with out-of-blocks recovery: route
+        through greedy preemption instead of letting MemoryError escape
+        ``step()`` mid-cache-update. The group's worst-case reservation
+        (+2 transient fork blocks per beam) should make this unreachable
+        now that greedy growth respects reservations; this is the
+        belt-and-braces path. ``protect``: req_ids whose prefill rows are
+        staged but not yet scattered (evicting one would corrupt the KV
+        writes about to land)."""
+        while True:
+            try:
+                return fn(*a)
+            except MemoryError:
+                if not self.preemption or not self._preempt(
+                        protect_rid=protect):
                     raise
 
     # ------------------------------------------------------------- decode

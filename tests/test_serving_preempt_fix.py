@@ -1,0 +1,111 @@
+"""Regressions for the round-4 advisor findings on the serving engine.
+
+* chunked-prefill livelock: two long prompts mid-prefill on a dry pool
+  (preemption=True) used to spin forever — prefilling slots are inactive
+  and were invisible to _preempt. Now a prefilling request is evictable
+  (it re-queues and replays its chunks), so the engine drains and every
+  output still equals solo greedy.
+* a pool that cannot fit ONE chunk of the sole remaining request raises
+  MemoryError instead of spinning.
+* windowed growth under preemption: the reservation guard must count
+  table POSITIONS (None placeholders from window recycling included),
+  not live blocks — the live-only count inflated `need` without bound
+  and preempted/crashed healthy long generations.
+* RefBlockManager.fork is exception-atomic: a fork that fails for the
+  partial-block copy leaves every refcount untouched (callers retry
+  after preempting; a leaked retain would shrink the pool forever).
+
+Ref capability: PaddleNLP llm/predict block-attention serving recompute
+preemption (vLLM-style), under chunked prefill.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.decoding import generate
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.paged import RefBlockManager
+from paddle_tpu.serving import LLMEngine, Request
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _solo(model, p, n):
+    return np.asarray(generate(model, jnp.asarray(np.asarray(p)[None]),
+                               max_new_tokens=n))[0, len(p):]
+
+
+def test_chunked_prefill_livelock_drains(model):
+    """The advisor's repro: num_blocks=8, block_size=4, max_prompt_len=8,
+    two 24-token prompts. Both admit optimistically, chunk-prefill until
+    the pool runs dry with NO active decode slot; progress now comes from
+    evicting the younger prefilling request."""
+    rs = np.random.RandomState(11)
+    p1 = rs.randint(0, 64, (24,))
+    p2 = rs.randint(0, 64, (24,))
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=8,
+                    max_seq_len=32, num_blocks=8, preemption=True,
+                    prefix_caching=False)
+    r1 = eng.add_request(Request(p1, max_new_tokens=4))
+    r2 = eng.add_request(Request(p2, max_new_tokens=4))
+    for _ in range(300):
+        eng.step()
+        if not eng.has_work():
+            break
+    assert not eng.has_work(), "engine did not drain (livelock)"
+    assert eng.stats["preemptions"] >= 1
+    out = {rid: np.asarray(r.tokens) for rid, r in eng.requests.items()}
+    np.testing.assert_array_equal(out[r1], _solo(model, p1, 4))
+    np.testing.assert_array_equal(out[r2], _solo(model, p2, 4))
+
+
+def test_request_bigger_than_pool_refused_at_add(model):
+    """A request whose worst case can NEVER fit the pool is refused at
+    add_request — the in-engine no-progress MemoryError backstop stays as
+    defense-in-depth behind this gate."""
+    rs = np.random.RandomState(12)
+    p = rs.randint(0, 64, (24,))
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=8,
+                    max_seq_len=32, num_blocks=2, preemption=True,
+                    prefix_caching=False)
+    with pytest.raises(ValueError):
+        eng.add_request(Request(p, max_new_tokens=4))
+
+
+def test_windowed_growth_preemption_no_storm(model):
+    """A windowed sequence generating far past its window holds O(window)
+    live blocks but a long table of None placeholders; growth must not
+    spuriously preempt (there is only one request — a 'preemption' here
+    would be the self-eviction crash path)."""
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64, sliding_window=8)
+    wmodel = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(13)
+    p = rs.randint(0, 64, (6,))
+    eng = LLMEngine(wmodel, num_slots=2, block_size=4, max_prompt_len=16,
+                    max_seq_len=64, num_blocks=6, preemption=True)
+    rid = eng.add_request(Request(p, max_new_tokens=40))
+    res = eng.run()
+    assert eng.stats["preemptions"] == 0
+    assert len(res[rid]) == 40
+
+
+def test_fork_failure_leaks_no_refcounts():
+    mgr = RefBlockManager(num_blocks=3, block_size=4)
+    mgr.allocate(1, 10)                     # 3 blocks, last one partial
+    assert mgr.free_blocks == 0
+    with pytest.raises(MemoryError):
+        mgr.fork(1, 2, 10)                  # partial copy needs a block
+    mgr.free(1)
+    assert mgr.free_blocks == 3, "failed fork leaked refcounts"
+    assert 2 not in mgr.tables
