@@ -1,0 +1,31 @@
+"""jax API compatibility shims for the distributed layer."""
+import inspect
+
+import jax
+
+try:                                     # newer public name
+    from jax import shard_map as _shard_map
+except ImportError:                      # older: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma; the old
+# experimental checker also lacks rules for several primitives the
+# pipeline/MoE paths use, so when a caller doesn't opt in, leave it OFF
+_CHECK_KW = ("check_vma" if "check_vma"
+             in inspect.signature(_shard_map).parameters else "check_rep")
+
+
+def shard_map(f, mesh, in_specs, out_specs, **kw):
+    check = kw.pop("check_vma", kw.pop("check_rep", False))
+    kw[_CHECK_KW] = check
+    return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        # psum of a Python constant is evaluated statically at trace
+        # time (the pre-axis_size idiom), so range()/shape uses stay legal
+        return jax.lax.psum(1, axis_name)
+
+__all__ = ["shard_map", "axis_size"]

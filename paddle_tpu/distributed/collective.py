@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+from paddle_tpu.distributed._compat import axis_size
 
 # ReduceOp parity (ref communication/reduce.py)
 class ReduceOp:
@@ -53,7 +54,7 @@ def all_to_all(x, *, axis_name: str, split_axis: int, concat_axis: int):
 def broadcast(x, src: int = 0, *, axis_name: str):
     """Every member gets member `src`'s value."""
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     sel = jnp.where(jnp.arange(n) == src, 1.0, 0.0).astype(x.dtype)
     gathered = lax.all_gather(x, axis_name, axis=0)
     return jnp.tensordot(sel, gathered, axes=([0], [0])).astype(x.dtype)
@@ -66,7 +67,7 @@ def permute(x, perm: list[tuple[int, int]], *, axis_name: str):
 
 def shift(x, offset: int = 1, *, axis_name: str):
     """Ring shift: member i's value goes to member (i+offset) % n."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -92,7 +93,7 @@ def scatter(x, src: int = 0, *, axis_name: str):
     """Member ``src``'s value, split over the axis: member i receives the
     i-th chunk of src's leading dim (ref communication/scatter.py)."""
     full = broadcast(x, src, axis_name=axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     i = lax.axis_index(axis_name)
     if full.shape[0] % n != 0:
         raise ValueError(

@@ -240,7 +240,7 @@ class MoELayer(Module):
 
     # -- expert-parallel path: shard_map + all_to_all over the ep axis ------
     def _forward_ep(self, x, mesh, ep):
-        from jax import shard_map
+        from paddle_tpu.distributed._compat import shard_map
 
         e = self.num_experts
         if e % ep != 0:

@@ -64,6 +64,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from paddle_tpu.distributed._compat import axis_size
+
 from paddle_tpu.core.module import Module
 
 
@@ -89,7 +91,7 @@ def pipeline_apply(stacked_stage_params, layer_fn: Callable, x_microbatches,
     Returns [M, mb, ...]: last stage's outputs (valid on the last stage;
       other stages hold garbage — psum/broadcast outside if needed).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m_total = x_microbatches.shape[0]
     ticks = m_total + n_stages - 1
@@ -214,7 +216,7 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
     queue of ``pp`` slots. Loss is bit-identical to 1F1B; grads equal up to
     fp32 accumulation order of the deferred terms.
     """
-    pp = lax.axis_size(axis_name)
+    pp = axis_size(axis_name)
     s = lax.axis_index(axis_name)
     M = x_mb.shape[0]
     R = 2 * pp - 1                      # residual ring slots, M-independent
@@ -424,7 +426,7 @@ def pipeline_train_1f1b(stage_params, stage_fwd: Callable, x_mb, y_mb, *,
         # the loss are per-dp-shard means — average across the dp group
         nb = 1
         for a in batch_axes:
-            nb *= lax.axis_size(a)
+            nb *= axis_size(a)
         pmean = lambda v: lax.psum(v, batch_axes) / nb
         loss = pmean(loss)
         dstage = jax.tree_util.tree_map(pmean, dstage)
@@ -454,7 +456,7 @@ def pipeline_train_step(pipe: "PipelineLayer", mesh, x, y, *,
     member runs the same pipeline on its shard, and loss/grads are
     dp-averaged inside the shard_map.
     """
-    from jax import shard_map
+    from paddle_tpu.distributed._compat import shard_map
 
     if schedule not in ("1f1b", "zb1"):
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
@@ -559,7 +561,7 @@ class PipelineLayer(Module):
                 return layer_call(lyr_params, h), None
             out, _ = lax.scan(body, x, self.stacked)
             return out
-        from jax import shard_map
+        from paddle_tpu.distributed._compat import shard_map
         mb = self.num_microbatches
         b = x.shape[0]
         assert b % mb == 0, "batch must divide microbatches"
@@ -578,7 +580,7 @@ class PipelineLayer(Module):
                                  remat=self.remat)
             # broadcast last stage's result to all pp members so downstream
             # (loss) is replicated over pp: zero elsewhere + psum
-            n = lax.axis_size("pp")
+            n = axis_size("pp")
             is_last = (lax.axis_index("pp") == n - 1).astype(out.dtype)
             return lax.psum(out * is_last, "pp")
         return run(self.stacked, xm).reshape(x.shape)

@@ -17,6 +17,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from paddle_tpu.distributed._compat import axis_size
 
 _NEG_INF = -1e30
 
@@ -85,7 +86,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
@@ -185,7 +186,7 @@ def make_ring_attention(mesh, causal=True, head_spec=None, window=None,
     ``bias_shape``: pass the [B|1, H|1, S, S] shape of an ADDITIVE float
     bias (T5 relative bias, ALiBi) to accept it as the last argument —
     q rows sharded over sp, head dim over ``head_spec`` when per-head."""
-    from jax import shard_map
+    from paddle_tpu.distributed._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(("dp", "fsdp"), "sp", head_spec, None)
@@ -260,7 +261,7 @@ def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp",
     The traced src<my / src>my choice is made by SELECTING OPERANDS
     (qA vs qB, C vs D) into one dense block-attend — shapes stay static.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     assert s_loc % 2 == 0, "zigzag needs an even local length"
@@ -331,7 +332,7 @@ def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp",
 def make_zigzag_ring_attention(mesh):
     """shard_map-wrapped zigzag ring attention (inputs already in zigzag
     layout, S sharded over sp)."""
-    from jax import shard_map
+    from paddle_tpu.distributed._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(("dp", "fsdp"), "sp", None, None)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from paddle_tpu.distributed._compat import axis_size
 from paddle_tpu.ops import attention as A
 
 
@@ -38,7 +39,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     relative bias, ALiBi) for THIS member's post-exchange head slice —
     ``make_ulysses_attention`` shards a global per-head bias over
     (tp, sp) so the slice lines up with the heads the all_to_all assigns."""
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     if q.shape[2] % sp != 0:
         raise ValueError(
             f"ulysses_attention: num_heads={q.shape[2]} must be divisible by "
@@ -102,7 +103,7 @@ def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp",
     as the last argument. A per-head bias is sharded over (tp, sp) on the
     head dim — tp-major, sp-minor, exactly the head range device
     (tp_j, sp_i) ends up computing after the all_to_all."""
-    from jax import shard_map
+    from paddle_tpu.distributed._compat import shard_map
 
     spec = P(batch_axes, axis_name, head_spec, None)
     in_specs = [spec, spec, spec]

@@ -18,6 +18,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.core.module import Module
@@ -65,6 +66,16 @@ class LlamaConfig:
     # long-context extension (ref rope_scaling: linear | ntk | dynamic)
     rope_scaling: dict | None = None
 
+    def save_names(self) -> tuple:
+        """The checkpoint_name tags each remat_policy mode SAVES (see the
+        field comment above); everything else is recomputed in backward."""
+        try:
+            return _REMAT_SAVE_NAMES[self.remat_policy]
+        except KeyError:
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; expected one "
+                f"of {sorted(k for k in _REMAT_SAVE_NAMES if k)} or None")
+
     @staticmethod
     def llama2_7b(**kw):
         return LlamaConfig(**{**dict(hidden_size=4096, intermediate_size=11008,
@@ -83,6 +94,16 @@ class LlamaConfig:
                                      num_attention_heads=4, num_key_value_heads=2,
                                      max_position_embeddings=128,
                                      dtype=jnp.float32, remat=False), **kw})
+
+
+# remat_policy mode -> checkpoint_name tags saved by the per-layer
+# jax.checkpoint (empty = classic full remat: save nothing named)
+_REMAT_SAVE_NAMES = {
+    None: (), "full": (),
+    "hidden": ("attn_ctx", "ffn_out"),
+    "no_ffn": ("qkv", "attn_ctx", "ffn_out"),
+    "dots": ("qkv", "attn_ctx", "ffn_gu", "ffn_out"),
+}
 
 
 class LlamaRMSNorm(Module):
@@ -280,9 +301,18 @@ class LlamaModel(Module):
                                   base=cfg.rope_theta, position_ids=position_ids,
                                   scaling=cfg.rope_scaling,
                                   max_position_embeddings=cfg.max_position_embeddings)
-        layer_fn = (jax.checkpoint(lambda lyr, h: lyr(h, cos, sin, attn_mask),
-                                   static_argnums=())
-                    if cfg.remat else (lambda lyr, h: lyr(h, cos, sin, attn_mask)))
+        if cfg.remat:
+            # selective remat: save only the tagged activations the policy
+            # names (checkpoint_name tags in attention/MLP); None/"full"
+            # saves nothing — classic full remat
+            names = cfg.save_names()
+            policy = (jax.checkpoint_policies.save_only_these_names(*names)
+                      if names else None)
+            layer_fn = jax.checkpoint(
+                lambda lyr, h: lyr(h, cos, sin, attn_mask),
+                static_argnums=(), policy=policy)
+        else:
+            layer_fn = (lambda lyr, h: lyr(h, cos, sin, attn_mask))
         if cfg.scan_layers:
             def body(h, lyr):
                 return layer_fn(lyr, h), None
@@ -590,9 +620,11 @@ def make_tp_layer_call(cos, sin, tp_axis: str = "tp"):
     permuted by ``tp_shuffle_llama_params``."""
     from jax import lax as _lax
 
+    from paddle_tpu.distributed._compat import axis_size as _axis_size
+
     def call(lyr, h):
         att, mlp = lyr.self_attn, lyr.mlp
-        tp = _lax.axis_size(tp_axis)
+        tp = _axis_size(tp_axis)
         hd = att.head_dim
         nh_l = att.num_heads // tp
         nkv_l = att.num_kv_heads // tp

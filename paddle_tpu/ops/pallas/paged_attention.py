@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# CompilerParams was TPUCompilerParams before the pallas API rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 _NEG_INF = -1e30
 
 
@@ -137,7 +141,7 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
         # (sequence-head, block) grid: rows are independent; declaring the
         # row axis parallel lets Mosaic pipeline pool-block DMAs across rows
         # (measured 3.5x on the flash grids — benchmarks/_perf_banded.py)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
     )(tables_bh, lens_bh, qf, kp, vp)

@@ -23,6 +23,7 @@ condition mid-decode (there is no preemption to recover with).
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -35,9 +36,20 @@ from paddle_tpu.models.paged import (PagedKVCache, PrefixCachingBlockManager,
                                      _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
                                      _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
                                      _PREFILL_JIT, _TICK_JIT)
+from paddle_tpu.utils.faults import fault_point
 
 # module-level so its compile cache persists across admissions
 _SAMPLE_ROWS_JIT = jax.jit(_sample_rows, static_argnums=(4,))
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at ``max_queue_len`` — backpressure: the caller
+    should shed load or retry later, NOT buffer unboundedly here."""
+
+
+class EngineDrainingError(RuntimeError):
+    """``drain()`` was called — the engine finishes in-flight work but
+    admits nothing new."""
 
 
 @dataclass
@@ -57,10 +69,19 @@ class Request:
     # per-request sampling overrides (None = the engine's defaults):
     temperature: float = None
     top_p: float = None
+    # robustness knobs (None = unbounded):
+    #   deadline_s    total wall-clock budget from submission — expired
+    #                 requests finish with finish_reason="timeout"
+    #                 (whatever tokens were generated stay available)
+    #   max_queue_s   max time WAITING for admission; a request that
+    #                 can't enter a slot in time also times out
+    deadline_s: float = None
+    max_queue_s: float = None
     # filled by the engine:
     tokens: list = field(default_factory=list)   # generated tokens
     done: bool = False
     finish_reason: str = None
+    _submit_t: float = None              # engine clock at add_request
     beam_score: float = None
     # set on preemption: prompt + tokens generated so far — the resume
     # prefill recomputes the whole sequence (prefix-cache hits make the
@@ -99,7 +120,8 @@ class LLMEngine:
     def __init__(self, model, *, num_slots=8, block_size=16,
                  max_prompt_len=128, max_seq_len=None, num_blocks=None,
                  eos_token_id=None, temperature=0.0, top_k=None, top_p=None,
-                 seed=0, prefix_caching=True, preemption=False):
+                 seed=0, prefix_caching=True, preemption=False,
+                 max_queue_len=None, clock=None):
         cfg = model.cfg
         self.model = model
         self.num_slots = num_slots
@@ -174,12 +196,34 @@ class LLMEngine:
         # stats["host_s"] is scheduling/bookkeeping, stats["device_s"] the
         # jitted tick incl. the [num_slots] token fetch
         self.stats = {"host_s": 0.0, "device_s": 0.0, "ticks": 0,
-                      "preemptions": 0}
+                      "preemptions": 0, "timeouts": 0, "cancelled": 0,
+                      "rejected": 0}
         self._adm_counter = 0                # admission recency, per slot
         self.adm_order = np.zeros(num_slots, np.int64)
+        # robustness: bounded admission queue (None = unbounded), a
+        # swappable clock (tests drive deadlines deterministically), and
+        # the drain flag (graceful shutdown: finish in-flight, admit
+        # nothing new)
+        self.max_queue_len = max_queue_len
+        self._clock = clock if clock is not None else time.monotonic
+        self._draining = False
+        self._has_deadlines = False
 
     # ------------------------------------------------------------- intake
     def add_request(self, req: Request) -> int:
+        if self._draining:
+            self.stats["rejected"] += 1
+            raise EngineDrainingError(
+                "engine is draining — finishing in-flight requests, "
+                "admitting nothing new")
+        if (self.max_queue_len is not None
+                and len(self.queue) >= self.max_queue_len):
+            # reject-on-full backpressure: push the load signal to the
+            # caller instead of buffering an unbounded deque
+            self.stats["rejected"] += 1
+            raise QueueFullError(
+                f"admission queue full ({self.max_queue_len} waiting) — "
+                "shed load or retry later")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "itself produces the first token)")
@@ -232,6 +276,9 @@ class LLMEngine:
             # keep auto ids from ever colliding with explicit ones
             self._ids = itertools.count(
                 max(req.req_id + 1, next(self._ids)))
+        req._submit_t = self._clock()
+        if req.deadline_s is not None or req.max_queue_s is not None:
+            self._has_deadlines = True
         self.requests[req.req_id] = req
         self.queue.append(req)
         return req.req_id
@@ -251,6 +298,105 @@ class LLMEngine:
     def has_work(self) -> bool:
         return (bool(self.queue) or bool(self.active.any())
                 or bool(self.groups) or bool(self.prefilling))
+
+    # --------------------------------------------- cancellation/deadlines
+    def _release_ledger(self, rid: int):
+        self._reserved -= self._resv.pop(rid, 0)
+        self._need.pop(rid, None)
+
+    def cancel(self, req_id: int, reason: str = "cancelled") -> bool:
+        """Terminate a request wherever it currently lives — queued,
+        chunk-prefilling, decoding, or mid-beam — freeing its blocks,
+        reservation, and slot(s). Exception-atomic: every mutation below
+        is a host dict/array op ordered so a failure cannot strand
+        half-released state. Safe between ``step()`` calls (and from
+        stream callbacks: an emptied slot is skipped by ``_emit``).
+        Returns False for unknown/finished requests."""
+        req = self.requests.get(req_id)
+        if req is None or req.done:
+            return False
+        released = False
+        for i, q in enumerate(self.queue):          # still waiting
+            if q.req_id == req_id:
+                del self.queue[i]
+                released = True
+                break
+        if not released and req_id in self.prefilling:
+            slot, _ = self.prefilling.pop(req_id)
+            self.mgr.free(req_id)
+            self.slot_req[slot] = -1
+            released = True
+        if not released and req_id in self.groups:
+            g = self.groups.pop(req_id)
+            for sid in g.sid.values():
+                self.mgr.free(sid)
+            for slot in g.slots:
+                self.active[slot] = False
+                self.is_beam[slot] = False
+                self.slot_req[slot] = -1
+            released = True
+        if not released:
+            slots = np.nonzero(self.slot_req == req_id)[0]
+            if not len(slots):
+                return False                        # mid-transition: punt
+            slot = int(slots[0])
+            self.mgr.free(req_id)
+            self.active[slot] = False
+            self.slot_req[slot] = -1
+            released = True
+        self._release_ledger(req_id)
+        req.done = True
+        req.finish_reason = reason
+        self.stats["timeouts" if reason == "timeout" else "cancelled"] += 1
+        return True
+
+    def _expire(self):
+        """Finish requests whose wall-clock budget ran out: absolute
+        ``deadline_s`` for everyone, ``max_queue_s`` additionally for
+        requests still waiting for admission. Runs at the top of every
+        tick — an expired request frees its slot/blocks THIS tick, so
+        deadlines double as livelock bounds."""
+        if not self._has_deadlines or not self.requests:
+            return
+        now = self._clock()
+        queued = {r.req_id for r in self.queue}
+        for rid, r in list(self.requests.items()):
+            if r.done or r._submit_t is None:
+                continue
+            age = now - r._submit_t
+            if ((r.deadline_s is not None and age >= r.deadline_s)
+                    or (rid in queued and r.max_queue_s is not None
+                        and age >= r.max_queue_s)):
+                self.cancel(rid, reason="timeout")
+
+    def drain(self, cancel_queued: bool = False) -> dict:
+        """Graceful shutdown: stop admitting (``add_request`` raises
+        EngineDrainingError) but finish everything in flight; returns
+        {req_id: tokens} like ``run``. ``cancel_queued=True`` also
+        cancels requests still waiting for admission instead of running
+        them to completion."""
+        self._draining = True
+        if cancel_queued:
+            for r in list(self.queue):
+                self.cancel(r.req_id)
+        while self.has_work():
+            self.step()
+        return {rid: r.tokens for rid, r in self.requests.items()}
+
+    def assert_quiescent(self):
+        """Invariant check once idle: every block is back in the pool
+        (prefix-cache parked blocks count — they are reclaimable), no
+        standing reservations, no per-sequence tables. Chaos tests call
+        this after driving fault schedules: any leak in a recovery path
+        shows up here as missing blocks."""
+        assert not self.has_work(), "engine still has work"
+        assert self.mgr.free_blocks == self.mgr.num_blocks, (
+            f"block leak: {self.mgr.num_blocks - self.mgr.free_blocks} "
+            f"of {self.mgr.num_blocks} blocks unaccounted for")
+        assert self._reserved == 0, f"reservation leak: {self._reserved}"
+        assert not self._resv and not self._need, (
+            f"ledger leak: resv={self._resv} need={self._need}")
+        assert not self.mgr.tables, f"table leak: {list(self.mgr.tables)}"
 
     def _pr(self, req) -> np.ndarray:
         """Effective prompt: the resume form (original prompt + tokens
@@ -329,6 +475,11 @@ class LLMEngine:
                     self._reserved += need
                     self._resv[req.req_id] = need
                     self.slot_req[slot] = req.req_id
+                    # admission recency stamped at slot-claim: preemption
+                    # victim selection keys on THIS, not on req_id (user
+                    # ids need not be monotonic with admission)
+                    self._adm_counter += 1
+                    self.adm_order[slot] = self._adm_counter
                     self.prefilling[req.req_id] = (slot, ct)
                     continue
                 self.mgr.allocate(req.req_id, len(p))
@@ -705,9 +856,12 @@ class LLMEngine:
         return frozenset((protect_rid,))
 
     def _preempt_prefilling(self, protect_rid=None) -> bool:
-        """Evict the youngest in-flight chunked prefill (req_ids are
-        monotonic, so max rid = youngest): free its blocks and re-queue it
-        at the head. Its consumed chunks are recomputed on re-admission —
+        """Evict the youngest in-flight chunked prefill — youngest by
+        ADMISSION order (``adm_order`` stamped at slot-claim), not by
+        req_id: ids may be user-supplied and non-monotonic, and evicting
+        an explicitly-numbered old request as if youngest would churn the
+        work closest to completion. Free its blocks and re-queue it at
+        the head; consumed chunks are recomputed on re-admission —
         prefill is deterministic, so this only costs work, never
         correctness. Rows already STAGED into this tick's chunk batch must
         ride in ``protect_rid`` — the jitted scatter would otherwise write
@@ -716,7 +870,7 @@ class LLMEngine:
         cand = [rid for rid in self.prefilling if rid not in protect]
         if not cand:
             return False
-        rid = max(cand)
+        rid = max(cand, key=lambda r: self.adm_order[self.prefilling[r][0]])
         slot, _ = self.prefilling.pop(rid)
         req = self.requests[rid]
         self.mgr.free(rid)
@@ -777,6 +931,9 @@ class LLMEngine:
             need = (self.mgr.blocks_needed(n_tokens)
                     - len(self.mgr.tables.get(rid, [])))
             try:
+                # chaos hook: an injected MemoryError exercises the same
+                # preempt-and-retry recovery a genuinely dry pool would
+                fault_point("serving.alloc", rid=rid, engine=self)
                 if need > self.mgr.free_blocks - max(0, others):
                     raise MemoryError("allocation would dip into blocks "
                                       "reserved by other requests")
@@ -841,6 +998,8 @@ class LLMEngine:
         """Record one sampled token for the request in ``slot``; finish on
         EOS or length. Returns [(req_id, token)]."""
         rid = int(self.slot_req[slot])
+        if rid < 0:
+            return []        # slot emptied mid-tick (stream-side cancel)
         req = self.requests[rid]
         req.tokens.append(token)
         if req.stream is not None:
@@ -865,6 +1024,12 @@ class LLMEngine:
         tick for every active slot. Returns [(req_id, new_token), ...]
         (a finishing beam request emits its whole best hypothesis)."""
         from time import perf_counter
+        # chaos hooks: serving.tick may raise/stall; serving.preempt rules
+        # receive the engine and typically call engine._preempt() to
+        # induce a preemption the pool never asked for
+        fault_point("serving.tick", engine=self)
+        fault_point("serving.preempt", engine=self)
+        self._expire()
         emitted = []
         for rid in list(self.groups):
             emitted += self._beam_advance(rid, self.groups[rid])

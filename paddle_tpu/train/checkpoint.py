@@ -7,11 +7,25 @@ Two backends:
 
 State layout: {model, opt_state, rng, step, meta}. Restore is EXACT —
 optimizer slots, RNG key, LR-schedule step all round-trip (SURVEY.md §2.9).
+
+Durability (elastic restore is only as good as the last durable
+checkpoint — PAPER.md §2.9):
+  * ATOMIC save — write to a same-directory tmp file, fsync, then
+    ``os.replace`` + directory fsync. A crash mid-save leaves at worst a
+    stale ``.tmp`` file; the previous checkpoint is never damaged.
+  * VERIFIED load — every array carries a CRC32 in the meta blob,
+    checked on read; truncated/bit-rotted files raise
+    :class:`CheckpointCorruptError` instead of restoring garbage.
+  * ``CheckpointManager`` keeps ``max_to_keep`` checkpoints plus a
+    ``latest`` pointer that only advances after the durable rename, and
+    ``restore`` falls back to the newest VERIFIABLE checkpoint when the
+    latest is corrupt/unreadable.
 """
 from __future__ import annotations
 
 import json
 import os
+import zlib
 from pathlib import Path
 from typing import Any, Optional
 
@@ -20,6 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.module import Module, _path_to_str
+from paddle_tpu.utils.faults import fault_point
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Checkpoint failed CRC/structure verification on load."""
 
 
 def _flatten_with_paths(tree):
@@ -28,8 +47,26 @@ def _flatten_with_paths(tree):
     return [(_path_to_str(p), l) for p, l in flat], treedef
 
 
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _fsync_dir(path: Path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:            # exotic fs: durability is best-effort
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save(state: Any, path: str) -> None:
-    """paddle.save equivalent: any pytree (Module, TrainState, dict) → one file."""
+    """paddle.save equivalent: any pytree (Module, TrainState, dict) → one
+    file. Crash-safe: the bytes land in ``<name>.tmp`` first and reach the
+    final path only through an fsync'd ``os.replace`` — a kill at any
+    point leaves either the complete old file or the complete new one."""
     path = Path(path)
     if path.suffix != ".npz":
         path = Path(str(path) + ".npz")
@@ -44,22 +81,48 @@ def save(state: Any, path: str) -> None:
             key = f"a{i}"
             arrays[key] = np.asarray(leaf)
             meta["leaves"].append({"path": p, "kind": "array", "key": key,
-                                   "dtype": str(np.asarray(leaf).dtype)})
+                                   "dtype": str(np.asarray(leaf).dtype),
+                                   "crc": _crc(arrays[key])})
         else:
             meta["leaves"].append({"path": p, "kind": "py", "value": leaf})
-    np.savez(path, __meta__=json.dumps(meta), **arrays)
+    fault_point("ckpt.write", path=str(path))     # injected host I/O error
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, __meta__=json.dumps(meta), **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    fault_point("ckpt.rename", path=str(path))    # the crash window
+    os.replace(tmp, path)
+    _fsync_dir(path.parent)
 
 
-def load(path: str, target: Any = None) -> Any:
+def load(path: str, target: Any = None, verify: bool = True) -> Any:
     """paddle.load equivalent. With `target`, restores into the target's
-    structure (exact dtypes/shapes checked); without, returns {path: array}."""
+    structure (exact dtypes/shapes checked); without, returns {path: array}.
+    ``verify`` checks each array's stored CRC32 (checkpoints written
+    before CRCs existed load unverified) and raises
+    :class:`CheckpointCorruptError` on mismatch or an unreadable file."""
     p = str(path)
     if not p.endswith(".npz"):
         p = p + ".npz"
-    with np.load(p, allow_pickle=False) as z:
-        meta = json.loads(str(z["__meta__"]))
-        leaves_meta = meta["leaves"]
-        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    try:
+        with np.load(p, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            leaves_meta = meta["leaves"]
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    except FileNotFoundError:
+        raise
+    except Exception as e:      # zip/pickle/json damage = corrupt file
+        raise CheckpointCorruptError(f"{p}: unreadable checkpoint "
+                                     f"({type(e).__name__}: {e})") from e
+    if verify:
+        for lm in leaves_meta:
+            if lm.get("kind") == "array" and "crc" in lm:
+                got = _crc(arrays[lm["key"]])
+                if got != lm["crc"]:
+                    raise CheckpointCorruptError(
+                        f"{p}: CRC mismatch for leaf {lm['path']} "
+                        f"(stored {lm['crc']:#010x}, got {got:#010x})")
     by_path = {}
     for lm in leaves_meta:
         if lm["kind"] == "array":
@@ -92,13 +155,21 @@ def load(path: str, target: Any = None) -> Any:
 
 
 class CheckpointManager:
-    """Step-numbered checkpoints with retention (ref Fleet auto ckpt)."""
+    """Step-numbered checkpoints with retention (ref Fleet auto ckpt).
+
+    Durability contract: ``save`` is atomic (see :func:`save`), the
+    ``latest`` pointer file advances only AFTER the checkpoint's durable
+    rename (itself via fsync'd tmp+replace), and ``restore`` verifies
+    CRCs — falling back step-by-step to the newest checkpoint that still
+    loads when the latest one is corrupt (``fallback=False`` restores
+    strictly the requested step or raises)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3, use_orbax: bool = False):
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
         self.use_orbax = use_orbax
+        self.last_restored_step: Optional[int] = None
         if use_orbax:
             import orbax.checkpoint as ocp
             self._mgr = ocp.CheckpointManager(
@@ -106,6 +177,15 @@ class CheckpointManager:
 
     def _step_path(self, step: int) -> Path:
         return self.dir / f"ckpt_{step:08d}.npz"
+
+    def _write_latest(self, step: int):
+        tmp = self.dir / "latest.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(step))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.dir / "latest")
+        _fsync_dir(self.dir)
 
     def save(self, step: int, state) -> None:
         if self.use_orbax:
@@ -116,32 +196,81 @@ class CheckpointManager:
             self._mgr.wait_until_finished()
             return
         save(state, self._step_path(step))
+        # pointer AFTER the durable rename: a kill anywhere before this
+        # line leaves ``latest`` on the previous good checkpoint
+        self._write_latest(step)
         self._gc()
+
+    def all_steps(self) -> list:
+        return sorted(int(p.stem.split("_")[1])
+                      for p in self.dir.glob("ckpt_*.npz"))
 
     def latest_step(self) -> Optional[int]:
         if self.use_orbax:
             return self._mgr.latest_step()
-        steps = sorted(int(p.stem.split("_")[1]) for p in self.dir.glob("ckpt_*.npz"))
+        ptr = self.dir / "latest"
+        if ptr.exists():
+            try:
+                step = int(ptr.read_text().strip())
+                if self._step_path(step).exists():
+                    return step
+            except (ValueError, OSError):
+                pass           # damaged pointer: fall back to the glob
+        steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def restore(self, state_like, step: Optional[int] = None):
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return None
+    def restore(self, state_like, step: Optional[int] = None,
+                fallback: bool = True):
         if self.use_orbax:
+            step = step if step is not None else self._mgr.latest_step()
+            if step is None:
+                return None
             import orbax.checkpoint as ocp
             restored = self._mgr.restore(step, args=ocp.args.StandardRestore(
                 jax.tree_util.tree_map(np.asarray, state_like,
                                        is_leaf=lambda x: x is None)))
             flat_new = jax.tree_util.tree_leaves(restored, is_leaf=lambda x: x is None)
             _, treedef = jax.tree_util.tree_flatten(state_like, is_leaf=lambda x: x is None)
+            self.last_restored_step = step
             return jax.tree_util.tree_unflatten(treedef, [
                 jnp.asarray(n, dtype=o.dtype) if isinstance(o, (jax.Array, np.ndarray)) else n
                 for n, o in zip(flat_new, jax.tree_util.tree_leaves(
                     state_like, is_leaf=lambda x: x is None))])
-        return load(self._step_path(step), target=state_like)
+        if step is not None:
+            # explicit step: strict — restoring some OTHER step than the
+            # one asked for would be silent time-travel
+            out = load(self._step_path(step), target=state_like)
+            self.last_restored_step = step
+            return out
+        start = self.latest_step()
+        if start is None:
+            return None
+        if not fallback:
+            out = load(self._step_path(start), target=state_like)
+            self.last_restored_step = start
+            return out
+        candidates = [s for s in reversed(self.all_steps()) if s <= start]
+        errors = []
+        for s in candidates:
+            try:
+                out = load(self._step_path(s), target=state_like)
+                self.last_restored_step = s
+                if errors:
+                    import warnings
+                    warnings.warn(
+                        f"CheckpointManager: fell back to step {s} — newer "
+                        f"checkpoint(s) failed verification: {errors}")
+                return out
+            except (CheckpointCorruptError, OSError, KeyError,
+                    ValueError) as e:
+                errors.append(f"step {s}: {type(e).__name__}: {e}")
+        raise CheckpointCorruptError(
+            f"no loadable checkpoint in {self.dir} (tried "
+            f"{candidates}); failures: {errors}")
 
     def _gc(self):
+        """keep_last_n retention — never deletes the checkpoint the
+        ``latest`` pointer references (it is always the newest)."""
         ckpts = sorted(self.dir.glob("ckpt_*.npz"))
         while len(ckpts) > self.max_to_keep:
             ckpts.pop(0).unlink()

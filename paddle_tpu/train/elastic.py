@@ -34,25 +34,29 @@ class ElasticRunner:
         after a failure the stream is rebuilt, then fast-forwarded by the
         restored step counter via the trainer's resume."""
         while True:
-            trainer = self.make_trainer().resume()
-            # streams are rebuilt fresh by data_fn each (re)start, so the
-            # trainer must fast-forward them to the restored step
-            trainer.args.resume_reskip = True
             dog = None
-            if self.stall_timeout_s and not trainer.args.ckpt_every:
-                import warnings
-                warnings.warn(
-                    "ElasticRunner: stall_timeout_s is set but ckpt_every=0 — "
-                    "a stall restart would lose ALL progress. Set "
-                    "TrainerArgs(ckpt_every=N) so recovery has checkpoints.")
-            if self.stall_timeout_s:
-                # NO emergency save on trip: during a hung step the live
-                # TrainState holds unfulfilled/donated buffers and reading
-                # it from the watchdog thread blocks or throws. Recovery
-                # comes from the trainer's periodic ckpt_every saves.
-                dog = StallWatchdog(self.stall_timeout_s).start()
-                trainer.watchdog = dog  # poked EVERY step inside fit
             try:
+                # resume INSIDE the restart net: restore already falls
+                # back past corrupt checkpoints (CheckpointManager), and
+                # a totally unrestorable state still gets bounded retries
+                # instead of escaping as an unhandled error
+                trainer = self.make_trainer().resume()
+                # streams are rebuilt fresh by data_fn each (re)start, so
+                # the trainer must fast-forward them to the restored step
+                trainer.args.resume_reskip = True
+                if self.stall_timeout_s and not trainer.args.ckpt_every:
+                    import warnings
+                    warnings.warn(
+                        "ElasticRunner: stall_timeout_s is set but ckpt_every=0 — "
+                        "a stall restart would lose ALL progress. Set "
+                        "TrainerArgs(ckpt_every=N) so recovery has checkpoints.")
+                if self.stall_timeout_s:
+                    # NO emergency save on trip: during a hung step the live
+                    # TrainState holds unfulfilled/donated buffers and reading
+                    # it from the watchdog thread blocks or throws. Recovery
+                    # comes from the trainer's periodic ckpt_every saves.
+                    dog = StallWatchdog(self.stall_timeout_s).start()
+                    trainer.watchdog = dog  # poked EVERY step inside fit
                 out = trainer.fit(data_fn(), eval_fn=eval_fn)
                 return out
             except (WatchdogTrip, FloatingPointError, RuntimeError) as e:

@@ -18,6 +18,7 @@ import numpy as np
 from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
 from paddle_tpu.train.checkpoint import CheckpointManager
 from paddle_tpu.train.step import TrainState, init_state
+from paddle_tpu.utils.faults import fault_point, fault_value
 
 
 @dataclass
@@ -31,6 +32,12 @@ class TrainerArgs:
     peak_flops: float = 197e12
     nan_guard: bool = True                # skip update & count on non-finite loss
     max_bad_steps: int = 25               # trip watchdog after this many
+    # backoff after a SKIPPED (non-finite) step: sleep nan_backoff_s,
+    # doubling per consecutive bad step up to nan_backoff_cap_s — a NaN
+    # storm from a sick host/chip slows down instead of spinning the
+    # accelerator at full rate on poisoned updates. 0 disables.
+    nan_backoff_s: float = 0.0
+    nan_backoff_cap_s: float = 30.0
     resume_reskip: bool = False           # fast-forward a FRESH stream on resume
     # (leave False when the caller positions the iterator; ElasticRunner
     # always rebuilds streams from scratch and turns this on)
@@ -49,6 +56,8 @@ class Trainer:
         self.history: list[dict] = []
         self._bad_steps = 0
         self.watchdog = None           # StallWatchdog, poked every step
+        # robustness accounting — ElasticRunner and tests read these
+        self.stats = {"nan_skips": 0, "bad_streak_max": 0}
 
     def _build_step(self):
         loss_fn = self.loss_fn
@@ -116,20 +125,35 @@ class Trainer:
             for _ in range(start_step * accum):
                 next(it)
         for _ in range(start_step, args.max_steps):
+            # chaos hooks: train.step may raise (→ elastic restart) or
+            # stall (→ StallWatchdog trip); train.loss overrides the host
+            # loss value (NaN-storm injection without poisoning data)
+            fault_point("train.step", step=int(self.state.step),
+                        trainer=self)
             micro = [self._to_batch(next(it)) for _ in range(accum)]
             self.state, loss = self._step_fn(self.state, *micro)
             if self.watchdog is not None:
                 self.watchdog.poke()   # raises WatchdogTrip if stalled
             step_no = int(self.state.step)
-            loss_val = float(loss)
+            loss_val = fault_value("train.loss", float(loss), step=step_no)
 
             if args.nan_guard:
                 if not np.isfinite(loss_val):
+                    # the in-graph guard already kept the params/opt state
+                    # of the poisoned update; here we count, back off, and
+                    # eventually trip into the elastic restart path
                     self._bad_steps += 1
+                    self.stats["nan_skips"] += 1
+                    self.stats["bad_streak_max"] = max(
+                        self.stats["bad_streak_max"], self._bad_steps)
                     if self._bad_steps >= args.max_bad_steps:
                         from paddle_tpu.utils.watchdog import WatchdogTrip
                         raise WatchdogTrip(
                             f"{self._bad_steps} consecutive non-finite losses")
+                    if args.nan_backoff_s > 0:
+                        time.sleep(min(
+                            args.nan_backoff_s * 2 ** (self._bad_steps - 1),
+                            args.nan_backoff_cap_s))
                 else:
                     self._bad_steps = 0
 

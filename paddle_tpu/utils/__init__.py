@@ -6,5 +6,7 @@ from paddle_tpu.utils.profiler import (
     record_event,
 )
 from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip, check_finite
+from paddle_tpu.utils.faults import (FAULTS, FaultRegistry, InjectedCrash,
+                                     InjectedFault, fault_point, fault_value)
 from paddle_tpu.utils import dlpack
 from paddle_tpu.utils import cpp_extension

@@ -1,0 +1,193 @@
+"""Deterministic fault injection (chaos layer).
+
+The ROADMAP north-star is a production system; PAPER.md §2.9 promises
+EXACT elastic restore. The only way to *prove* the recovery paths
+(preemption, NaN skip, elastic restart, crash-safe checkpoints) stay
+correct is to drive them through induced failures on demand — seeded
+and reproducible, so a chaos test that fails once fails every time.
+
+Instrumented sites call :func:`fault_point` (a cheap no-op while no
+rule is installed). Tests install rules against site names:
+
+    serving.alloc    block allocation inside the engine (MemoryError)
+    serving.tick     top of ``LLMEngine.step`` (exception / stall)
+    serving.preempt  induced preemption (rule action receives the engine)
+    train.step       top of each trainer step (exception / stall)
+    train.loss       loss override — return value replaces the real loss
+                     (NaN injection)
+    ckpt.write       before the checkpoint tmp file is written (OSError)
+    ckpt.rename      between tmp-write and the atomic rename — the
+                     crash window (InjectedCrash)
+
+Rules fire on specific hit counts of their site (``on={3, 5}``), every
+k-th hit (``every=3``), or a seeded pseudo-random schedule
+(:meth:`FaultRegistry.schedule`). An exhausted rule (``times``) stops
+firing; ``clear()`` removes everything. All state is per-process and
+host-side only — nothing here ever traces into a jitted program.
+
+Usage::
+
+    from paddle_tpu.utils.faults import FAULTS, InjectedFault
+    with FAULTS.scope("serving.alloc", exc=MemoryError, on={2, 3}):
+        eng.run()        # 2nd and 3rd allocation attempts fail
+
+Ref: Fleet's elastic controller is validated the same way in the
+reference — induced pod kills, not production incidents.
+"""
+from __future__ import annotations
+
+import contextlib
+import random
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+__all__ = ["FAULTS", "FaultRegistry", "FaultRule", "InjectedFault",
+           "InjectedCrash", "fault_point", "fault_value"]
+
+
+class InjectedFault(RuntimeError):
+    """Default exception raised by a rule with no explicit ``exc``."""
+
+
+class InjectedCrash(RuntimeError):
+    """Simulates a process kill at a crash window (e.g. mid-checkpoint-
+    save). A RuntimeError so ElasticRunner's restart net catches it."""
+
+
+@dataclass
+class FaultRule:
+    """One installed fault. Matches when its site is hit and the hit
+    index (0-based, per site, counted from installation) satisfies
+    ``on``/``every``; fires at most ``times`` times (None = unbounded).
+
+    Exactly one behaviour:
+      * ``exc``     — an exception class or instance to raise
+      * ``action``  — called with the site's context kwargs; its return
+                      value is handed back to the fault point (the
+                      ``train.loss`` site uses it as the loss override)
+      * ``stall_s`` — sleep this long (stall injection)
+    """
+    site: str
+    on: Optional[frozenset] = None
+    every: Optional[int] = None
+    times: Optional[int] = None
+    exc: Any = None
+    action: Optional[Callable[..., Any]] = None
+    stall_s: Optional[float] = None
+    fired: int = 0
+    _base_hit: int = 0          # site hit count when the rule was installed
+
+    def matches(self, hit: int) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        rel = hit - self._base_hit
+        if self.on is not None:
+            return rel in self.on
+        if self.every is not None:
+            return self.every > 0 and rel % self.every == self.every - 1
+        return True
+
+    def fire(self, ctx: dict):
+        self.fired += 1
+        if self.exc is not None:
+            raise self.exc if isinstance(self.exc, BaseException) \
+                else self.exc(f"injected fault at {self.site}")
+        if self.stall_s is not None:
+            time.sleep(self.stall_s)
+            return None
+        if self.action is not None:
+            return self.action(ctx)
+        raise InjectedFault(f"injected fault at {self.site}")
+
+
+class FaultRegistry:
+    """Per-process rule table + per-site hit counters. The module-level
+    :data:`FAULTS` singleton is what the instrumented sites consult."""
+
+    def __init__(self):
+        self._rules: dict[str, list[FaultRule]] = defaultdict(list)
+        self.hits: dict[str, int] = defaultdict(int)
+        self.log: list[tuple[str, int]] = []   # (site, hit) of every firing
+
+    # ------------------------------------------------------------- admin
+    def install(self, site: str, *, on=None, every: Optional[int] = None,
+                times: Optional[int] = None, exc=None,
+                action: Optional[Callable] = None,
+                stall_s: Optional[float] = None) -> FaultRule:
+        rule = FaultRule(site=site,
+                         on=None if on is None else frozenset(on),
+                         every=every, times=times, exc=exc, action=action,
+                         stall_s=stall_s, _base_hit=self.hits[site])
+        self._rules[site].append(rule)
+        return rule
+
+    def schedule(self, site: str, *, seed: int, p: float, horizon: int,
+                 **kw) -> FaultRule:
+        """Seeded pseudo-random hit set: each of the next ``horizon``
+        hits of ``site`` fails independently with probability ``p``,
+        drawn from ``random.Random(seed)`` — the same seed always yields
+        the same schedule, so chaos runs are reproducible bit-for-bit."""
+        rng = random.Random(seed)
+        on = frozenset(i for i in range(horizon) if rng.random() < p)
+        return self.install(site, on=on, **kw)
+
+    def remove(self, rule: FaultRule):
+        self._rules.get(rule.site, []) and self._rules[rule.site].remove(rule)
+        if not self._rules.get(rule.site):
+            self._rules.pop(rule.site, None)
+
+    def clear(self, site: Optional[str] = None):
+        if site is None:
+            self._rules.clear()
+            self.hits.clear()
+            self.log.clear()
+        else:
+            self._rules.pop(site, None)
+
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    @contextlib.contextmanager
+    def scope(self, site: str, **kw):
+        """Install a rule for the duration of a with-block."""
+        rule = self.install(site, **kw)
+        try:
+            yield rule
+        finally:
+            self.remove(rule)
+
+    # ------------------------------------------------------------ firing
+    def fire(self, site: str, **ctx):
+        """Advance ``site``'s hit counter; run every matching rule.
+        Returns the last matching rule's action result (None when no
+        rule matched or the rule raised/stalled)."""
+        hit = self.hits[site]
+        self.hits[site] = hit + 1
+        out = None
+        for rule in self._rules.get(site, ()):
+            if rule.matches(hit):
+                self.log.append((site, hit))
+                out = rule.fire(ctx)
+        return out
+
+
+FAULTS = FaultRegistry()
+
+
+def fault_point(site: str, **ctx):
+    """Instrumentation hook. A no-op (one dict lookup) unless a rule is
+    installed for any site; returns the matched rule's action result."""
+    if not FAULTS._rules:
+        return None
+    return FAULTS.fire(site, **ctx)
+
+
+def fault_value(site: str, default, **ctx):
+    """Value-override hook (e.g. ``train.loss``): returns ``default``
+    unless a matching rule's action supplies a replacement."""
+    if not FAULTS._rules:
+        return default
+    out = FAULTS.fire(site, default=default, **ctx)
+    return default if out is None else out

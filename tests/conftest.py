@@ -20,8 +20,25 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests — seeded "
+        "schedules, CPU-safe, run in tier-1 (no slow marker)")
+    config.addinivalue_line("markers", "slow: long-running; excluded from "
+                            "the tier-1 '-m not slow' run")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as pt
     pt.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    """Chaos hygiene: no fault rule ever leaks across tests."""
+    from paddle_tpu.utils.faults import FAULTS
+    FAULTS.clear()
+    yield
+    FAULTS.clear()

@@ -22,6 +22,7 @@ def _qkv(rs, b, s, h, d, hkv=None):
     return q, k, v
 
 
+@pytest.mark.slow
 def test_ring_attention_kv_lens_matches_masked_full():
     b, s, h, d = 2, 32, 2, 8
     rs = np.random.RandomState(0)
@@ -41,6 +42,7 @@ def test_ring_attention_kv_lens_matches_masked_full():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_dense_mask_fwd_and_grad():
     b, s, h, d = 1, 16, 2, 4
     rs = np.random.RandomState(1)
@@ -98,6 +100,7 @@ def test_ulysses_kv_lens_and_mask():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_mistral_window_composes_with_ulysses():
     """Mistral x Ulysses now WORKS (r1 raised): global sliding window via
     the full-sequence inner attention after the all_to_all."""
@@ -113,6 +116,7 @@ def test_mistral_window_composes_with_ulysses():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_llama_ring_with_attn_mask():
     """Model-level: LLaMA with sequence_parallel='ring' accepts attn_mask
     (r1: it raised NotImplementedError)."""
@@ -145,6 +149,7 @@ def test_llama_ring_with_attn_mask():
                                rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_llama_sp_bool_broadcast_mask_and_float_bias():
     """A [B,1,1,S] BOOL key-padding mask broadcasts through the sp
     dispatch; float additive and per-head masks ride the sp BIAS path
@@ -205,6 +210,7 @@ def _alibi_bias(h, s):
                        * (i - j)[None, None], jnp.float32)
 
 
+@pytest.mark.slow
 def test_ring_additive_per_head_bias_fwd_and_grads():
     """Ring attention with an ALiBi/T5-style additive per-head bias ==
     full attention; grads (incl. d(bias) — T5's bias is LEARNED) match."""
@@ -232,6 +238,7 @@ def test_ring_additive_per_head_bias_fwd_and_grads():
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_ring_bias_composes_with_bool_mask_and_gqa():
     """Additive bias + dense bool mask + GQA heads through the ring."""
     b, s, h, d = 2, 16, 4, 4
@@ -252,6 +259,7 @@ def test_ring_bias_composes_with_bool_mask_and_gqa():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_additive_per_head_bias_fwd_and_grads():
     """Ulysses with a per-head additive bias: the bias head dim shards
     over sp to match the post-all_to_all head slice; fwd + grads parity."""
@@ -279,6 +287,7 @@ def test_ulysses_additive_per_head_bias_fwd_and_grads():
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_per_head_bias_composes_with_tp():
     """tp x sp: bias heads shard (tp-major, sp-minor) to exactly the head
     range each device computes after the all_to_all."""

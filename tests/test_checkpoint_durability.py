@@ -1,0 +1,235 @@
+"""Checkpoint crash-safety chaos tests (ISSUE 1 tentpole + satellite).
+
+The durability contract under kill-at-any-point:
+
+  * :func:`save` is atomic — a crash before/at the rename leaves the
+    previous complete file untouched (tmp + fsync + ``os.replace``)
+  * :func:`load` verifies per-array CRCs — damage raises
+    :class:`CheckpointCorruptError`, never restores garbage
+  * ``CheckpointManager``'s ``latest`` pointer advances only AFTER the
+    durable rename, so a kill during save never leaves an unloadable
+    latest; ``restore`` falls back past corrupt checkpoints
+  * end-to-end: ``run_elastic`` survives an injected kill mid-save and
+    still finishes training
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.train.checkpoint import (CheckpointCorruptError,
+                                         CheckpointManager, load, save)
+from paddle_tpu.train.elastic import ElasticRunner
+from paddle_tpu.train.trainer import Trainer, TrainerArgs
+from paddle_tpu.utils.faults import FAULTS, InjectedCrash
+
+pytestmark = pytest.mark.chaos
+
+
+def _state(v: float):
+    return {"w": np.full((4,), v, np.float32), "step": int(v)}
+
+
+# ----------------------------------------------------------- atomic save
+
+@pytest.mark.parametrize("site", ["ckpt.write", "ckpt.rename"])
+def test_kill_during_save_preserves_previous_file(tmp_path, site):
+    """A crash at EITHER window — before the tmp write or between the tmp
+    write and the rename — must leave the prior complete checkpoint
+    loadable and byte-identical."""
+    path = tmp_path / "ck.npz"
+    save(_state(1.0), path)
+    FAULTS.install(site, on={0}, exc=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        save(_state(2.0), path)
+    FAULTS.clear()
+    got = load(path, target=_state(0.0))
+    np.testing.assert_array_equal(np.asarray(got["w"]), _state(1.0)["w"])
+    assert got["step"] == 1
+    # a retried save (the crash window now clear) supersedes cleanly,
+    # stale .tmp or not
+    save(_state(2.0), path)
+    assert load(path, target=_state(0.0))["step"] == 2
+
+
+def test_save_is_atomic_even_first_time(tmp_path):
+    """Crash on the very first save: no final file may exist at all —
+    half-written checkpoints must be invisible to readers."""
+    path = tmp_path / "ck.npz"
+    FAULTS.install("ckpt.rename", on={0}, exc=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        save(_state(1.0), path)
+    assert not path.exists()
+    with pytest.raises(FileNotFoundError):
+        load(path)
+
+
+# ------------------------------------------------------------ CRC verify
+
+def test_truncated_file_raises_corrupt(tmp_path):
+    path = tmp_path / "ck.npz"
+    save(_state(3.0), path)
+    raw = path.read_bytes()
+    path.write_bytes(raw[: len(raw) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        load(path)
+
+
+def test_crc_mismatch_raises_corrupt(tmp_path):
+    """Bit-rot that the zip container misses: rewrite the archive with a
+    stored CRC that no longer matches the payload — the meta-level CRC
+    check must catch it (and ``verify=False`` must skip it)."""
+    path = tmp_path / "ck.npz"
+    save(_state(4.0), path)
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    for lm in meta["leaves"]:
+        if lm.get("kind") == "array":
+            lm["crc"] ^= 0xDEADBEEF
+    np.savez(str(path), __meta__=json.dumps(meta), **arrays)
+    with pytest.raises(CheckpointCorruptError, match="CRC mismatch"):
+        load(path)
+    got = load(path, target=_state(0.0), verify=False)   # explicit opt-out
+    np.testing.assert_array_equal(np.asarray(got["w"]), _state(4.0)["w"])
+
+
+# ----------------------------------------------------- manager + pointer
+
+def test_latest_pointer_survives_kill_during_save(tmp_path):
+    mgr = CheckpointManager(tmp_path, max_to_keep=3)
+    mgr.save(1, _state(1.0))
+    assert mgr.latest_step() == 1
+    FAULTS.install("ckpt.rename", on={0}, exc=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        mgr.save(2, _state(2.0))
+    FAULTS.clear()
+    # pointer never advanced: latest is still the previous GOOD step,
+    # and it restores
+    assert mgr.latest_step() == 1
+    got = mgr.restore(_state(0.0))
+    assert got["step"] == 1 and mgr.last_restored_step == 1
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path):
+    mgr = CheckpointManager(tmp_path, max_to_keep=5)
+    for s in (1, 2, 3):
+        mgr.save(s, _state(float(s)))
+    p3 = mgr._step_path(3)
+    p3.write_bytes(p3.read_bytes()[:40])          # rot the newest
+    with pytest.warns(UserWarning, match="fell back"):
+        got = mgr.restore(_state(0.0))
+    assert got["step"] == 2 and mgr.last_restored_step == 2
+    # strict modes refuse to time-travel silently
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore(_state(0.0), step=3)
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore(_state(0.0), fallback=False)
+
+
+def test_restore_raises_when_nothing_loadable(tmp_path):
+    mgr = CheckpointManager(tmp_path, max_to_keep=5)
+    for s in (1, 2):
+        mgr.save(s, _state(float(s)))
+    for s in (1, 2):
+        mgr._step_path(s).write_bytes(b"not a checkpoint")
+    with pytest.raises(CheckpointCorruptError, match="no loadable"):
+        mgr.restore(_state(0.0))
+
+
+def test_retention_never_deletes_latest_target(tmp_path):
+    mgr = CheckpointManager(tmp_path, max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, _state(float(s)))
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+    assert mgr.restore(_state(0.0))["step"] == 4
+
+
+def test_damaged_pointer_falls_back_to_glob(tmp_path):
+    mgr = CheckpointManager(tmp_path, max_to_keep=3)
+    mgr.save(5, _state(5.0))
+    (tmp_path / "latest").write_text("garbage")
+    assert mgr.latest_step() == 5
+    (tmp_path / "latest").write_text("999")       # dangling reference
+    assert mgr.latest_step() == 5
+
+
+# ------------------------------------------------------ elastic end-to-end
+
+def test_elastic_survives_kill_during_save(tmp_path):
+    """Kill the trainer mid-save (between tmp-write and rename) via the
+    fault registry: the elastic restart restores the previous durable
+    step and finishes all 8 steps; at no point is ``latest`` unloadable."""
+    pt.seed(0)
+
+    def loss_fn(m, x, y):
+        return nn.functional.mse_loss(m(x), y)
+
+    def make_trainer():
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(3, 8), nn.Tanh(), nn.Linear(8, 1))
+        return Trainer(net, opt.SGD(learning_rate=0.05), loss_fn,
+                       TrainerArgs(max_steps=8, log_every=0, ckpt_every=2,
+                                   ckpt_dir=str(tmp_path), nan_guard=False))
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 3)).astype(np.float32)
+    Y = (X @ np.array([[1.0], [-2.0], [0.5]], np.float32))
+
+    def data_fn():
+        def gen():
+            i = 0
+            while True:
+                sl = slice((i * 4) % 64, (i * 4) % 64 + 4)
+                yield X[sl], Y[sl]
+                i += 1
+        return gen()
+
+    # saves land at steps 2,4,6,8 -> rename hits 0,1,2,3. Kill hit 1
+    # (the step-4 save): restart resumes from step 2, the step-4 save
+    # retries clean (hit 2), training runs through step 8.
+    FAULTS.install("ckpt.rename", on={1}, exc=InjectedCrash)
+    runner = ElasticRunner(make_trainer, max_restarts=2, backoff_s=0.0)
+    state = runner.run(data_fn)
+    assert int(state.step) == 8
+    assert runner.restarts == 1
+    assert any("InjectedCrash" in f for f in runner.failures)
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.latest_step() == 8
+
+
+def test_elastic_recovers_from_injected_step_exception(tmp_path):
+    """train.step chaos site: a one-shot injected exception inside the
+    fit loop rides the same restart net as a real device error."""
+    pt.seed(0)
+
+    def make_trainer():
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(2, 4), nn.Tanh(), nn.Linear(4, 1))
+        return Trainer(net, opt.SGD(learning_rate=0.05),
+                       lambda m, x, y: nn.functional.mse_loss(m(x), y),
+                       TrainerArgs(max_steps=6, log_every=0, ckpt_every=2,
+                                   ckpt_dir=str(tmp_path), nan_guard=False))
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((32, 2)).astype(np.float32)
+    Y = X.sum(1, keepdims=True)
+
+    def data_fn():
+        def gen():
+            i = 0
+            while True:
+                sl = slice((i * 4) % 32, (i * 4) % 32 + 4)
+                yield X[sl], Y[sl]
+                i += 1
+        return gen()
+
+    FAULTS.install("train.step", on={4}, exc=InjectedCrash)
+    runner = ElasticRunner(make_trainer, max_restarts=2, backoff_s=0.0)
+    state = runner.run(data_fn)
+    assert int(state.step) == 6
+    assert runner.restarts == 1

@@ -28,6 +28,7 @@ def test_train_llama_example(tmp_path):
     assert losses[-1] < losses[0]  # trains
 
 
+@pytest.mark.slow
 def test_train_resnet_example():
     out = _run("train_resnet.py", "--steps", "4", "--batch", "4")
     assert "loss" in out
@@ -47,6 +48,7 @@ def test_generate_example():
     assert "mistral/greedy" in out
 
 
+@pytest.mark.slow
 def test_long_context_example():
     out = _run("long_context.py", "--mode", "ring", "--steps", "2",
                "--seq", "64")

@@ -1,0 +1,121 @@
+"""Fault-injection registry unit tests (paddle_tpu/utils/faults.py).
+
+The chaos layer itself must be boring and exact: rules fire on the hit
+indices they were given, seeded schedules replay bit-for-bit, scopes
+clean up after themselves. Every serving/training chaos test builds on
+these semantics.
+"""
+import pytest
+
+from paddle_tpu.utils.faults import (FAULTS, FaultRegistry, InjectedCrash,
+                                     InjectedFault, fault_point, fault_value)
+
+pytestmark = pytest.mark.chaos
+
+
+def test_noop_without_rules():
+    assert fault_point("nowhere") is None
+    assert fault_value("nowhere", 42) == 42
+    assert not FAULTS.active()
+
+
+def test_on_hits_fire_exactly():
+    FAULTS.install("s", on={1, 3}, exc=InjectedFault)
+    fault_point("s")                       # hit 0: clean
+    with pytest.raises(InjectedFault):
+        fault_point("s")                   # hit 1
+    fault_point("s")                       # hit 2: clean
+    with pytest.raises(InjectedFault):
+        fault_point("s")                   # hit 3
+    fault_point("s")                       # hit 4: clean
+    assert FAULTS.log == [("s", 1), ("s", 3)]
+
+
+def test_every_kth_hit():
+    FAULTS.install("e", every=3, exc=MemoryError)
+    pattern = []
+    for _ in range(9):
+        try:
+            fault_point("e")
+            pattern.append(0)
+        except MemoryError:
+            pattern.append(1)
+    assert pattern == [0, 0, 1] * 3
+
+
+def test_times_bound_exhausts():
+    FAULTS.install("t", every=1, times=2, exc=InjectedFault)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            fault_point("t")
+    fault_point("t")                       # exhausted: clean forever after
+    fault_point("t")
+
+
+def test_hits_relative_to_install():
+    """A rule's ``on`` indices count from ITS installation, not from the
+    process-wide site counter — late-installed rules stay predictable."""
+    for _ in range(5):
+        fault_point("r")                   # pre-warm the site counter
+    FAULTS.install("r", on={0}, exc=InjectedFault)
+    with pytest.raises(InjectedFault):
+        fault_point("r")
+
+
+def test_scope_installs_and_removes():
+    with FAULTS.scope("sc", on={0}, exc=InjectedFault):
+        with pytest.raises(InjectedFault):
+            fault_point("sc")
+    fault_point("sc")                      # out of scope: clean
+    assert not FAULTS.active()
+
+
+def test_action_return_value_and_fault_value():
+    FAULTS.install("loss", on={1}, action=lambda ctx: float("nan"))
+    import math
+    assert fault_value("loss", 1.0) == 1.0             # hit 0: default
+    assert math.isnan(fault_value("loss", 1.0))        # hit 1: override
+    assert fault_value("loss", 2.5) == 2.5
+
+
+def test_action_receives_context():
+    seen = {}
+    FAULTS.install("ctx", on={0}, action=lambda c: seen.update(c))
+    fault_point("ctx", rid=7, engine="E")
+    assert seen["rid"] == 7 and seen["engine"] == "E"
+
+
+def test_seeded_schedule_reproducible():
+    a = FaultRegistry()
+    b = FaultRegistry()
+    ra = a.schedule("x", seed=123, p=0.3, horizon=50, exc=InjectedFault)
+    rb = b.schedule("x", seed=123, p=0.3, horizon=50, exc=InjectedFault)
+    assert ra.on == rb.on and 0 < len(ra.on) < 50
+    rc = a.schedule("y", seed=124, p=0.3, horizon=50, exc=InjectedFault)
+    assert rc.on != ra.on                  # different seed, different chaos
+
+
+def test_clear_site_and_all():
+    FAULTS.install("a", every=1, exc=InjectedFault)
+    FAULTS.install("b", every=1, exc=InjectedFault)
+    FAULTS.clear("a")
+    fault_point("a")                       # cleared: clean
+    with pytest.raises(InjectedFault):
+        fault_point("b")
+    FAULTS.clear()
+    fault_point("b")
+    assert not FAULTS.active()
+
+
+def test_injected_crash_is_runtimeerror():
+    """ElasticRunner's restart net catches RuntimeError — the simulated
+    kill must ride it."""
+    assert issubclass(InjectedCrash, RuntimeError)
+
+
+def test_stall_action_sleeps():
+    import time
+    FAULTS.install("z", on={0}, stall_s=0.05)
+    t0 = time.monotonic()
+    fault_point("z")
+    assert time.monotonic() - t0 >= 0.04

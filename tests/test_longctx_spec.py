@@ -274,6 +274,7 @@ def test_dynamic_ntk_chunked_prefill_matches_forward():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_speculative_dynamic_ntk_stays_lossless():
     """Speculative chunk verify under dynamic-NTK rotates each position
     with ITS current length (like one-at-a-time decode) — output still

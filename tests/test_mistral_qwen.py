@@ -168,6 +168,7 @@ def test_window_without_causal_raises():
         xla_attention(q, q, q, is_causal=False, window=4)
 
 
+@pytest.mark.slow
 def test_mistral_generation_consistent_with_forward():
     """KV-cache decode honors the sliding window: greedy generation must
     match argmax over the full windowed forward."""

@@ -80,6 +80,7 @@ def test_moe_llm_train():
     _train_decreases(MoEForCausalLM(cfg), _lm_batch(cfg.base.vocab_size))
 
 
+@pytest.mark.slow
 def test_resnet18_forward_and_grad():
     pt.seed(0)
     m = resnet18(num_classes=10)

@@ -173,7 +173,7 @@ def test_partition_specs_respect_tp_annotations():
 
 
 def test_collectives_shard_map():
-    from jax import shard_map
+    from paddle_tpu.distributed._compat import shard_map
     from jax.sharding import PartitionSpec as P
     import paddle_tpu.distributed as dist
 

@@ -182,6 +182,7 @@ def test_1f1b_optimizer_integrated_training_matches_adamw():
 
 
 @pytest.mark.parametrize("dp", [1, 2])
+@pytest.mark.slow
 def test_1f1b_composes_with_tp(dp):
     """Full hybrid: tensor parallelism INSIDE the 1F1B pipeline (pp x tp,
     and pp x tp x dp): Megatron-interleaved fused projections, explicit

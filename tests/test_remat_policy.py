@@ -1,0 +1,97 @@
+"""Selective-remat policy tests (ISSUE 1 satellite: the ``remat_policy``
+flag was parsed but never reached ``jax.checkpoint`` — VERDICT r4 item 1).
+
+Assert the policy is ACTUALLY applied, not just accepted: the residuals
+jax saves across the per-layer checkpoint must grow as the policy keeps
+more named activations, the ffn_gu tensor must appear exactly when a
+policy names it, and — remat being a pure memory/recompute trade —
+loss and gradients must be bit-identical across every policy.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.core.module import value_and_grad
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+try:
+    from jax._src.ad_checkpoint import saved_residuals
+except ImportError:                        # pragma: no cover
+    saved_residuals = None
+
+# hidden=32, intermediate=48: the fused gate_up ("ffn_gu") activation has
+# last dim 2*48=96 — unique in the net, so its presence in the saved
+# residuals identifies the policy unambiguously
+_B, _S, _H, _I = 1, 8, 32, 48
+
+
+@pytest.fixture(scope="module")
+def setup():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(remat=True, num_hidden_layers=2, hidden_size=_H,
+                           intermediate_size=_I, num_attention_heads=4,
+                           num_key_value_heads=2, vocab_size=64,
+                           scan_layers=False)
+    model = LlamaForCausalLM(cfg)
+    ids = np.arange(_S, dtype=np.int32)[None]
+    labels = np.concatenate(
+        [ids[:, 1:], -100 * np.ones((_B, 1), np.int32)], axis=1)
+    return model, jnp.asarray(ids), jnp.asarray(labels)
+
+
+def _residual_shapes(model, ids, labels):
+    res = saved_residuals(lambda m: m.loss(ids, labels), model)
+    # drop arguments (params/inputs are always live) — count only what
+    # the checkpoint policy chose to SAVE from the forward
+    return [tuple(a.shape) for a, d in res if "argument" not in d]
+
+
+@pytest.mark.skipif(saved_residuals is None,
+                    reason="jax saved_residuals unavailable")
+def test_policy_monotonically_grows_saved_residuals(setup):
+    model, ids, labels = setup
+    counts = {}
+    for pol in [None, "hidden", "no_ffn", "dots"]:
+        model.cfg.remat_policy = pol
+        counts[pol] = len(_residual_shapes(model, ids, labels))
+    assert counts[None] < counts["hidden"] < counts["no_ffn"] < counts["dots"]
+
+
+@pytest.mark.skipif(saved_residuals is None,
+                    reason="jax saved_residuals unavailable")
+def test_ffn_gu_saved_exactly_when_policy_names_it(setup):
+    model, ids, labels = setup
+    gu_shape = (_B, _S, 2 * _I)
+    model.cfg.remat_policy = "dots"        # names "ffn_gu"
+    assert gu_shape in _residual_shapes(model, ids, labels)
+    model.cfg.remat_policy = "no_ffn"      # does not
+    assert gu_shape not in _residual_shapes(model, ids, labels)
+
+
+def test_loss_and_grads_identical_across_policies(setup):
+    model, ids, labels = setup
+    ref = None
+    for pol in [None, "full", "hidden", "no_ffn", "dots"]:
+        model.cfg.remat_policy = pol
+        loss, grads = value_and_grad(
+            lambda m, i, l: m.loss(i, l))(model, ids, labels)
+        flat = [np.asarray(g) for g in jax.tree_util.tree_leaves(grads)
+                if g is not None]
+        if ref is None:
+            ref = (float(loss), flat)
+            continue
+        assert float(loss) == ref[0], f"loss drifted under {pol!r}"
+        for a, b in zip(flat, ref[1]):
+            np.testing.assert_allclose(a, b, rtol=0, atol=0,
+                                       err_msg=f"grad drifted under {pol!r}")
+
+
+def test_unknown_policy_raises(setup):
+    model, ids, labels = setup
+    model.cfg.remat_policy = "everything"
+    with pytest.raises(ValueError, match="remat_policy"):
+        model(ids)
+    model.cfg.remat_policy = None

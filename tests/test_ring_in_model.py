@@ -18,6 +18,7 @@ def _data(cfg, batch=2, seq=32):
     return ids, labels
 
 
+@pytest.mark.slow
 def test_ring_model_matches_single_device():
     pt.seed(0)
     cfg = LlamaConfig.tiny(num_hidden_layers=2)
@@ -61,6 +62,7 @@ def test_ring_model_with_tp_and_sp():
     assert abs(loss - ref_loss) < 2e-4, (loss, ref_loss)
 
 
+@pytest.mark.slow
 def test_ring_model_trains_end_to_end():
     import paddle_tpu.optimizer as opt
     from paddle_tpu.train import make_train_step
@@ -93,6 +95,7 @@ def test_ring_falls_back_without_sp_mesh():
     assert out.shape == (1, 16, cfg.vocab_size)
 
 
+@pytest.mark.slow
 def test_ring_gqa_grouped_matches_full():
     """GQA ring (grouped einsum, unrepeated KV rotation) == full attention."""
     from paddle_tpu.distributed.ring_attention import make_ring_attention
@@ -109,6 +112,7 @@ def test_ring_gqa_grouped_matches_full():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_gqa():
     from paddle_tpu.distributed.ring_attention import (
         make_zigzag_ring_attention, zigzag_inverse_permutation,
@@ -130,7 +134,11 @@ def test_zigzag_ring_gqa():
                                rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("window", [4, 9, 100])
+@pytest.mark.parametrize("window", [
+    4,
+    pytest.param(9, marks=pytest.mark.slow),
+    pytest.param(100, marks=pytest.mark.slow),
+])
 def test_windowed_ring_matches_windowed_full(window):
     """Global sliding window across shard boundaries == windowed full
     attention."""
@@ -148,6 +156,7 @@ def test_windowed_ring_matches_windowed_full(window):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_windowed_ring_grads_match():
     from paddle_tpu.distributed.ring_attention import make_ring_attention
     from paddle_tpu.ops.attention import xla_attention
@@ -184,6 +193,7 @@ def test_mistral_ring_matches_single_device():
     assert abs(loss - ref_loss) < 2e-4, (loss, ref_loss)
 
 
+@pytest.mark.slow
 def test_ulysses_with_window_matches_single_device():
     """Round 1 raised here; the window now composes with Ulysses (the
     post-all_to_all inner attention is full-sequence, so the global band
@@ -218,6 +228,7 @@ def test_ulysses_model_matches_single_device():
     assert abs(loss - ref_loss) < 2e-4, (loss, ref_loss)
 
 
+@pytest.mark.slow
 def test_ulysses_model_trains():
     import paddle_tpu.optimizer as opt
     from paddle_tpu.train import make_train_step

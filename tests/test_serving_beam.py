@@ -53,6 +53,7 @@ def test_engine_beam_matches_paged_beam_search(model):
     assert eng.mgr.free_blocks == eng.mgr.num_blocks
 
 
+@pytest.mark.slow
 def test_engine_beam_rides_with_greedy_traffic(model):
     """A beam request and greedy requests interleave in the same ticks;
     each result equals its isolated reference, under oversubscription."""

@@ -1,0 +1,343 @@
+"""Serving hardening + chaos tests (ISSUE 1 tentpole).
+
+Deadlines, cancellation, backpressure, and graceful drain for
+``LLMEngine`` — then seeded fault schedules (allocator failure, induced
+preemption, tick exceptions) driven through full runs with the
+invariants the production story needs:
+
+  * zero leaked blocks (``assert_quiescent``: every block back, no
+    standing reservations, no per-sequence tables)
+  * no livelock (every run bounded in ticks)
+  * expired requests finish with finish_reason == "timeout"
+  * surviving outputs still EQUAL solo greedy (recovery never corrupts)
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.models.decoding import generate
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (EngineDrainingError, LLMEngine,
+                                QueueFullError, Request)
+from paddle_tpu.utils.faults import FAULTS, InjectedFault
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+class FakeClock:
+    """Deterministic engine clock: tests advance it explicitly."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _solo(model, p, n):
+    return np.asarray(generate(model, jnp.asarray(np.asarray(p)[None]),
+                               max_new_tokens=n))[0, len(p):]
+
+
+def _run_bounded(eng, max_ticks=400):
+    ticks = 0
+    while eng.has_work():
+        eng.step()
+        ticks += 1
+        assert ticks < max_ticks, "livelock: engine did not drain"
+    return ticks
+
+
+# ------------------------------------------------------------- deadlines
+
+def test_deadline_expires_inflight_request(model):
+    clk = FakeClock()
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=16,
+                    max_seq_len=64, clock=clk)
+    rs = np.random.RandomState(0)
+    slow = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                   max_new_tokens=30, deadline_s=5.0))
+    fast = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                   max_new_tokens=30))
+    while eng.has_work():
+        eng.step()
+        clk.t += 1.0          # 1s per tick: the deadline hits mid-decode
+    r_slow, r_fast = eng.requests[slow], eng.requests[fast]
+    assert r_slow.done and r_slow.finish_reason == "timeout"
+    assert 0 < len(r_slow.tokens) < 30      # partial output survives
+    assert r_fast.finish_reason == "length" and len(r_fast.tokens) == 30
+    assert eng.stats["timeouts"] == 1
+    eng.assert_quiescent()
+
+
+def test_max_queue_s_times_out_waiting_request(model):
+    clk = FakeClock()
+    eng = LLMEngine(model, num_slots=1, block_size=4, max_prompt_len=16,
+                    max_seq_len=32, clock=clk)
+    rs = np.random.RandomState(1)
+    head = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                   max_new_tokens=12))
+    waiter = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                     max_new_tokens=4, max_queue_s=3.0))
+    while eng.has_work():
+        eng.step()
+        clk.t += 1.0
+    assert eng.requests[waiter].finish_reason == "timeout"
+    assert eng.requests[waiter].tokens == []     # never admitted
+    assert eng.requests[head].finish_reason == "length"
+    eng.assert_quiescent()
+
+
+def test_deadline_already_expired_request_never_runs(model):
+    clk = FakeClock()
+    eng = LLMEngine(model, num_slots=1, block_size=4, max_prompt_len=16,
+                    max_seq_len=32, clock=clk)
+    rid = eng.add_request(Request([1, 2, 3], max_new_tokens=4,
+                                  deadline_s=1.0))
+    clk.t = 2.0
+    _run_bounded(eng)
+    assert eng.requests[rid].finish_reason == "timeout"
+    assert eng.requests[rid].tokens == []
+    eng.assert_quiescent()
+
+
+# ---------------------------------------------------------- cancellation
+
+def test_cancel_queued_active_and_unknown(model):
+    eng = LLMEngine(model, num_slots=1, block_size=4, max_prompt_len=16,
+                    max_seq_len=32)
+    rs = np.random.RandomState(2)
+    active = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                     max_new_tokens=20))
+    queued = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                     max_new_tokens=20))
+    eng.step()                             # admit + first token
+    assert eng.cancel(queued)              # still waiting: pulled from queue
+    assert eng.cancel(active)              # mid-decode: slot + blocks freed
+    assert not eng.cancel(active)          # double-cancel: no-op
+    assert not eng.cancel(99999)           # unknown: no-op
+    assert eng.requests[active].finish_reason == "cancelled"
+    assert eng.requests[queued].finish_reason == "cancelled"
+    assert eng.stats["cancelled"] == 2
+    assert not eng.has_work()
+    eng.assert_quiescent()
+
+
+def test_cancel_beam_group_frees_all_slots(model):
+    eng = LLMEngine(model, num_slots=4, block_size=4, max_prompt_len=16,
+                    max_seq_len=32, eos_token_id=None)
+    rs = np.random.RandomState(3)
+    rid = eng.add_request(Request(rs.randint(0, 64, (7,)), max_new_tokens=8,
+                                  num_beams=4))
+    eng.step()                             # beam admitted: 4 slots live
+    assert rid in eng.groups
+    assert eng.cancel(rid)
+    assert rid not in eng.groups and not eng.active.any()
+    eng.assert_quiescent()
+
+
+def test_cancel_chunk_prefilling_request(model):
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=8,
+                    max_seq_len=48, prefix_caching=False)
+    rs = np.random.RandomState(4)
+    rid = eng.add_request(Request(rs.randint(0, 64, (24,)),
+                                  max_new_tokens=4))
+    eng.step()                             # claims slot, first chunk in
+    assert rid in eng.prefilling
+    assert eng.cancel(rid)
+    assert rid not in eng.prefilling
+    eng.assert_quiescent()
+
+
+# ---------------------------------------------------------- backpressure
+
+def test_bounded_queue_rejects_on_full(model):
+    eng = LLMEngine(model, num_slots=1, block_size=4, max_prompt_len=16,
+                    max_seq_len=32, max_queue_len=2)
+    rs = np.random.RandomState(5)
+    eng.add_request(Request(rs.randint(0, 64, (5,)), max_new_tokens=8))
+    eng.add_request(Request(rs.randint(0, 64, (5,)), max_new_tokens=8))
+    with pytest.raises(QueueFullError):
+        eng.add_request(Request(rs.randint(0, 64, (5,)), max_new_tokens=8))
+    assert eng.stats["rejected"] == 1
+    eng.step()                             # head admitted -> queue has room
+    eng.add_request(Request(rs.randint(0, 64, (5,)), max_new_tokens=8))
+    _run_bounded(eng)
+    eng.assert_quiescent()
+
+
+def test_drain_finishes_inflight_rejects_new(model):
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=16,
+                    max_seq_len=32)
+    rs = np.random.RandomState(6)
+    prompts = [rs.randint(0, 64, (5,)) for _ in range(3)]
+    rids = [eng.add_request(Request(p, max_new_tokens=6)) for p in prompts]
+    eng.step()
+    out = eng.drain()
+    with pytest.raises(EngineDrainingError):
+        eng.add_request(Request([1, 2], max_new_tokens=2))
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(np.asarray(out[rid]),
+                                      _solo(model, p, 6))
+    eng.assert_quiescent()
+
+
+def test_drain_cancel_queued(model):
+    eng = LLMEngine(model, num_slots=1, block_size=4, max_prompt_len=16,
+                    max_seq_len=32)
+    rs = np.random.RandomState(7)
+    head = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                   max_new_tokens=6))
+    tail = eng.add_request(Request(rs.randint(0, 64, (5,)),
+                                   max_new_tokens=6))
+    eng.step()                             # head holds the only slot
+    eng.drain(cancel_queued=True)
+    assert eng.requests[head].finish_reason == "length"
+    assert eng.requests[tail].finish_reason == "cancelled"
+    eng.assert_quiescent()
+
+
+# ------------------------------------------------- preemption-order fix
+
+def test_prefill_preemption_evicts_by_admission_order_not_req_id(model):
+    """Round-5 advisor low: explicit req_ids are NOT monotonic with
+    admission — the victim must be the LAST-ADMITTED prefill, even when
+    it carries the numerically smallest id."""
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=8,
+                    max_seq_len=48, preemption=True, prefix_caching=False)
+    rs = np.random.RandomState(8)
+    old = eng.add_request(Request(rs.randint(0, 64, (24,)),
+                                  max_new_tokens=4, req_id=100))
+    young = eng.add_request(Request(rs.randint(0, 64, (24,)),
+                                    max_new_tokens=4, req_id=5))
+    eng.step()                             # both claim slots, chunks land
+    assert set(eng.prefilling) == {100, 5}
+    assert eng._preempt_prefilling()
+    # max(req_id) would have evicted 100; admission order evicts 5
+    assert 100 in eng.prefilling
+    assert 5 not in eng.prefilling and eng.queue[0].req_id == 5
+    _run_bounded(eng)
+    for rid, p in ((100, eng.requests[100].prompt),
+                   (5, eng.requests[5].prompt)):
+        np.testing.assert_array_equal(
+            np.asarray(eng.requests[rid].tokens), _solo(model, p, 4))
+    eng.assert_quiescent()
+
+
+# ----------------------------------------------------------- chaos runs
+
+def test_chaos_allocator_failures_no_leaks_exact_outputs(model):
+    """Seeded allocator-failure schedule under preemption: every injected
+    MemoryError routes through preempt-and-retry; the run drains with
+    zero leaked blocks and every output still equals solo greedy."""
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(0, 64, (int(n),)) for n in rs.randint(4, 12, 6)]
+    FAULTS.schedule("serving.alloc", seed=42, p=0.25, horizon=200,
+                    exc=MemoryError, times=20)
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=16,
+                    max_seq_len=32, preemption=True)
+    for p in prompts:
+        eng.add_request(Request(p, max_new_tokens=6))
+    ticks = 0
+    while eng.has_work():
+        try:
+            eng.step()
+        except MemoryError:
+            # transient injected failure with nothing left to preempt:
+            # the raise happens before any tick mutation — supervisor
+            # retries the tick (a real dry pool would raise forever; the
+            # tick bound below distinguishes the two)
+            pass
+        ticks += 1
+        assert ticks < 400, "livelock under chaos"
+    assert FAULTS.log, "schedule never fired — test is vacuous"
+    for rid, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            np.asarray(eng.requests[rid].tokens), _solo(model, p, 6),
+            err_msg=f"request {rid} corrupted by chaos")
+    eng.assert_quiescent()
+
+
+def test_chaos_induced_preemption_exact_outputs(model):
+    """serving.preempt rule calls engine._preempt() on a seeded cadence —
+    victims re-queue with their progress and still produce exact greedy
+    outputs."""
+    rs = np.random.RandomState(10)
+    prompts = [rs.randint(0, 64, (int(n),)) for n in rs.randint(4, 12, 4)]
+    FAULTS.install("serving.preempt", every=5, times=6,
+                   action=lambda ctx: ctx["engine"]._preempt())
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=16,
+                    max_seq_len=32, preemption=True)
+    for p in prompts:
+        eng.add_request(Request(p, max_new_tokens=6))
+    _run_bounded(eng)
+    assert eng.stats["preemptions"] > 0
+    for rid, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            np.asarray(eng.requests[rid].tokens), _solo(model, p, 6))
+    eng.assert_quiescent()
+
+
+def test_chaos_tick_exception_engine_state_survives(model):
+    """An exception at the top of step() (before any mutation) must leave
+    the engine resumable: catch it, keep stepping, finish exactly."""
+    rs = np.random.RandomState(11)
+    p = rs.randint(0, 64, (6,))
+    FAULTS.install("serving.tick", on={2, 4}, exc=InjectedFault)
+    eng = LLMEngine(model, num_slots=1, block_size=4, max_prompt_len=16,
+                    max_seq_len=32)
+    eng.add_request(Request(p, max_new_tokens=6))
+    ticks = 0
+    while eng.has_work():
+        try:
+            eng.step()
+        except InjectedFault:
+            pass                           # supervisor catches and retries
+        ticks += 1
+        assert ticks < 100
+    np.testing.assert_array_equal(np.asarray(eng.requests[0].tokens),
+                                  _solo(model, p, 6))
+    eng.assert_quiescent()
+
+
+def test_chaos_deadlines_under_allocator_pressure(model):
+    """Deadlines + chaos together: timed-out requests report "timeout",
+    survivors stay exact, nothing leaks."""
+    clk = FakeClock()
+    rs = np.random.RandomState(12)
+    prompts = [rs.randint(0, 64, (int(n),)) for n in rs.randint(4, 10, 5)]
+    FAULTS.schedule("serving.alloc", seed=7, p=0.15, horizon=150,
+                    exc=MemoryError, times=10)
+    eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=16,
+                    max_seq_len=32, preemption=True, clock=clk)
+    rids = [eng.add_request(Request(p, max_new_tokens=8,
+                                    deadline_s=6.0 if i % 2 else None))
+            for i, p in enumerate(prompts)]
+    ticks = 0
+    while eng.has_work():
+        try:
+            eng.step()
+        except MemoryError:
+            pass                           # transient injection: retry tick
+        clk.t += 1.0
+        ticks += 1
+        assert ticks < 400, "livelock under chaos"
+    for i, rid in enumerate(rids):
+        r = eng.requests[rid]
+        assert r.done
+        if r.finish_reason == "timeout":
+            continue                       # expired under pressure: fine
+        np.testing.assert_array_equal(np.asarray(r.tokens),
+                                      _solo(model, prompts[i], 8))
+    eng.assert_quiescent()

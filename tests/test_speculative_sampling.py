@@ -6,6 +6,7 @@ target marginal, with the draft's own marginal as the power check."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -43,6 +44,7 @@ def test_temperature_zero_falls_back_to_lossless_greedy():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_sampling_matches_target_distribution():
     vocab = 16
     target, draft = _models(vocab, sharpen=True)

@@ -84,6 +84,7 @@ def test_t5_relative_bias_buckets():
     assert int(uni[-1]) == 0
 
 
+@pytest.mark.slow
 def test_ulysses_matches_full_attention():
     from paddle_tpu.distributed import HybridMesh
     from paddle_tpu.distributed.ulysses import make_ulysses_attention
@@ -106,6 +107,7 @@ def test_ulysses_matches_full_attention():
 
 
 @pytest.mark.parametrize("sp_mode,sp", [("ulysses", 4), ("ring", 8)])
+@pytest.mark.slow
 def test_t5_relative_bias_over_sequence_parallel(sp_mode, sp):
     """Full T5 (encoder + causal decoder self-attn) under sp: the LEARNED
     relative position bias rides the additive-bias path; loss AND grads

@@ -106,7 +106,10 @@ def test_checkpoint_retention(tmp_path):
         mgr.save(s, {"x": jnp.ones((2,)) * s})
     import os
     files = sorted(os.listdir(tmp_path / "ck"))
-    assert len(files) == 2 and "ckpt_00000003.npz" in files
+    ckpts = [f for f in files if f.startswith("ckpt_")]
+    assert len(ckpts) == 2 and "ckpt_00000003.npz" in ckpts
+    # the durable latest pointer rides along and tracks the newest save
+    assert "latest" in files and mgr.latest_step() == 3
 
 
 def test_save_load_plain_tree(tmp_path):
@@ -146,6 +149,67 @@ def test_nan_guard_skips_poisoned_update():
                  TrainerArgs(max_steps=1, log_every=0, max_bad_steps=5))
     tr.fit(iter([(np.ones((2, 4), np.float32),)]))
     np.testing.assert_array_equal(np.asarray(tr.state.model.weight), w0)
+
+
+@pytest.mark.chaos
+def test_injected_nan_losses_counted_and_skipped():
+    """train.loss chaos site: inject a 3-step NaN storm mid-run — the
+    trainer counts the skips, tracks the worst streak, recovers, and
+    finishes all steps."""
+    from paddle_tpu.utils.faults import FAULTS
+    pt.seed(0)
+    m = nn.Linear(4, 1)
+    tr = Trainer(m, opt.SGD(0.1),
+                 lambda mod, x, y: nn.functional.mse_loss(mod(x), y),
+                 TrainerArgs(max_steps=8, log_every=0, max_bad_steps=10))
+    FAULTS.install("train.loss", on={2, 3, 4}, action=lambda c: float("nan"))
+    rs = np.random.RandomState(0)
+    data = ((rs.randn(2, 4).astype(np.float32),
+             rs.randn(2, 1).astype(np.float32)) for _ in range(8))
+    state = tr.fit(data)
+    assert int(state.step) == 8
+    assert tr.stats["nan_skips"] == 3
+    assert tr.stats["bad_streak_max"] == 3
+    assert tr._bad_steps == 0              # streak reset by the good tail
+
+
+@pytest.mark.chaos
+def test_nan_storm_trips_watchdog():
+    """An unbroken injected NaN storm must trip after max_bad_steps —
+    feeding the elastic restart path instead of burning steps forever."""
+    from paddle_tpu.utils.faults import FAULTS
+    pt.seed(0)
+    m = nn.Linear(4, 1)
+    tr = Trainer(m, opt.SGD(0.1),
+                 lambda mod, x, y: nn.functional.mse_loss(mod(x), y),
+                 TrainerArgs(max_steps=50, log_every=0, max_bad_steps=3))
+    FAULTS.install("train.loss", every=1, action=lambda c: float("nan"))
+    rs = np.random.RandomState(1)
+    data = ((rs.randn(2, 4).astype(np.float32),
+             rs.randn(2, 1).astype(np.float32)) for _ in range(50))
+    with pytest.raises(WatchdogTrip, match="non-finite"):
+        tr.fit(data)
+    assert tr.stats["nan_skips"] == 3
+
+
+@pytest.mark.chaos
+def test_nan_backoff_sleeps_exponentially():
+    from paddle_tpu.utils.faults import FAULTS
+    import time as _time
+    pt.seed(0)
+    m = nn.Linear(4, 1)
+    tr = Trainer(m, opt.SGD(0.1),
+                 lambda mod, x, y: nn.functional.mse_loss(mod(x), y),
+                 TrainerArgs(max_steps=4, log_every=0, max_bad_steps=10,
+                             nan_backoff_s=0.05))
+    FAULTS.install("train.loss", on={1, 2}, action=lambda c: float("nan"))
+    rs = np.random.RandomState(2)
+    data = ((rs.randn(2, 4).astype(np.float32),
+             rs.randn(2, 1).astype(np.float32)) for _ in range(4))
+    t0 = _time.monotonic()
+    tr.fit(data)
+    # streak 1 sleeps 0.05, streak 2 doubles to 0.10
+    assert _time.monotonic() - t0 >= 0.14
 
 
 def test_watchdog_trips():

@@ -23,12 +23,12 @@ def _check(model, x, num_classes=10, rng=False):
     ("AlexNet", 71, {}),
     ("SqueezeNet", 65, {"version": "1.0"}),
     ("SqueezeNet", 65, {"version": "1.1"}),
-    ("DenseNet", 64, {"layers": 121}),
-    ("GoogLeNet", 64, {}),
-    ("ShuffleNetV2", 64, {"scale": 0.5}),
+    pytest.param(*("DenseNet", 64, {"layers": 121}), marks=pytest.mark.slow),
+    pytest.param(*("GoogLeNet", 64, {}), marks=pytest.mark.slow),
+    pytest.param(*("ShuffleNetV2", 64, {"scale": 0.5}), marks=pytest.mark.slow),
     ("MobileNetV1", 64, {"scale": 0.5}),
-    ("MobileNetV3Small", 64, {}),
-    ("MobileNetV3Large", 64, {}),
+    pytest.param(*("MobileNetV3Small", 64, {}), marks=pytest.mark.slow),
+    pytest.param(*("MobileNetV3Large", 64, {}), marks=pytest.mark.slow),
 ])
 def test_zoo_forward(name, size, kw):
     pt.seed(0)
@@ -40,6 +40,7 @@ def test_zoo_forward(name, size, kw):
     _check(model, x)
 
 
+@pytest.mark.slow
 def test_inception_v3_forward():
     pt.seed(0)
     model = vision.models_extra.InceptionV3(num_classes=10).eval()
@@ -47,6 +48,7 @@ def test_inception_v3_forward():
     _check(model, x)
 
 
+@pytest.mark.slow
 def test_resnext_and_wide():
     pt.seed(0)
     x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 64, 64), jnp.float32)
@@ -59,6 +61,7 @@ def test_resnext_and_wide():
     assert blk.conv2.weight.shape == (128, 4, 3, 3)
 
 
+@pytest.mark.slow
 def test_zoo_trains():
     """One SGD step decreases loss on a fixed batch (ShuffleNet as probe)."""
     import paddle_tpu.optimizer as opt
@@ -119,6 +122,7 @@ def test_vit_configs_param_counts():
     assert 4e6 < n < 8e6
 
 
+@pytest.mark.slow
 def test_convnext_forward_grad():
     import paddle_tpu as pt
     from paddle_tpu.vision import convnext
@@ -140,6 +144,7 @@ def test_convnext_forward_grad():
     assert np.abs(g).sum() > 0
 
 
+@pytest.mark.slow
 def test_swin_forward_shapes_and_shift_mask():
     import paddle_tpu as pt
     from paddle_tpu.vision import swin
