@@ -23,13 +23,9 @@ import subprocess
 import sys
 import time
 
-PEAK_BF16 = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6": 918e12,
-}
+# The peak-FLOPs table lives in paddle_tpu.observability.flops (one copy
+# shared with the Trainer and StepTimer); the worker imports it inside
+# main() — the orchestrator process must stay jax-and-paddle_tpu-free.
 
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
 WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT", "1800"))
@@ -66,14 +62,6 @@ CLEAN_ENV = {
     "HOME": os.environ.get("HOME", "/root"),
     "JAX_PLATFORMS": "cpu",
 }
-
-
-def chip_peak_flops(dev) -> float:
-    kind = getattr(dev, "device_kind", "")
-    for k, v in PEAK_BF16.items():
-        if kind.startswith(k) or k in kind:
-            return v
-    return 197e12  # assume v5e-class
 
 
 def _probe_backend(env, timeout=PROBE_TIMEOUT_S):
@@ -398,6 +386,8 @@ def main():
     import paddle_tpu as pt
     import paddle_tpu.optimizer as opt
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, num_flops_per_token
+    from paddle_tpu.observability import METRICS
+    from paddle_tpu.observability.flops import chip_peak_flops, record_throughput
     from paddle_tpu.train import make_train_step
     from paddle_tpu.train.step import TrainState, init_state
 
@@ -469,9 +459,11 @@ def main():
 
     tokens_per_sec = batch * seq / dt
     flops_per_token = num_flops_per_token(cfg, seq)
-    achieved = tokens_per_sec * flops_per_token
     peak = chip_peak_flops(jax.devices()[0]) if on_tpu else 0.0
-    mfu = achieved / peak if peak else 0.0
+    # the shared choke point: sets train_tokens_per_sec/train_mfu gauges
+    # (read back below into the "metrics" sub-object) and returns MFU —
+    # bench.py no longer carries its own FLOPs model
+    mfu = record_throughput(tokens_per_sec, flops_per_token, peak)
 
     # the other four BASELINE configs (one JSON line total — they ride in
     # extra.configs; the LLaMA MFU stays the headline). A config that
@@ -496,6 +488,15 @@ def main():
     # honest config label: the CPU-smoke fallback runs LlamaConfig.tiny(),
     # not the 0.8B geometry — name the metric by what actually ran
     size_tag = f"{n_params / 1e9:.1f}b" if n_params >= 5e7 else f"{n_params:,}-param smoke"
+    # throughput/MFU read back FROM the metrics registry (not recomputed):
+    # the gauges record_throughput just set are the single source of truth
+    snap = METRICS.snapshot()
+    metrics_obj = {
+        "tokens_per_sec": snap["gauges"].get("train_tokens_per_sec", 0.0),
+        "mfu": snap["gauges"].get("train_mfu", 0.0),
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if k.startswith(("collective_", "faults_"))},
+    }
     print(json.dumps({
         "metric": f"llama-{size_tag} bf16 train step tokens/sec/chip (MFU in extra)",
         "value": round(tokens_per_sec, 1),
@@ -511,6 +512,7 @@ def main():
             "device": device_str,
             "configs": configs,
         },
+        "metrics": metrics_obj,
     }))
 
 

@@ -88,7 +88,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
-        self._t0 = time.time()
+        self._t0 = time.monotonic()
         self._seen = 0
 
     def on_train_batch_end(self, step, logs=None):
@@ -106,7 +106,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._t0
+            dt = time.monotonic() - self._t0
             rate = self._seen / dt if dt > 0 else float("inf")
             print(f"[epoch {epoch}] done in {dt:.1f}s ({rate:.1f} steps/s)")
 

@@ -14,6 +14,30 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from paddle_tpu.distributed._compat import axis_size
+from paddle_tpu.observability import METRICS
+from paddle_tpu.utils.faults import fault_point
+
+# Host-side collective accounting. These wrappers run at TRACE time (the
+# executed program is XLA's), so the counters measure how many collective
+# ops each compiled program CONTAINS — per-trace, not per-device-launch.
+# That is the number that matters for schedule review ("why does this
+# step all-gather 40 times?") and it is exactly once per compilation, so
+# the hot path stays untouched.
+_COLL_OPS = METRICS.counter(
+    "collective_ops_total", "collective ops traced, by op kind",
+    labelnames=("op",))
+_COLL_BYTES = METRICS.counter(
+    "collective_bytes_total",
+    "per-member payload bytes of traced collective ops", labelnames=("op",))
+
+
+def _count(op: str, x):
+    _COLL_OPS.inc(op=op)
+    try:
+        _COLL_BYTES.inc(x.size * x.dtype.itemsize, op=op)
+    except (AttributeError, TypeError):   # python scalars / exotic leaves
+        pass
+
 
 # ReduceOp parity (ref communication/reduce.py)
 class ReduceOp:
@@ -25,6 +49,12 @@ class ReduceOp:
 
 
 def all_reduce(x, op: str = ReduceOp.SUM, *, axis_name: str):
+    # chaos site (ROADMAP multi-host slice): an installed rule can raise
+    # (collective timeout → surfaces as a trace-time error the elastic
+    # layer restarts through) or stall (straggler host). Host-side at
+    # trace time — nothing is injected into the compiled program.
+    fault_point("collective.all_reduce", op=op, axis_name=axis_name)
+    _count("all_reduce", x)
     if op == ReduceOp.SUM:
         return lax.psum(x, axis_name)
     if op == ReduceOp.MAX:
@@ -39,20 +69,24 @@ def all_reduce(x, op: str = ReduceOp.SUM, *, axis_name: str):
 
 
 def all_gather(x, *, axis_name: str, axis: int = 0, tiled: bool = True):
+    _count("all_gather", x)
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, *, axis_name: str, axis: int = 0):
+    _count("reduce_scatter", x)
     return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
 
 
 def all_to_all(x, *, axis_name: str, split_axis: int, concat_axis: int):
+    _count("all_to_all", x)
     return lax.all_to_all(x, axis_name, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(x, src: int = 0, *, axis_name: str):
     """Every member gets member `src`'s value."""
+    _count("broadcast", x)
     idx = lax.axis_index(axis_name)
     n = axis_size(axis_name)
     sel = jnp.where(jnp.arange(n) == src, 1.0, 0.0).astype(x.dtype)
@@ -62,11 +96,13 @@ def broadcast(x, src: int = 0, *, axis_name: str):
 
 def permute(x, perm: list[tuple[int, int]], *, axis_name: str):
     """Point-to-point send/recv pattern (ref send/recv): perm = [(src,dst)...]."""
+    _count("permute", x)
     return lax.ppermute(x, axis_name, perm)
 
 
 def shift(x, offset: int = 1, *, axis_name: str):
     """Ring shift: member i's value goes to member (i+offset) % n."""
+    _count("shift", x)
     n = axis_size(axis_name)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)

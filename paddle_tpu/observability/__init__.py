@@ -1,0 +1,68 @@
+"""Observability subsystem (ISSUE 2): metrics registry, trace spans,
+and the shared FLOPs/MFU accounting.
+
+Three layers, all host-side and CPU-safe:
+
+  * :mod:`paddle_tpu.observability.metrics` — process-global
+    Counter/Gauge/Histogram registry (:data:`METRICS`), exportable as
+    one-line JSON and Prometheus text.
+  * :mod:`paddle_tpu.observability.tracing` — :func:`span` context
+    manager/decorator + :func:`instant` markers over the global
+    :data:`TRACER`, exported as a Chrome-trace/Perfetto JSON timeline.
+  * :mod:`paddle_tpu.observability.flops` — the peak-FLOPs table and
+    :func:`record_throughput`, the single MFU choke point shared by the
+    Trainer, ``utils.profiler.StepTimer``, and bench.py.
+
+Built-in instrumentation (serving engine, Trainer, checkpoints, elastic
+restarts, collectives, fault injection) emits through these singletons;
+``metrics_snapshot()``/``dump()`` give a one-call export of everything.
+"""
+from __future__ import annotations
+
+from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                              METRICS, MetricsRegistry,
+                                              DEFAULT_BUCKETS)
+from paddle_tpu.observability.tracing import (TRACER, Tracer, span, instant,
+                                              export_chrome_trace)
+from paddle_tpu.observability.flops import (PEAK_BF16, chip_peak_flops, mfu,
+                                            record_throughput)
+
+__all__ = [
+    "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "DEFAULT_BUCKETS",
+    "TRACER", "Tracer", "span", "instant", "export_chrome_trace",
+    "PEAK_BF16", "chip_peak_flops", "mfu", "record_throughput",
+    "enable", "disable", "metrics_snapshot", "dump",
+]
+
+
+def enable(tracing: bool = True):
+    """Turn the whole layer on (metrics are on by default; this also
+    starts span collection when ``tracing``)."""
+    METRICS.enable()
+    if tracing:
+        TRACER.enable()
+
+
+def disable():
+    """No-op every instrument and stop span collection."""
+    METRICS.disable()
+    TRACER.disable()
+
+
+def metrics_snapshot() -> dict:
+    return METRICS.snapshot()
+
+
+def dump(prefix: str) -> dict:
+    """Write ``<prefix>.metrics.json`` (one line), ``<prefix>.prom``
+    (Prometheus text), and ``<prefix>.trace.json`` (Chrome trace);
+    returns the three paths."""
+    paths = {"json": prefix + ".metrics.json", "prom": prefix + ".prom",
+             "trace": prefix + ".trace.json"}
+    with open(paths["json"], "w") as f:
+        f.write(METRICS.to_json() + "\n")
+    with open(paths["prom"], "w") as f:
+        f.write(METRICS.to_prometheus())
+    TRACER.export_chrome_trace(paths["trace"])
+    return paths

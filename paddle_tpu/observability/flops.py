@@ -1,0 +1,69 @@
+"""Peak-FLOPs table + MFU accounting — the ONE copy bench.py, the
+Trainer, and ``utils.profiler.StepTimer`` all read (ISSUE 2 satellite:
+bench.py used to carry its own table and recompute MFU ad hoc).
+
+Import-light on purpose: bench.py's orchestrator process must never pull
+in jax, so nothing at this module's top level may import jax (or the
+``paddle_tpu`` root package — this file is reached via
+``paddle_tpu.observability.flops`` only from contexts that already paid
+that import, or standalone through sys.modules tricks bench does not
+need: ``from paddle_tpu.observability import flops`` inside the worker).
+"""
+from __future__ import annotations
+
+from paddle_tpu.observability.metrics import METRICS
+
+__all__ = ["PEAK_BF16", "chip_peak_flops", "mfu", "record_throughput"]
+
+# Peak dense bf16 FLOP/s per chip, by device_kind prefix. (The serving
+# and training MFU numbers, bench.py's vs_baseline, and the profiler's
+# StepTimer all divide by THIS table.)
+PEAK_BF16 = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,
+}
+
+
+def chip_peak_flops(dev=None, kind: str = None) -> float:
+    """Peak bf16 FLOP/s for a jax device (or an explicit ``device_kind``
+    string). Unknown TPU kinds assume v5e-class; non-TPU backends (cpu
+    debugging runs) return 0.0 — callers treat 0 peak as "MFU undefined"
+    rather than dividing by a made-up number."""
+    if kind is None:
+        kind = getattr(dev, "device_kind", "") or ""
+        platform = getattr(dev, "platform", "")
+        if platform and platform != "tpu":
+            return 0.0
+    for k, v in PEAK_BF16.items():
+        if kind.startswith(k) or k in kind:
+            return v
+    return 197e12 if "TPU" in kind.upper() or kind == "" else 0.0
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        peak_flops: float) -> float:
+    """Model FLOPs utilisation; 0.0 when the peak is unknown."""
+    if not peak_flops or not flops_per_token:
+        return 0.0
+    return tokens_per_sec * flops_per_token / peak_flops
+
+
+_TOKENS_PER_SEC = METRICS.gauge(
+    "train_tokens_per_sec", "training throughput, tokens/sec")
+_MFU = METRICS.gauge(
+    "train_mfu", "model FLOPs utilisation vs the chip peak-bf16 table")
+
+
+def record_throughput(tokens_per_sec: float, flops_per_token: float = 0.0,
+                      peak_flops: float = 0.0) -> float:
+    """Single choke point for throughput/MFU accounting: computes MFU
+    from the shared table's peak, sets the ``train_tokens_per_sec`` and
+    ``train_mfu`` gauges, returns the MFU. Trainer, StepTimer, and
+    bench.py all land here — there is exactly one FLOPs model."""
+    m = mfu(tokens_per_sec, flops_per_token, peak_flops)
+    _TOKENS_PER_SEC.set(tokens_per_sec)
+    _MFU.set(m)
+    return m

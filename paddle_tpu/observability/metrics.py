@@ -1,0 +1,354 @@
+"""Process-global metrics registry (ref: ``paddle.profiler`` statistics +
+the Prometheus exposition conventions production serving stacks expect).
+
+Three instrument kinds over one registry:
+
+  * :class:`Counter`    — monotonically increasing (``inc``)
+  * :class:`Gauge`      — settable point-in-time value (``set``/``inc``/``dec``)
+  * :class:`Histogram`  — fixed bucket boundaries, cumulative counts +
+                          sum/count (Prometheus semantics)
+
+Labels are declared at creation (``labelnames=("site",)``) and bound per
+observation either inline (``c.inc(site="x")``) or pre-bound for hot
+paths (``child = c.labels(site="x"); child.inc()``).
+
+Design constraints (ISSUE 2):
+  * process-global singleton (:data:`METRICS`) — instruments are created
+    at module import by the subsystems that emit them; creation is
+    idempotent (same name → same instrument; a conflicting re-register
+    raises).
+  * ZERO overhead when disabled — every mutating call is gated on one
+    ``bool`` attribute read; ``METRICS.disable()`` turns the whole layer
+    into no-ops (export still works, frozen at the last enabled state).
+  * host-side only — nothing here ever traces into a jitted program.
+  * two export formats: one-line JSON (:meth:`MetricsRegistry.to_json`)
+    and Prometheus text exposition 0.0.4
+    (:meth:`MetricsRegistry.to_prometheus`).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from bisect import bisect_left
+from typing import Optional, Sequence
+
+__all__ = ["METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "DEFAULT_BUCKETS"]
+
+# Prometheus client default buckets — latency-shaped (seconds).
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus number formatting: integers without a trailing .0."""
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _label_str(labelnames: tuple, labelvalues: tuple) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in zip(labelnames, labelvalues))
+    return "{" + inner + "}"
+
+
+class _Instrument:
+    """Shared base: name/help/labelnames + the per-labelset series dict."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self._reg = registry
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._series: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict) -> tuple:
+        if tuple(sorted(labels)) != tuple(sorted(self.labelnames)):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} do not match declared "
+                f"labelnames {sorted(self.labelnames)}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def labels(self, **labels) -> "_Bound":
+        """Pre-bind a label set (hot-path form: no per-call dict)."""
+        return _Bound(self, self._key(labels))
+
+    # ---- overridden per kind -------------------------------------------
+    def _zero(self):
+        raise NotImplementedError
+
+    def _get(self, key: tuple):
+        with self._lock:
+            if key not in self._series:
+                self._series[key] = self._zero()
+            return self._series[key]
+
+
+class _Bound:
+    """An instrument bound to one label-value tuple."""
+
+    def __init__(self, inst: _Instrument, key: tuple):
+        self._inst = inst
+        self._key = key
+
+    def inc(self, n: float = 1.0):
+        self._inst._inc_key(self._key, n)
+
+    def dec(self, n: float = 1.0):
+        self._inst._inc_key(self._key, -n)
+
+    def set(self, v: float):
+        self._inst._set_key(self._key, v)
+
+    def observe(self, v: float):
+        self._inst._observe_key(self._key, v)
+
+    def value(self):
+        return self._inst._value_key(self._key)
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def _zero(self):
+        return [0.0]
+
+    def inc(self, n: float = 1.0, **labels):
+        if not self._reg._enabled:
+            return
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up (n={n})")
+        self._inc_key(self._key(labels), n)
+
+    def _inc_key(self, key: tuple, n: float):
+        if not self._reg._enabled:
+            return
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up (n={n})")
+        cell = self._get(key)
+        with self._lock:
+            cell[0] += n
+
+    def value(self, **labels) -> float:
+        return self._value_key(self._key(labels))
+
+    def _value_key(self, key: tuple) -> float:
+        return self._series.get(key, [0.0])[0]
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def _zero(self):
+        return [0.0]
+
+    def set(self, v: float, **labels):
+        if not self._reg._enabled:
+            return
+        self._set_key(self._key(labels), v)
+
+    def inc(self, n: float = 1.0, **labels):
+        if not self._reg._enabled:
+            return
+        self._inc_key(self._key(labels), n)
+
+    def dec(self, n: float = 1.0, **labels):
+        self.inc(-n, **labels)
+
+    def _set_key(self, key: tuple, v: float):
+        if not self._reg._enabled:
+            return
+        cell = self._get(key)
+        with self._lock:
+            cell[0] = float(v)
+
+    def _inc_key(self, key: tuple, n: float):
+        if not self._reg._enabled:
+            return
+        cell = self._get(key)
+        with self._lock:
+            cell[0] += n
+
+    def value(self, **labels) -> float:
+        return self._value_key(self._key(labels))
+
+    def _value_key(self, key: tuple) -> float:
+        return self._series.get(key, [0.0])[0]
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)   # +1 = the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Instrument):
+    """Fixed-boundary histogram. ``buckets`` are UPPER bounds (le),
+    strictly increasing; an implicit +Inf bucket is appended. Exported
+    counts are cumulative, matching Prometheus exposition."""
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, name, help, labelnames)
+        b = tuple(float(x) for x in buckets)
+        if not b or any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
+            raise ValueError(f"{name}: buckets must be non-empty and "
+                             f"strictly increasing, got {b}")
+        self.buckets = b
+
+    def _zero(self):
+        return _HistSeries(len(self.buckets))
+
+    def observe(self, v: float, **labels):
+        if not self._reg._enabled:
+            return
+        self._observe_key(self._key(labels), v)
+
+    def _observe_key(self, key: tuple, v: float):
+        if not self._reg._enabled:
+            return
+        s = self._get(key)
+        v = float(v)
+        with self._lock:
+            s.counts[bisect_left(self.buckets, v)] += 1
+            s.sum += v
+            s.count += 1
+
+    def value(self, **labels) -> dict:
+        """{"buckets": {le: cumulative}, "sum", "count"} for one series."""
+        return self._value_key(self._key(labels))
+
+    def _value_key(self, key: tuple):
+        return self._snapshot_series(self._series.get(
+            key, _HistSeries(len(self.buckets))))
+
+    def _snapshot_series(self, s: _HistSeries) -> dict:
+        cum, out = 0, {}
+        for bound, c in zip(self.buckets, s.counts):
+            cum += c
+            out[_fmt_value(bound)] = cum
+        out["+Inf"] = cum + s.counts[-1]
+        return {"buckets": out, "sum": s.sum, "count": s.count}
+
+
+class MetricsRegistry:
+    """Name → instrument table. ``counter``/``gauge``/``histogram`` are
+    get-or-create: the same name always returns the same instrument, and
+    a re-register with a different kind/labelnames/buckets raises (two
+    subsystems silently sharing one series would corrupt both)."""
+
+    def __init__(self):
+        self._instruments: dict[str, _Instrument] = {}
+        self._enabled = True
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ admin
+    def enable(self):
+        self._enabled = True
+
+    def disable(self):
+        """Turn every instrument into a no-op (one bool read per call)."""
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def reset(self):
+        """Zero every series (instruments survive — module-level handles
+        stay valid). Test hygiene, not a production operation."""
+        with self._lock:
+            for inst in self._instruments.values():
+                inst._series.clear()
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        return self._instruments.get(name)
+
+    # --------------------------------------------------------- creation
+    def _make(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            have = self._instruments.get(name)
+            if have is not None:
+                same = (type(have) is cls
+                        and have.labelnames == tuple(labelnames)
+                        and kw.get("buckets") in (
+                            None, getattr(have, "buckets", None)))
+                if not same:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{have.kind}{have.labelnames} — conflicting "
+                        f"re-registration")
+                return have
+            inst = cls(self, name, help, labelnames, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._make(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._make(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._make(Histogram, name, help, labelnames, buckets=buckets)
+
+    # ----------------------------------------------------------- export
+    def snapshot(self) -> dict:
+        """{"counters": {series: value}, "gauges": {...},
+        "histograms": {series: {"buckets": {le: cum}, "sum", "count"}}}.
+        Series keys carry their labels Prometheus-style:
+        ``name{site="serving.alloc"}``."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name in sorted(self._instruments):
+            inst = self._instruments[name]
+            dst = out[inst.kind + "s"]
+            for key in sorted(inst._series):
+                series = name + _label_str(inst.labelnames, key)
+                dst[series] = inst._value_key(key)
+        return out
+
+    def to_json(self) -> str:
+        """The whole registry as ONE line of JSON (log-shipping-friendly:
+        one snapshot per scrape per line)."""
+        return json.dumps(self.snapshot(), sort_keys=True,
+                          separators=(",", ":"))
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines = []
+        for name in sorted(self._instruments):
+            inst = self._instruments[name]
+            if not inst._series:
+                continue
+            if inst.help:
+                lines.append(f"# HELP {name} {inst.help}")
+            lines.append(f"# TYPE {name} {inst.kind}")
+            for key in sorted(inst._series):
+                if isinstance(inst, Histogram):
+                    snap = inst._value_key(key)
+                    for le, cum in snap["buckets"].items():
+                        ls = _label_str(inst.labelnames + ("le",),
+                                        key + (le,))
+                        lines.append(f"{name}_bucket{ls} {cum}")
+                    ls = _label_str(inst.labelnames, key)
+                    lines.append(f"{name}_sum{ls} {_fmt_value(snap['sum'])}")
+                    lines.append(f"{name}_count{ls} {snap['count']}")
+                else:
+                    ls = _label_str(inst.labelnames, key)
+                    lines.append(
+                        f"{name}{ls} {_fmt_value(inst._value_key(key))}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+METRICS = MetricsRegistry()

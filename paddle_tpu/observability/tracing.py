@@ -1,0 +1,168 @@
+"""Trace spans → Chrome-trace/Perfetto timeline (ref: ``paddle.profiler``
+RecordEvent + chrome-trace export; host-side complement to XLA's own
+``jax.profiler`` device timeline).
+
+:func:`span` is a context manager AND a decorator::
+
+    with span("engine.step", tick=3):
+        ...
+
+    @span("ckpt.save")
+    def save(...): ...
+
+Spans clock with ``time.monotonic_ns`` (never wall clock — a stepped
+NTP correction inside a span would report negative durations), record
+their thread id, and nest naturally: Chrome's "X" (complete) events
+reconstruct the hierarchy from ts/dur containment per thread.
+
+The global :data:`TRACER` starts DISABLED. Enablement is checked when a
+span is ENTERED (not when it is created), so a ``@span(...)`` decorator
+applied at import time starts tracing the moment the tracer is turned
+on; a span entered while tracing is off is one bool read and no buffer
+write. :func:`instant` emits zero-duration "i" events — fault
+injections use it so a chaos run's timeline shows exactly where each
+fault landed.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+
+__all__ = ["TRACER", "Tracer", "span", "instant", "export_chrome_trace"]
+
+
+class _Span:
+    """One span site. Create fresh per use (``with span(...):``); the
+    decorator form re-opens a fresh span per call, so one decoration is
+    safe under recursion and concurrent threads."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.monotonic_ns() if self._tracer._enabled else None
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is None:             # tracing was off at entry
+            return False
+        t1 = time.monotonic_ns()
+        self._tracer._emit({
+            "name": self.name, "ph": "X", "cat": "host",
+            "ts": self._t0 / 1e3, "dur": (t1 - self._t0) / 1e3,
+            "pid": self._tracer._pid, "tid": threading.get_ident(),
+            **({"args": self.args} if self.args else {}),
+        })
+        return False
+
+    def __call__(self, fn):
+        tracer, name, args = self._tracer, self.name, self.args
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with _Span(tracer, name, args):
+                return fn(*a, **kw)
+        return wrapped
+
+
+class Tracer:
+    """Event buffer + export. ``max_events`` bounds memory: the buffer
+    drops NEW events past the cap (and counts the drops) instead of
+    growing without bound during a long traced run."""
+
+    def __init__(self, max_events: int = 200_000):
+        self.max_events = max_events
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._enabled = False
+        self._pid = os.getpid()
+        self.dropped = 0
+
+    # ------------------------------------------------------------ admin
+    def enable(self):
+        self._enabled = True
+
+    def disable(self):
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def __enter__(self):                 # `with TRACER:` traces a block
+        self.enable()
+        return self
+
+    def __exit__(self, *exc):
+        self.disable()
+        return False
+
+    # ----------------------------------------------------------- record
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args):
+        """Zero-duration marker ("i" event) — fault injections, restarts."""
+        if not self._enabled:
+            return
+        self._emit({
+            "name": name, "ph": "i", "cat": "host", "s": "t",
+            "ts": time.monotonic_ns() / 1e3,
+            "pid": self._pid, "tid": threading.get_ident(),
+            **({"args": args} if args else {}),
+        })
+
+    def _emit(self, ev: dict):
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    # ----------------------------------------------------------- export
+    def export(self) -> dict:
+        """Chrome-trace JSON object (load at chrome://tracing or
+        ui.perfetto.dev)."""
+        with self._lock:
+            events = list(self._events)
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"producer": "paddle_tpu.observability",
+                              "dropped_events": self.dropped}}
+
+    def export_chrome_trace(self, path: str = None) -> str:
+        """Serialise the timeline; write to ``path`` when given. Returns
+        the JSON string either way."""
+        s = json.dumps(self.export(), separators=(",", ":"))
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(s)
+        return s
+
+
+TRACER = Tracer()
+
+
+def span(name: str, **args) -> _Span:
+    """Module-level sugar over the global tracer."""
+    return TRACER.span(name, **args)
+
+
+def instant(name: str, **args):
+    return TRACER.instant(name, **args)
+
+
+def export_chrome_trace(path: str = None) -> str:
+    return TRACER.export_chrome_trace(path)

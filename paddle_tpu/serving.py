@@ -36,10 +36,56 @@ from paddle_tpu.models.paged import (PagedKVCache, PrefixCachingBlockManager,
                                      _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
                                      _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
                                      _PREFILL_JIT, _TICK_JIT)
+from paddle_tpu.observability import METRICS, span as _span
 from paddle_tpu.utils.faults import fault_point
 
 # module-level so its compile cache persists across admissions
 _SAMPLE_ROWS_JIT = jax.jit(_sample_rows, static_argnums=(4,))
+
+# ---------------------------------------------------------- telemetry
+# Engine metrics (ISSUE 2). Request-relative timings (TTFT, inter-token
+# latency, queue wait) use the ENGINE clock — the swappable ``clock``
+# ctor arg — so deadline tests driving a fake clock see deterministic
+# histograms; host work timings (tick, drain) use the real monotonic
+# clock. All instruments live in the process-global registry: a serve
+# loop exports them with ``paddle_tpu.observability.dump(prefix)``.
+_ADMITTED = METRICS.counter(
+    "serving_admissions_total", "requests admitted into cache slots")
+_PREEMPTED = METRICS.counter(
+    "serving_preemptions_total", "requests evicted and re-queued")
+_TIMEOUTS = METRICS.counter(
+    "serving_timeouts_total", "requests expired (deadline_s/max_queue_s)")
+_CANCELLED = METRICS.counter(
+    "serving_cancellations_total", "requests cancelled by the caller")
+_REJECTED = METRICS.counter(
+    "serving_rejections_total", "admissions refused at intake",
+    labelnames=("reason",))
+_TOKENS = METRICS.counter(
+    "serving_tokens_total", "tokens sampled and emitted")
+_FINISHED = METRICS.counter(
+    "serving_finished_total", "requests finished, by finish_reason",
+    labelnames=("reason",))
+_QUEUE_DEPTH = METRICS.gauge(
+    "serving_queue_depth", "requests waiting for admission")
+_ACTIVE_SLOTS = METRICS.gauge(
+    "serving_active_slots", "cache slots actively decoding")
+_KV_IN_USE = METRICS.gauge(
+    "serving_kv_blocks_in_use", "paged KV blocks currently allocated")
+_KV_UTIL = METRICS.gauge(
+    "serving_kv_block_utilization", "allocated fraction of the KV pool")
+_TTFT = METRICS.histogram(
+    "serving_ttft_seconds", "submission → first token (engine clock)")
+_TOK_LAT = METRICS.histogram(
+    "serving_token_latency_seconds", "inter-token gap (engine clock)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
+_QUEUE_WAIT = METRICS.histogram(
+    "serving_queue_wait_seconds", "submission → admission (engine clock)")
+_TICK = METRICS.histogram(
+    "serving_tick_seconds", "wall time of one engine tick")
+_DRAIN = METRICS.histogram(
+    "serving_drain_seconds", "wall time of graceful drain",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
 
 
 class QueueFullError(RuntimeError):
@@ -82,6 +128,8 @@ class Request:
     done: bool = False
     finish_reason: str = None
     _submit_t: float = None              # engine clock at add_request
+    _first_tok_t: float = None           # engine clock at first token (TTFT)
+    _last_tok_t: float = None            # engine clock at newest token
     beam_score: float = None
     # set on preemption: prompt + tokens generated so far — the resume
     # prefill recomputes the whole sequence (prefix-cache hits make the
@@ -213,6 +261,7 @@ class LLMEngine:
     def add_request(self, req: Request) -> int:
         if self._draining:
             self.stats["rejected"] += 1
+            _REJECTED.inc(reason="draining")
             raise EngineDrainingError(
                 "engine is draining — finishing in-flight requests, "
                 "admitting nothing new")
@@ -221,6 +270,7 @@ class LLMEngine:
             # reject-on-full backpressure: push the load signal to the
             # caller instead of buffering an unbounded deque
             self.stats["rejected"] += 1
+            _REJECTED.inc(reason="queue_full")
             raise QueueFullError(
                 f"admission queue full ({self.max_queue_len} waiting) — "
                 "shed load or retry later")
@@ -281,6 +331,7 @@ class LLMEngine:
             self._has_deadlines = True
         self.requests[req.req_id] = req
         self.queue.append(req)
+        _QUEUE_DEPTH.set(len(self.queue))
         return req.req_id
 
     def pop_finished(self) -> dict:
@@ -348,6 +399,8 @@ class LLMEngine:
         req.done = True
         req.finish_reason = reason
         self.stats["timeouts" if reason == "timeout" else "cancelled"] += 1
+        (_TIMEOUTS if reason == "timeout" else _CANCELLED).inc()
+        _FINISHED.inc(reason=reason)
         return True
 
     def _expire(self):
@@ -375,12 +428,16 @@ class LLMEngine:
         {req_id: tokens} like ``run``. ``cancel_queued=True`` also
         cancels requests still waiting for admission instead of running
         them to completion."""
-        self._draining = True
-        if cancel_queued:
-            for r in list(self.queue):
-                self.cancel(r.req_id)
-        while self.has_work():
-            self.step()
+        from time import monotonic
+        t0 = monotonic()
+        with _span("serving.drain", cancel_queued=cancel_queued):
+            self._draining = True
+            if cancel_queued:
+                for r in list(self.queue):
+                    self.cancel(r.req_id)
+            while self.has_work():
+                self.step()
+        _DRAIN.observe(monotonic() - t0)
         return {rid: r.tokens for rid, r in self.requests.items()}
 
     def assert_quiescent(self):
@@ -458,6 +515,9 @@ class LLMEngine:
                     or need > self.mgr.free_blocks - self._reserved):
                 break                      # FCFS: do not starve the head
             self.queue.popleft()
+            _ADMITTED.inc()
+            if req._submit_t is not None:
+                _QUEUE_WAIT.observe(max(0.0, self._clock() - req._submit_t))
             if self.preemption and k == 1:
                 need = 0                   # no standing reservation
             self._need[req.req_id] = need
@@ -724,6 +784,8 @@ class LLMEngine:
         req.beam_score = float(best_score)
         req.done = True
         req.finish_reason = "beam"
+        _FINISHED.inc(reason="beam")
+        _TOKENS.inc(len(req.tokens))
         for sid in g.sid.values():
             self.mgr.free(sid)
         for slot in g.slots:
@@ -879,6 +941,7 @@ class LLMEngine:
         self.slot_req[slot] = -1
         self.queue.appendleft(req)
         self.stats["preemptions"] += 1
+        _PREEMPTED.inc()
         return True
 
     def _preempt_from(self, cand) -> bool:
@@ -905,6 +968,7 @@ class LLMEngine:
         self.slot_req[slot] = -1
         self.queue.appendleft(req)
         self.stats["preemptions"] += 1
+        _PREEMPTED.inc()
         return True
 
     def _allocate_or_preempt(self, rid: int, n_tokens: int, protect=None):
@@ -1002,6 +1066,15 @@ class LLMEngine:
             return []        # slot emptied mid-tick (stream-side cancel)
         req = self.requests[rid]
         req.tokens.append(token)
+        _TOKENS.inc()
+        now = self._clock()
+        if req._first_tok_t is None:
+            req._first_tok_t = now
+            if req._submit_t is not None:
+                _TTFT.observe(max(0.0, now - req._submit_t))
+        elif req._last_tok_t is not None:
+            _TOK_LAT.observe(max(0.0, now - req._last_tok_t))
+        req._last_tok_t = now
         if req.stream is not None:
             req.stream(req, token)
         self.last_tok[slot] = token
@@ -1010,6 +1083,7 @@ class LLMEngine:
         if eos or self.gen[slot] >= self.max_gen[slot]:
             req.done = True
             req.finish_reason = "eos" if eos else "length"
+            _FINISHED.inc(reason=req.finish_reason)
             self.mgr.free(rid)
             self._reserved -= self._resv.pop(rid, 0)
             self._need.pop(rid, None)
@@ -1017,7 +1091,31 @@ class LLMEngine:
             self.slot_req[slot] = -1
         return [(rid, token)]
 
+    def _refresh_gauges(self):
+        """Point-in-time engine state → gauges (queue depth, active
+        slots, KV-pool utilization). Called after every tick and intake
+        mutation; cheap enough to never matter."""
+        _QUEUE_DEPTH.set(len(self.queue))
+        _ACTIVE_SLOTS.set(int(self.active.sum()))
+        used = self.mgr.num_blocks - self.mgr.free_blocks
+        _KV_IN_USE.set(used)
+        _KV_UTIL.set(used / self.mgr.num_blocks if self.mgr.num_blocks
+                     else 0.0)
+
     def step(self):
+        """One engine tick — see :meth:`_step_impl`. Wrapped here so the
+        tick lands in the trace timeline and the tick-duration histogram
+        even when a chaos rule or a dry pool raises out of the middle."""
+        from time import monotonic
+        t0 = monotonic()
+        try:
+            with _span("serving.step"):
+                return self._step_impl()
+        finally:
+            _TICK.observe(monotonic() - t0)
+            self._refresh_gauges()
+
+    def _step_impl(self):
         """One engine tick: advance in-flight beam groups (select + fork,
         or their final selection), admit waiting requests into free slots
         (their prefill runs now, interleaved with decode), then one decode

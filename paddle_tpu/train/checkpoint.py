@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import zlib
 from pathlib import Path
 from typing import Any, Optional
@@ -34,7 +35,30 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.module import Module, _path_to_str
+from paddle_tpu.observability import METRICS, span as _span
 from paddle_tpu.utils.faults import fault_point
+
+# Checkpoint telemetry (ISSUE 2): durations/bytes of successful saves
+# and restores (failed attempts surface via faults_injected_total and
+# the corruption counters, not as latency samples).
+_SAVE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0)
+_CKPT_SAVES = METRICS.counter("ckpt_saves_total", "durable checkpoint saves")
+_CKPT_RESTORES = METRICS.counter(
+    "ckpt_restores_total", "successful checkpoint restores")
+_CKPT_SAVE_S = METRICS.histogram(
+    "ckpt_save_seconds", "wall time of one durable save",
+    buckets=_SAVE_BUCKETS)
+_CKPT_RESTORE_S = METRICS.histogram(
+    "ckpt_restore_seconds", "wall time of one verified load",
+    buckets=_SAVE_BUCKETS)
+_CKPT_BYTES = METRICS.counter(
+    "ckpt_saved_bytes_total", "bytes written by durable saves")
+_CKPT_LAST_BYTES = METRICS.gauge(
+    "ckpt_last_save_bytes", "size of the newest durable checkpoint")
+_CKPT_CRC_FAILS = METRICS.counter(
+    "ckpt_crc_failures_total", "array CRC mismatches caught on load")
+_CKPT_UNREADABLE = METRICS.counter(
+    "ckpt_unreadable_total", "checkpoints that failed to parse at all")
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -85,15 +109,22 @@ def save(state: Any, path: str) -> None:
                                    "crc": _crc(arrays[key])})
         else:
             meta["leaves"].append({"path": p, "kind": "py", "value": leaf})
-    fault_point("ckpt.write", path=str(path))     # injected host I/O error
-    tmp = path.with_name(path.name + ".tmp")
-    with open(tmp, "wb") as f:
-        np.savez(f, __meta__=json.dumps(meta), **arrays)
-        f.flush()
-        os.fsync(f.fileno())
-    fault_point("ckpt.rename", path=str(path))    # the crash window
-    os.replace(tmp, path)
-    _fsync_dir(path.parent)
+    t0 = time.monotonic()
+    with _span("ckpt.save", path=str(path)):
+        fault_point("ckpt.write", path=str(path))  # injected host I/O error
+        tmp = path.with_name(path.name + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, __meta__=json.dumps(meta), **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        fault_point("ckpt.rename", path=str(path))    # the crash window
+        os.replace(tmp, path)
+        _fsync_dir(path.parent)
+    nbytes = path.stat().st_size
+    _CKPT_SAVES.inc()
+    _CKPT_BYTES.inc(nbytes)
+    _CKPT_LAST_BYTES.set(nbytes)
+    _CKPT_SAVE_S.observe(time.monotonic() - t0)
 
 
 def load(path: str, target: Any = None, verify: bool = True) -> Any:
@@ -102,9 +133,15 @@ def load(path: str, target: Any = None, verify: bool = True) -> Any:
     ``verify`` checks each array's stored CRC32 (checkpoints written
     before CRCs existed load unverified) and raises
     :class:`CheckpointCorruptError` on mismatch or an unreadable file."""
+    with _span("ckpt.restore", path=str(path)):
+        return _load_impl(path, target, verify)
+
+
+def _load_impl(path: str, target: Any, verify: bool) -> Any:
     p = str(path)
     if not p.endswith(".npz"):
         p = p + ".npz"
+    t0 = time.monotonic()
     try:
         with np.load(p, allow_pickle=False) as z:
             meta = json.loads(str(z["__meta__"]))
@@ -113,6 +150,7 @@ def load(path: str, target: Any = None, verify: bool = True) -> Any:
     except FileNotFoundError:
         raise
     except Exception as e:      # zip/pickle/json damage = corrupt file
+        _CKPT_UNREADABLE.inc()
         raise CheckpointCorruptError(f"{p}: unreadable checkpoint "
                                      f"({type(e).__name__}: {e})") from e
     if verify:
@@ -120,6 +158,7 @@ def load(path: str, target: Any = None, verify: bool = True) -> Any:
             if lm.get("kind") == "array" and "crc" in lm:
                 got = _crc(arrays[lm["key"]])
                 if got != lm["crc"]:
+                    _CKPT_CRC_FAILS.inc()
                     raise CheckpointCorruptError(
                         f"{p}: CRC mismatch for leaf {lm['path']} "
                         f"(stored {lm['crc']:#010x}, got {got:#010x})")
@@ -132,6 +171,8 @@ def load(path: str, target: Any = None, verify: bool = True) -> Any:
         else:
             by_path[lm["path"]] = None
     if target is None:
+        _CKPT_RESTORES.inc()
+        _CKPT_RESTORE_S.observe(time.monotonic() - t0)
         return by_path
     flat, treedef = jax.tree_util.tree_flatten_with_path(
         target, is_leaf=lambda x: x is None)
@@ -151,7 +192,10 @@ def load(path: str, target: Any = None, verify: bool = True) -> Any:
             new_leaves.append(arr)
         else:
             new_leaves.append(val if val is not None else leaf)
-    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+    out = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    _CKPT_RESTORES.inc()
+    _CKPT_RESTORE_S.observe(time.monotonic() - t0)
+    return out
 
 
 class CheckpointManager:

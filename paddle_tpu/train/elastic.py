@@ -13,9 +13,15 @@ import time
 from typing import Callable, Optional
 
 
+from paddle_tpu.observability import METRICS, instant as _trace_instant
 from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip
 
 __all__ = ["ElasticRunner", "run_elastic"]
+
+_RESTARTS = METRICS.counter(
+    "elastic_restarts_total", "elastic restarts taken after a failure")
+_GIVEUPS = METRICS.counter(
+    "elastic_giveups_total", "elastic runs abandoned at the restart cap")
 
 
 class ElasticRunner:
@@ -62,7 +68,11 @@ class ElasticRunner:
             except (WatchdogTrip, FloatingPointError, RuntimeError) as e:
                 self.failures.append(f"{type(e).__name__}: {e}")
                 self.restarts += 1
+                _RESTARTS.inc()
+                _trace_instant("elastic.restart", restart=self.restarts,
+                               cause=type(e).__name__)
                 if self.restarts > self.max_restarts:
+                    _GIVEUPS.inc()
                     raise RuntimeError(
                         f"elastic: gave up after {self.max_restarts} restarts; "
                         f"failures={self.failures}") from e

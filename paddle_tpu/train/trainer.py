@@ -16,9 +16,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
+from paddle_tpu.observability import METRICS, span as _span
+from paddle_tpu.observability.flops import record_throughput
 from paddle_tpu.train.checkpoint import CheckpointManager
 from paddle_tpu.train.step import TrainState, init_state
 from paddle_tpu.utils.faults import fault_point, fault_value
+
+# Training telemetry (ISSUE 2). tokens/sec + MFU ride the SHARED gauges
+# in observability.flops (record_throughput) — the same choke point
+# bench.py reads, so there is exactly one FLOPs/MFU model.
+_STEPS = METRICS.counter("train_steps_total", "optimizer steps completed")
+_STEP_S = METRICS.histogram(
+    "train_step_seconds", "wall time per training step (host-observed)")
+_NAN_SKIPS = METRICS.counter(
+    "train_nan_skips_total", "steps skipped on non-finite loss")
+_NAN_BACKOFF = METRICS.counter(
+    "train_nan_backoff_total", "backoff sleeps taken during NaN streaks")
+_LOSS = METRICS.gauge("train_loss", "most recent host-fetched loss")
 
 
 @dataclass
@@ -130,12 +144,20 @@ class Trainer:
             # loss value (NaN-storm injection without poisoning data)
             fault_point("train.step", step=int(self.state.step),
                         trainer=self)
-            micro = [self._to_batch(next(it)) for _ in range(accum)]
-            self.state, loss = self._step_fn(self.state, *micro)
-            if self.watchdog is not None:
-                self.watchdog.poke()   # raises WatchdogTrip if stalled
-            step_no = int(self.state.step)
-            loss_val = fault_value("train.loss", float(loss), step=step_no)
+            t_step = time.monotonic()
+            with _span("train.step", step=int(self.state.step)):
+                micro = [self._to_batch(next(it)) for _ in range(accum)]
+                self.state, loss = self._step_fn(self.state, *micro)
+                if self.watchdog is not None:
+                    self.watchdog.poke()   # raises WatchdogTrip if stalled
+                step_no = int(self.state.step)
+                # the float() fetch blocks on the device step, so the
+                # histogram sees real step latency, not dispatch latency
+                loss_val = fault_value("train.loss", float(loss),
+                                       step=step_no)
+            _STEP_S.observe(time.monotonic() - t_step)
+            _STEPS.inc()
+            _LOSS.set(loss_val)
 
             if args.nan_guard:
                 if not np.isfinite(loss_val):
@@ -144,6 +166,7 @@ class Trainer:
                     # eventually trip into the elastic restart path
                     self._bad_steps += 1
                     self.stats["nan_skips"] += 1
+                    _NAN_SKIPS.inc()
                     self.stats["bad_streak_max"] = max(
                         self.stats["bad_streak_max"], self._bad_steps)
                     if self._bad_steps >= args.max_bad_steps:
@@ -151,6 +174,7 @@ class Trainer:
                         raise WatchdogTrip(
                             f"{self._bad_steps} consecutive non-finite losses")
                     if args.nan_backoff_s > 0:
+                        _NAN_BACKOFF.inc()
                         time.sleep(min(
                             args.nan_backoff_s * 2 ** (self._bad_steps - 1),
                             args.nan_backoff_cap_s))
@@ -165,9 +189,13 @@ class Trainer:
                 rec = {"step": step_no, "loss": loss_val,
                        "steps_per_sec": args.log_every / dt if dt > 0 else 0.0,
                        "lr": self.optimizer.get_lr(self.state.opt_state)}
-                if args.flops_per_token and tokens_since:
+                if args.flops_per_token and tokens_since and dt > 0:
                     rec["tokens_per_sec"] = tokens_since / dt
-                    rec["mfu"] = (tokens_since / dt) * args.flops_per_token / args.peak_flops
+                    # one MFU model for trainer, StepTimer, and bench.py:
+                    # the shared gauges in observability.flops
+                    rec["mfu"] = record_throughput(
+                        tokens_since / dt, args.flops_per_token,
+                        args.peak_flops)
                 self.history.append(rec)
                 for h in self.hooks:
                     h(rec)

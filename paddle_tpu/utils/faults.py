@@ -43,6 +43,14 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from paddle_tpu.observability import METRICS, instant as _trace_instant
+
+# chaos runs are self-describing: every firing increments this counter
+# (labelled by site) and drops an instant event on the trace timeline
+_INJECTED = METRICS.counter(
+    "faults_injected_total",
+    "fault-injection firings by chaos site", labelnames=("site",))
+
 __all__ = ["FAULTS", "FaultRegistry", "FaultRule", "InjectedFault",
            "InjectedCrash", "fault_point", "fault_value"]
 
@@ -169,6 +177,8 @@ class FaultRegistry:
         for rule in self._rules.get(site, ()):
             if rule.matches(hit):
                 self.log.append((site, hit))
+                _INJECTED.inc(site=site)
+                _trace_instant(f"fault:{site}", hit=hit)
                 out = rule.fire(ctx)
         return out
 

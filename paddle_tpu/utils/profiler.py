@@ -4,16 +4,34 @@ RecordEvent, chrome-trace export; SURVEY.md §2.9).
 TPU-native: wraps ``jax.profiler`` (XLA's own tracer → TensorBoard/perfetto
 trace with per-op HLO timings, HBM usage, ICI traffic) plus a host-side
 step-timer with MFU accounting, and HLO/jaxpr dump helpers for graph debug.
+
+Since the observability subsystem landed, the names here are THIN
+DELEGATES: Profiler also drives the host-side span tracer (and writes
+its Chrome trace next to the XLA artifact on stop), RecordEvent opens an
+observability span alongside the XLA annotation, and StepTimer feeds the
+shared ``train_tokens_per_sec``/``train_mfu`` gauges through the same
+:func:`~paddle_tpu.observability.flops.record_throughput` choke point the
+Trainer and bench.py use.
 """
 from __future__ import annotations
 
 import contextlib
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 import jax
+
+from paddle_tpu.observability import METRICS, TRACER, span as _span
+from paddle_tpu.observability.flops import record_throughput
+
+_STEPTIMER_S = METRICS.histogram(
+    "steptimer_step_seconds", "wall time per StepTimer start/stop window")
+_DEV_MEM = METRICS.gauge(
+    "device_bytes_in_use", "per-device bytes in use (0 when the backend "
+    "does not report memory stats)", labelnames=("device",))
 
 
 class Profiler:
@@ -21,7 +39,9 @@ class Profiler:
     on_trace_ready=...) ... start/stop. ``targets`` is accepted for parity
     (XLA traces always cover host + device); ``on_trace_ready`` runs
     BEFORE the trace starts so export_chrome_tracing can direct the
-    output directory."""
+    output directory. Also drives the host span tracer: host spans are
+    collected while active and written to ``<log_dir>/host_trace.json``
+    (Chrome/Perfetto format) on stop."""
 
     def __init__(self, log_dir: str = "profile_out", targets=None,
                  scheduler=None, on_trace_ready=None):
@@ -30,17 +50,31 @@ class Profiler:
         self.scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._active = False
+        self._owns_tracer = False
+        self.host_trace_path: Optional[str] = None
 
     def start(self):
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)  # may redirect self.log_dir
         jax.profiler.start_trace(self.log_dir)
+        # only take over the host tracer if nobody else enabled it —
+        # a surrounding `with TRACER:` keeps ownership of its buffer
+        self._owns_tracer = not TRACER._enabled
+        if self._owns_tracer:
+            TRACER.enable()
         self._active = True
         return self
 
     def stop(self):
         if self._active:
             jax.profiler.stop_trace()
+            if self._owns_tracer:
+                os.makedirs(self.log_dir, exist_ok=True)
+                self.host_trace_path = os.path.join(
+                    self.log_dir, "host_trace.json")
+                TRACER.export_chrome_trace(self.host_trace_path)
+                TRACER.disable()
+                self._owns_tracer = False
             self._active = False
 
     def __enter__(self):
@@ -52,28 +86,40 @@ class Profiler:
 
 @contextlib.contextmanager
 def record_event(name: str):
-    """Ref: paddle.profiler.RecordEvent — annotates the XLA trace."""
-    with jax.profiler.TraceAnnotation(name):
+    """Ref: paddle.profiler.RecordEvent — annotates the XLA trace and the
+    host span timeline."""
+    with jax.profiler.TraceAnnotation(name), _span(name):
         yield
 
 
 def device_memory_stats() -> dict:
-    """Per-device HBM usage (ref: paddle.device.cuda.memory_allocated)."""
+    """Per-device HBM usage (ref: paddle.device.cuda.memory_allocated).
+    Backends without memory stats (CPU) report explicit zeroed
+    placeholders with the backend named, never an empty dict."""
     out = {}
     for d in jax.local_devices():
         try:
-            s = d.memory_stats()
-            out[str(d)] = {"bytes_in_use": s.get("bytes_in_use"),
-                           "peak_bytes_in_use": s.get("peak_bytes_in_use"),
-                           "bytes_limit": s.get("bytes_limit")}
+            s = d.memory_stats() or {}
         except Exception:
-            out[str(d)] = {}
+            s = {}
+        if s:
+            rec = {"backend": d.platform,
+                   "bytes_in_use": s.get("bytes_in_use"),
+                   "peak_bytes_in_use": s.get("peak_bytes_in_use"),
+                   "bytes_limit": s.get("bytes_limit")}
+        else:
+            rec = {"backend": d.platform, "bytes_in_use": 0,
+                   "peak_bytes_in_use": 0, "bytes_limit": 0}
+        out[str(d)] = rec
+        _DEV_MEM.set(rec["bytes_in_use"] or 0, device=str(d))
     return out
 
 
 @dataclass
 class StepTimer:
-    """Host-side step timing + MFU meter."""
+    """Host-side step timing + MFU meter. Each stop() also lands in the
+    ``steptimer_step_seconds`` histogram and (when tokens are reported)
+    the shared throughput/MFU gauges."""
     flops_per_token: float = 0.0
     peak_flops: float = 197e12
     _t0: float = field(default=0.0, repr=False)
@@ -85,10 +131,13 @@ class StepTimer:
     def stop(self, tokens: int = 0) -> dict:
         dt = time.perf_counter() - self._t0
         rec = {"step_s": dt}
-        if tokens:
+        _STEPTIMER_S.observe(dt)
+        if tokens and dt > 0:
             rec["tokens_per_sec"] = tokens / dt
+            mfu = record_throughput(tokens / dt, self.flops_per_token,
+                                    self.peak_flops)
             if self.flops_per_token:
-                rec["mfu"] = tokens / dt * self.flops_per_token / self.peak_flops
+                rec["mfu"] = mfu
         self.records.append(rec)
         return rec
 
@@ -126,17 +175,23 @@ class ProfilerTarget:
 
 class RecordEvent:
     """Ref profiler.RecordEvent: context manager/decorator annotating the
-    trace (maps onto jax.profiler.TraceAnnotation)."""
+    trace (maps onto jax.profiler.TraceAnnotation plus a host span)."""
 
     def __init__(self, name: str):
         self.name = name
         self._cm = None
+        self._span = None
 
     def begin(self):
         self._cm = jax.profiler.TraceAnnotation(self.name)
         self._cm.__enter__()
+        self._span = _span(self.name)
+        self._span.__enter__()
 
     def end(self):
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
         if self._cm is not None:
             self._cm.__exit__(None, None, None)
             self._cm = None

@@ -42,3 +42,19 @@ def _clear_faults():
     FAULTS.clear()
     yield
     FAULTS.clear()
+
+
+@pytest.fixture(autouse=True)
+def _clear_observability():
+    """Telemetry hygiene: every test starts with zeroed metric series,
+    an empty span buffer, and the tracer disabled (its default)."""
+    from paddle_tpu.observability import METRICS, TRACER
+    METRICS.reset()
+    METRICS.enable()
+    TRACER.disable()
+    TRACER.clear()
+    yield
+    METRICS.reset()
+    METRICS.enable()
+    TRACER.disable()
+    TRACER.clear()

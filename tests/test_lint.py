@@ -1,5 +1,9 @@
 """Collective-deadlock lint: catches divergent-cond collectives and
-collective while-predicates; passes clean SPMD code."""
+collective while-predicates; passes clean SPMD code. Plus a source-level
+clock lint: durations must never come from the wall clock."""
+import pathlib
+import re
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -102,6 +106,34 @@ def test_pipeline_shard_map_body_lints_clean():
     assert rep.ok, rep.issues
     names = [n for n, _ in rep.sequence]
     assert "ppermute" in names
+
+
+# --------------------------------------------------------------- clock lint
+# Durations measured with time.time() jump when NTP steps the wall clock —
+# every duration in paddle_tpu must ride time.monotonic()/perf_counter or
+# the observability span API. Files with a LEGITIMATE wall-clock need
+# (timestamps for humans, not durations) go on the allowlist with a reason.
+_WALLCLOCK_ALLOWLIST = {
+    # e.g. "paddle_tpu/some/module.py": "emits human-readable timestamps",
+}
+
+
+def test_no_wall_clock_durations_in_paddle_tpu():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    pkg = root / "paddle_tpu"
+    pat = re.compile(r"\btime\.time\s*\(")
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        if rel in _WALLCLOCK_ALLOWLIST:
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if pat.search(line.split("#", 1)[0]):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "wall-clock time.time() used for timing (use time.monotonic() or "
+        "the observability span API, or allowlist with a reason):\n"
+        + "\n".join(offenders))
 
 
 def test_pipeline_divergent_handoff_flagged():

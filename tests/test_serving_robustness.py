@@ -267,6 +267,11 @@ def test_chaos_allocator_failures_no_leaks_exact_outputs(model):
             np.asarray(eng.requests[rid].tokens), _solo(model, p, 6),
             err_msg=f"request {rid} corrupted by chaos")
     eng.assert_quiescent()
+    # fault counters agree with the chaos log (ISSUE 2)
+    from paddle_tpu.observability import METRICS
+    snap = METRICS.snapshot()["counters"]
+    assert snap['faults_injected_total{site="serving.alloc"}'] == \
+        len(FAULTS.log)
 
 
 def test_chaos_induced_preemption_exact_outputs(model):
@@ -287,6 +292,12 @@ def test_chaos_induced_preemption_exact_outputs(model):
         np.testing.assert_array_equal(
             np.asarray(eng.requests[rid].tokens), _solo(model, p, 6))
     eng.assert_quiescent()
+    # the chaos run shows up in the metrics registry (ISSUE 2): every
+    # induced preemption and injected firing is counted
+    from paddle_tpu.observability import METRICS
+    snap = METRICS.snapshot()["counters"]
+    assert snap["serving_preemptions_total"] == eng.stats["preemptions"]
+    assert snap['faults_injected_total{site="serving.preempt"}'] > 0
 
 
 def test_chaos_tick_exception_engine_state_survives(model):

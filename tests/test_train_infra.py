@@ -171,6 +171,13 @@ def test_injected_nan_losses_counted_and_skipped():
     assert tr.stats["nan_skips"] == 3
     assert tr.stats["bad_streak_max"] == 3
     assert tr._bad_steps == 0              # streak reset by the good tail
+    # the same run is visible in the metrics registry (ISSUE 2): every
+    # injected loss override and every skip landed in a counter
+    from paddle_tpu.observability import METRICS
+    snap = METRICS.snapshot()["counters"]
+    assert snap["train_nan_skips_total"] == 3
+    assert snap['faults_injected_total{site="train.loss"}'] == 3
+    assert snap["train_steps_total"] == 8
 
 
 @pytest.mark.chaos
