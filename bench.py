@@ -379,6 +379,73 @@ def bench_moe_ep(on_tpu, sync):
             "ep": n, "experts": mcfg.num_experts}
 
 
+def bench_host_overlap():
+    """Whole-loop host/device overlap micro-benchmark (ISSUE 3): steps/sec
+    of the synchronous fit loop vs pipeline_depth=3 + prefetch_to_device,
+    driven by a deliberately host-bound iterator. Calibrated — the
+    iterator sleeps ~one device step per batch, the worst case for a
+    synchronous loop (host and device strictly serialize) and the best
+    case for overlap (each side hides the other). CPU-safe by design:
+    this measures loop structure, not kernel speed."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.io import prefetch_to_device
+    from paddle_tpu.train.trainer import Trainer, TrainerArgs
+
+    steps, every = 30, 10
+
+    def make(depth):
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(256, 1024), nn.Tanh(),
+                            nn.Linear(1024, 1024), nn.Tanh(),
+                            nn.Linear(1024, 1))
+        return Trainer(net, popt.SGD(learning_rate=0.05),
+                       lambda m, x, y: nn.functional.mse_loss(m(x), y),
+                       TrainerArgs(max_steps=steps, log_every=every,
+                                   pipeline_depth=depth))
+
+    rng = np.random.default_rng(0)
+    batches = [(rng.standard_normal((128, 256)).astype(np.float32),
+                rng.standard_normal((128, 1)).astype(np.float32))
+               for _ in range(steps)]
+
+    def steady_sps(tr):
+        """Steps/sec from the trainer's own log records, dropping the
+        FIRST record — it pays the per-fit jit compile (each Trainer
+        re-jits its step closure)."""
+        recs = tr.history[1:]
+        return sum(r["steps_per_sec"] for r in recs) / len(recs)
+
+    cal = make(0)
+    cal.fit(iter(batches))
+    # sleep one measured STEADY-STATE device step per batch: host and
+    # device each take ~d, so sync pays ~2d/step and overlap pays ~d
+    d_step = min(max(1.0 / steady_sps(cal), 0.005), 0.1)
+
+    def host_bound():
+        for b in batches:
+            time.sleep(d_step)
+            yield b
+
+    def run(depth):
+        tr = make(depth)
+        if depth:
+            with prefetch_to_device(host_bound(), depth=depth) as p:
+                tr.fit(p)
+        else:
+            tr.fit(host_bound())
+        return steady_sps(tr)
+
+    sync_sps = run(0)
+    pipe_sps = run(3)
+    return {"host_step_ms": round(d_step * 1e3, 2),
+            "sync_steps_per_sec": round(sync_sps, 2),
+            "pipelined_steps_per_sec": round(pipe_sps, 2),
+            "speedup": round(pipe_sps / sync_sps, 3)}
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -485,6 +552,14 @@ def main():
             print(f"bench config {name} failed: {e!r}", file=sys.stderr)
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
 
+    # host/device overlap: whole-loop sync vs pipelined steps/sec on a
+    # host-bound iterator — backend-independent, lands in "metrics"
+    try:
+        host_overlap = bench_host_overlap()
+    except Exception as e:  # noqa: BLE001 — per-config isolation
+        print(f"bench config host_overlap failed: {e!r}", file=sys.stderr)
+        host_overlap = {"error": f"{type(e).__name__}: {e}"}
+
     # honest config label: the CPU-smoke fallback runs LlamaConfig.tiny(),
     # not the 0.8B geometry — name the metric by what actually ran
     size_tag = f"{n_params / 1e9:.1f}b" if n_params >= 5e7 else f"{n_params:,}-param smoke"
@@ -496,6 +571,7 @@ def main():
         "mfu": snap["gauges"].get("train_mfu", 0.0),
         "counters": {k: v for k, v in snap["counters"].items()
                      if k.startswith(("collective_", "faults_"))},
+        "host_overlap": host_overlap,
     }
     print(json.dumps({
         "metric": f"llama-{size_tag} bf16 train step tokens/sec/chip (MFU in extra)",

@@ -19,4 +19,5 @@ from paddle_tpu.io.sampler import (
 )
 from paddle_tpu.io.dataloader import (DataLoader, WorkerInfo,
                                       default_collate_fn, get_worker_info)
+from paddle_tpu.io.prefetch import DevicePrefetch, prefetch_to_device
 from paddle_tpu.io.token_bin import TokenBinDataset

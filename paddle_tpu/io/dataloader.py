@@ -114,6 +114,16 @@ class DataLoader:
             return
         yield from self._mp_iter()
 
+    def prefetch(self, depth: int = 2, sharding=None):
+        """Host/device overlap: iterate this loader through a background
+        thread that lands each batch on device (``jax.device_put``)
+        ``depth`` batches ahead of the consumer — see
+        :func:`paddle_tpu.io.prefetch.prefetch_to_device`. The returned
+        object is a fresh iterator over ONE pass of the loader; close it
+        (or exhaust it) to reap the producer thread."""
+        from paddle_tpu.io.prefetch import prefetch_to_device
+        return prefetch_to_device(iter(self), depth, sharding)
+
     def _threaded_iter(self):
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         _END = object()

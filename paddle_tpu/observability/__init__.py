@@ -26,12 +26,16 @@ from paddle_tpu.observability.tracing import (TRACER, Tracer, span, instant,
                                               export_chrome_trace)
 from paddle_tpu.observability.flops import (PEAK_BF16, chip_peak_flops, mfu,
                                             record_throughput)
+from paddle_tpu.observability.httpd import (MetricsServer,
+                                            start_metrics_server,
+                                            stop_metrics_server)
 
 __all__ = [
     "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "DEFAULT_BUCKETS",
     "TRACER", "Tracer", "span", "instant", "export_chrome_trace",
     "PEAK_BF16", "chip_peak_flops", "mfu", "record_throughput",
+    "MetricsServer", "start_metrics_server", "stop_metrics_server",
     "enable", "disable", "metrics_snapshot", "dump",
 ]
 

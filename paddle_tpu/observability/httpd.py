@@ -1,0 +1,109 @@
+"""``/metrics`` pull endpoint (ROADMAP open item; ISSUE 3 satellite).
+
+A stdlib-only ``http.server`` running on a daemon thread, exposing the
+process-global registry the way a Prometheus scraper expects:
+
+  * ``GET /metrics``       → text exposition format 0.0.4
+  * ``GET /metrics.json``  → the one-line JSON snapshot
+  * anything else          → 404
+
+Usage::
+
+    from paddle_tpu.observability import start_metrics_server
+    srv = start_metrics_server(port=9100)    # port=0 picks a free port
+    ...                                      # scrape http://host:srv.port/metrics
+    srv.stop()
+
+``start_metrics_server``/``stop_metrics_server`` also manage one
+module-level default server so a training script can expose metrics in
+two lines and not hold a handle. The serving thread is named
+``pt-metrics-http`` (the test suite's leak fixture reaps strays).
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from paddle_tpu.observability.metrics import METRICS
+
+__all__ = ["MetricsServer", "start_metrics_server", "stop_metrics_server"]
+
+_PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = METRICS.to_prometheus().encode()
+            ctype = _PROM_CTYPE
+        elif path == "/metrics.json":
+            body = (METRICS.to_json() + "\n").encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404, "try /metrics or /metrics.json")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):   # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    """One bound listener + one daemon serve thread. ``port=0`` binds an
+    ephemeral port; read it back from :attr:`port` (useful in tests and
+    when several trainers share a host)."""
+
+    def __init__(self, port: int = 9100, host: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pt-metrics-http",
+            kwargs={"poll_interval": 0.1}, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.host == "0.0.0.0" else self.host
+        return f"http://{host}:{self.port}/metrics"
+
+    def stop(self, timeout: float = 5.0):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+_default: Optional[MetricsServer] = None
+_default_lock = threading.Lock()
+
+
+def start_metrics_server(port: int = 9100, host: str = "0.0.0.0") -> MetricsServer:
+    """Start (or return the already-running) module-default server."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsServer(port=port, host=host)
+        return _default
+
+
+def stop_metrics_server():
+    """Stop the module-default server, if one is running."""
+    global _default
+    with _default_lock:
+        srv, _default = _default, None
+    if srv is not None:
+        srv.stop()

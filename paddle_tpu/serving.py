@@ -584,6 +584,10 @@ class LLMEngine:
         greedy prompts in rows 0..n-1, each beam request's prompt as one
         more row (written into its beam-0 slot; the forks are installed
         after, in ``_beam_init``)."""
+        if not admits and not beam_admits:
+            # nothing admitted: never pay the full (num_slots,
+            # max_prompt_len) padded forward on all-sentinel rows
+            return []
         a_cap = self.num_slots           # one compiled admission shape
         ids = np.zeros((a_cap, self.max_prompt_len), np.int32)
         lens = np.zeros(a_cap, np.int32)
@@ -841,6 +845,11 @@ class LLMEngine:
                 "paged pool cannot fit one prefill chunk of the remaining "
                 "request(s) even after preemption — increase num_blocks or "
                 "reduce max_prompt_len (chunk size)")
+        if not progressed:
+            # every prefilling row is starved of blocks this tick (decode
+            # keeps the engine alive): the batch is all-sentinel, so the
+            # padded chunk forward would scatter nothing — skip it
+            return []
         logits, self.cache = _PREFILL_CHUNK_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             jnp.asarray(offs), self.cache, jnp.asarray(slots),

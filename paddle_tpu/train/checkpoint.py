@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import zlib
 from pathlib import Path
@@ -59,6 +60,9 @@ _CKPT_CRC_FAILS = METRICS.counter(
     "ckpt_crc_failures_total", "array CRC mismatches caught on load")
 _CKPT_UNREADABLE = METRICS.counter(
     "ckpt_unreadable_total", "checkpoints that failed to parse at all")
+_CKPT_ASYNC_INFLIGHT = METRICS.gauge(
+    "ckpt_async_in_flight", "background checkpoint writes in flight (0/1 — "
+    "at most one save is ever in flight)")
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -206,14 +210,29 @@ class CheckpointManager:
     rename (itself via fsync'd tmp+replace), and ``restore`` verifies
     CRCs — falling back step-by-step to the newest checkpoint that still
     loads when the latest one is corrupt (``fallback=False`` restores
-    strictly the requested step or raises)."""
+    strictly the requested step or raises).
 
-    def __init__(self, directory: str, max_to_keep: int = 3, use_orbax: bool = False):
+    Async mode (``async_save=True``, ISSUE 3): ``save`` snapshots the
+    device arrays to host ON THE CALLER'S THREAD (so a later donated
+    train step can never race the copy), then hands the whole existing
+    tmp+fsync+``os.replace`` protocol to a single background writer
+    thread and returns. The durability invariants are untouched — the
+    ``latest`` pointer still advances only after the durable rename,
+    inside the writer. At most one save is ever in flight (a second
+    ``save`` first waits out the previous one); ``wait()`` joins the
+    writer and re-raises anything it threw. "save returned" therefore
+    means "snapshot taken", NOT "durable" — call ``wait()`` for that."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 use_orbax: bool = False, async_save: bool = False):
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
         self.use_orbax = use_orbax
+        self.async_save = async_save
         self.last_restored_step: Optional[int] = None
+        self._writer: Optional[threading.Thread] = None
+        self._writer_exc: Optional[BaseException] = None
         if use_orbax:
             import orbax.checkpoint as ocp
             self._mgr = ocp.CheckpointManager(
@@ -239,11 +258,60 @@ class CheckpointManager:
                                        is_leaf=lambda x: x is None)))
             self._mgr.wait_until_finished()
             return
+        if self.async_save:
+            return self._save_async(step, state)
         save(state, self._step_path(step))
         # pointer AFTER the durable rename: a kill anywhere before this
         # line leaves ``latest`` on the previous good checkpoint
         self._write_latest(step)
         self._gc()
+
+    def _save_async(self, step: int, state) -> None:
+        # one save in flight, ever: a prior writer finishes (and its
+        # failure surfaces HERE) before the next snapshot is taken
+        self.wait()
+        # device→host copy on the caller's thread: after this returns the
+        # snapshot shares nothing with the live (donated) TrainState.
+        # np.asarray on a jax.Array materializes a fresh host buffer, but
+        # on an ndarray it aliases — host leaves need the explicit copy
+        snapshot = jax.tree_util.tree_map(
+            lambda x: np.array(x, copy=True) if isinstance(x, np.ndarray)
+            else np.asarray(x) if isinstance(x, jax.Array) else x,
+            state, is_leaf=lambda x: x is None)
+        _CKPT_ASYNC_INFLIGHT.set(1)
+
+        def _write():
+            try:
+                save(snapshot, self._step_path(step))
+                # same ordering as the sync path: pointer only after the
+                # durable rename — a writer death here leaves ``latest``
+                # on the previous good checkpoint
+                self._write_latest(step)
+                self._gc()
+            except BaseException as e:   # surfaced by wait()/next save()
+                self._writer_exc = e
+            finally:
+                _CKPT_ASYNC_INFLIGHT.set(0)
+
+        self._writer = threading.Thread(target=_write, name="pt-ckpt-writer",
+                                        daemon=True)
+        self._writer.start()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until the in-flight background save (if any) is durable;
+        re-raise anything the writer threw. No-op in sync mode. Tests and
+        ``Trainer.fit`` (at exit) call this — it is the only point where
+        "the checkpoint is on disk" is guaranteed in async mode."""
+        t = self._writer
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"checkpoint writer still running after {timeout}s")
+            self._writer = None
+        if self._writer_exc is not None:
+            exc, self._writer_exc = self._writer_exc, None
+            raise exc
 
     def all_steps(self) -> list:
         return sorted(int(p.stem.split("_")[1])

@@ -4,10 +4,21 @@ One fused jitted step (grads+clip+optimizer+schedule), gradient accumulation
 via an inner ``lax.scan``-free accumulation (accumulate in fp32 and apply on
 the boundary — keeps one compiled program), watchdog/NaN sentinel hooks, MFU
 logging, checkpoint/resume.
+
+Host/device overlap (ISSUE 3): with ``pipeline_depth=K > 0``, ``fit``
+keeps a K-deep window of dispatched-but-unfetched steps — XLA's async
+dispatch queue executes step N while the host is already feeding steps
+N+1..N+K — and the host-side work that needs the loss value (the
+``float()`` fetch, NaN guard, fault_value override, watchdog poke, loss
+gauge) moves to the DRAIN side of the window with correct (≤K-lagged)
+step attribution. Log/eval/checkpoint boundaries drain the window first,
+so everything they observe (LR, params, step counter) is exact.
+``pipeline_depth=0`` (the default) is the unchanged synchronous loop.
 """
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -55,6 +66,14 @@ class TrainerArgs:
     resume_reskip: bool = False           # fast-forward a FRESH stream on resume
     # (leave False when the caller positions the iterator; ElasticRunner
     # always rebuilds streams from scratch and turns this on)
+    # host/device overlap: keep up to this many dispatched steps in
+    # flight before fetching their losses. 0 = the synchronous loop,
+    # bit-identical to the pre-pipelining trainer.
+    pipeline_depth: int = 0
+    # background checkpoint writes (CheckpointManager(async_save=True)):
+    # save() snapshots to host and returns; the tmp+fsync+rename protocol
+    # runs on a writer thread. fit() calls mgr.wait() at exit either way.
+    async_ckpt: bool = False
 
 
 class Trainer:
@@ -122,8 +141,14 @@ class Trainer:
         return self
 
     def fit(self, data_iter, eval_fn: Optional[Callable] = None):
+        if self.args.pipeline_depth > 0:
+            return self._fit_pipelined(data_iter, eval_fn)
+        return self._fit_sync(data_iter, eval_fn)
+
+    def _fit_sync(self, data_iter, eval_fn: Optional[Callable] = None):
         args = self.args
-        mgr = CheckpointManager(args.ckpt_dir) if args.ckpt_every else None
+        mgr = (CheckpointManager(args.ckpt_dir, async_save=args.async_ckpt)
+               if args.ckpt_every else None)
         accum = args.grad_accum_steps
         t_last = time.perf_counter()
         tokens_since = 0
@@ -204,6 +229,146 @@ class Trainer:
                 mgr.save(step_no, self.state)
             if eval_fn and args.log_every and step_no % (args.log_every * 10) == 0:
                 eval_fn(self.state.model)
+        if mgr is not None:
+            mgr.wait()     # async mode: "fit returned" implies durable
+        return self.state
+
+    # ------------------------------------------------- pipelined fit path
+    def _fit_pipelined(self, data_iter, eval_fn: Optional[Callable] = None):
+        """The deferred-sync loop. Invariants vs the synchronous path:
+
+        * the DISPATCH sequence (batch order, jitted calls, donation
+          chain) is identical, so per-step losses are bit-identical;
+        * every host decision that needs a loss value happens at drain
+          time, attributed to the step that produced it — a host step
+          mirror tracks the in-graph counter (which does NOT advance on
+          a non-finite loss when nan_guard holds the update);
+        * log/ckpt/eval fire only with the window empty, so they see
+          exactly the state the synchronous loop would have seen.
+        """
+        args = self.args
+        depth = args.pipeline_depth
+        mgr = (CheckpointManager(args.ckpt_dir, async_save=args.async_ckpt)
+               if args.ckpt_every else None)
+        accum = args.grad_accum_steps
+        start_step = int(self.state.step)
+        if start_step >= args.max_steps:
+            return self.state
+        it = iter(data_iter)
+        if start_step and args.resume_reskip:
+            for _ in range(start_step * accum):
+                next(it)
+
+        window: deque = deque()   # (loss_handle, t_dispatch, n_tokens)
+        drained = start_step      # host mirror of the device step counter
+        last_loss = float("nan")
+        t_last = time.perf_counter()
+        tokens_since = 0
+        boundary_done = start_step   # last step boundary actions ran for
+
+        def is_boundary(s: int) -> bool:
+            if s <= boundary_done:
+                return False
+            return ((args.log_every and s % args.log_every == 0)
+                    or (mgr and s % args.ckpt_every == 0)
+                    or (eval_fn is not None and args.log_every
+                        and s % (args.log_every * 10) == 0))
+
+        def drain_one():
+            nonlocal drained, last_loss, tokens_since
+            loss, t_disp, ntok = window.popleft()
+            with _span("train.drain", step=drained + 1,
+                       inflight=len(window) + 1):
+                raw = float(loss)         # blocks until the step executed
+            if self.watchdog is not None:
+                self.watchdog.poke()      # raises WatchdogTrip if stalled
+            # in-graph guard held params/opt/step on a non-finite loss, so
+            # the device counter did not move — mirror that on the host
+            if (not args.nan_guard) or np.isfinite(raw):
+                drained += 1
+            step_no = drained
+            loss_val = fault_value("train.loss", raw, step=step_no)
+            _STEP_S.observe(time.monotonic() - t_disp)
+            _STEPS.inc()
+            _LOSS.set(loss_val)
+            last_loss = loss_val
+            tokens_since += ntok
+            if args.nan_guard:
+                if not np.isfinite(loss_val):
+                    self._bad_steps += 1
+                    self.stats["nan_skips"] += 1
+                    _NAN_SKIPS.inc()
+                    self.stats["bad_streak_max"] = max(
+                        self.stats["bad_streak_max"], self._bad_steps)
+                    if self._bad_steps >= args.max_bad_steps:
+                        from paddle_tpu.utils.watchdog import WatchdogTrip
+                        raise WatchdogTrip(
+                            f"{self._bad_steps} consecutive non-finite losses")
+                    if args.nan_backoff_s > 0:
+                        _NAN_BACKOFF.inc()
+                        time.sleep(min(
+                            args.nan_backoff_s * 2 ** (self._bad_steps - 1),
+                            args.nan_backoff_cap_s))
+                else:
+                    self._bad_steps = 0
+
+        def run_boundaries():
+            """Log/ckpt/eval for the (fully drained) current step — same
+            order and conditions as the synchronous loop."""
+            nonlocal t_last, tokens_since, boundary_done
+            step_no = drained
+            if step_no <= boundary_done:
+                return
+            boundary_done = step_no
+            if args.log_every and step_no % args.log_every == 0:
+                now = time.perf_counter()
+                dt = now - t_last
+                rec = {"step": step_no, "loss": last_loss,
+                       "steps_per_sec": args.log_every / dt if dt > 0 else 0.0,
+                       "lr": self.optimizer.get_lr(self.state.opt_state)}
+                if args.flops_per_token and tokens_since and dt > 0:
+                    rec["tokens_per_sec"] = tokens_since / dt
+                    rec["mfu"] = record_throughput(
+                        tokens_since / dt, args.flops_per_token,
+                        args.peak_flops)
+                self.history.append(rec)
+                for h in self.hooks:
+                    h(rec)
+                t_last, tokens_since = now, 0
+            if mgr and step_no % args.ckpt_every == 0:
+                # the window is empty: self.state IS step `step_no`
+                mgr.save(step_no, self.state)
+            if (eval_fn and args.log_every
+                    and step_no % (args.log_every * 10) == 0):
+                eval_fn(self.state.model)
+
+        for _ in range(start_step, args.max_steps):
+            # chaos hook rides the dispatch side (an exception here must
+            # reach the elastic restart net immediately); the host step
+            # prediction replaces int(state.step), which would sync
+            fault_point("train.step", step=drained + len(window),
+                        trainer=self)
+            t_disp = time.monotonic()
+            with _span("train.step", step=drained + len(window)):
+                micro = [self._to_batch(next(it)) for _ in range(accum)]
+                self.state, loss = self._step_fn(self.state, *micro)
+            ntok = sum(int(np.prod(b[0].shape[:2])) for b in micro
+                       if hasattr(b[0], "shape") and b[0].ndim >= 2)
+            window.append((loss, t_disp, ntok))
+            while len(window) > depth:
+                drain_one()
+            # drain fully when the just-dispatched step lands on a
+            # boundary (host prediction — exact unless a NaN is in
+            # flight), or when a mid-window drain revealed one
+            if is_boundary(drained + len(window)) or is_boundary(drained):
+                while window:
+                    drain_one()
+                run_boundaries()
+        while window:
+            drain_one()
+        run_boundaries()
+        if mgr is not None:
+            mgr.wait()     # async mode: "fit returned" implies durable
         return self.state
 
     @staticmethod

@@ -31,7 +31,8 @@ class StallWatchdog:
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, name="pt-watchdog",
+                                        daemon=True)
         self._thread.start()
         return self
 

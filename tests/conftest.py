@@ -45,6 +45,26 @@ def _clear_faults():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_threads():
+    """Background-thread hygiene: every paddle_tpu helper thread carries a
+    ``pt-`` name prefix (prefetch producers, the async checkpoint writer,
+    the metrics HTTP server, stall watchdogs). None may outlive the test
+    that started it. A short grace join absorbs threads that are already
+    winding down (e.g. a prefetch producer observing its closed flag)."""
+    import threading
+    import time
+    yield
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.name.startswith("pt-") and t.is_alive()]
+        if not leaked:
+            return
+        time.sleep(0.02)
+    assert not leaked, f"leaked background threads: {[t.name for t in leaked]}"
+
+
+@pytest.fixture(autouse=True)
 def _clear_observability():
     """Telemetry hygiene: every test starts with zeroed metric series,
     an empty span buffer, and the tracer disabled (its default)."""

@@ -158,6 +158,83 @@ def test_damaged_pointer_falls_back_to_glob(tmp_path):
     assert mgr.latest_step() == 5
 
 
+# ---------------------------------------------------- async (background) save
+
+def test_async_save_is_durable_after_wait(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    mgr.save(1, _state(1.0))       # returns once the snapshot is taken
+    mgr.wait()                     # ...and THIS is the durability point
+    assert mgr.latest_step() == 1
+    got = mgr.restore(_state(0.0))
+    assert got["step"] == 1
+
+
+def test_async_writer_death_preserves_previous_checkpoint(tmp_path):
+    """ISSUE 3 crash-safety: the writer dies between the snapshot and the
+    rename (injected at the ckpt.rename window, which now fires on the
+    writer thread). ``latest`` must never advance, the failure must
+    surface at wait(), and restore must land on the previous durable
+    step."""
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    mgr.save(1, _state(1.0))
+    mgr.wait()
+    FAULTS.install("ckpt.rename", on={0}, exc=InjectedCrash)
+    mgr.save(2, _state(2.0))       # returns fine — the crash is in-flight
+    with pytest.raises(InjectedCrash):
+        mgr.wait()
+    FAULTS.clear()
+    assert mgr.latest_step() == 1
+    got = mgr.restore(_state(0.0))
+    assert got["step"] == 1 and mgr.last_restored_step == 1
+    mgr.save(2, _state(2.0))       # retry supersedes cleanly
+    mgr.wait()
+    assert mgr.latest_step() == 2
+
+
+def test_async_writer_failure_surfaces_at_next_save(tmp_path):
+    """A caller that never wait()s between saves still sees the failure:
+    save N+1 first drains save N and re-raises its exception."""
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    FAULTS.install("ckpt.write", on={0}, exc=InjectedCrash)
+    mgr.save(1, _state(1.0))
+    with pytest.raises(InjectedCrash):
+        mgr.save(2, _state(2.0))
+    FAULTS.clear()
+    mgr.wait()                     # exception already consumed — clean now
+
+
+def test_async_save_snapshots_before_mutation(tmp_path):
+    """The device→host copy happens on the caller's thread BEFORE save
+    returns: mutating (or donating) the live buffers afterwards must not
+    corrupt the bytes on disk. A stall injected in the write window keeps
+    the writer busy while the caller scribbles over the source array."""
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    live = _state(7.0)
+    FAULTS.install("ckpt.write", on={0}, stall_s=0.2)
+    mgr.save(1, live)
+    live["w"][:] = -1.0            # donation stand-in: buffer reused
+    mgr.wait()
+    got = mgr.restore(_state(0.0))
+    np.testing.assert_array_equal(np.asarray(got["w"]),
+                                  np.full((4,), 7.0, np.float32))
+
+
+def test_async_save_at_most_one_in_flight(tmp_path):
+    """A second save must wait out the first writer, never overlap it."""
+    import threading as _threading
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    FAULTS.install("ckpt.write", on={0}, stall_s=0.3)
+    mgr.save(1, _state(1.0))
+    first_writer = mgr._writer
+    mgr.save(2, _state(2.0))       # blocks until save 1 is durable
+    assert not first_writer.is_alive()
+    assert mgr.latest_step() in (1, 2)   # 1 definitely durable; 2 racing
+    mgr.wait()
+    assert mgr.latest_step() == 2
+    assert sum(t.name == "pt-ckpt-writer"
+               for t in _threading.enumerate() if t.is_alive()) == 0
+
+
 # ------------------------------------------------------ elastic end-to-end
 
 def test_elastic_survives_kill_during_save(tmp_path):
