@@ -138,7 +138,8 @@ class DataLoader:
             finally:
                 q.put(_END)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, name="pt-dataloader",
+                             daemon=True)
         t.start()
         while True:
             item = q.get()

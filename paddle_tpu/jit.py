@@ -16,11 +16,21 @@ import jax
 
 
 def jit(fn: Callable = None, *, static_argnums=None, static_argnames=None,
-        donate_argnums=None, device=None) -> Callable:
+        donate_argnums=None, device=None, instrument: bool = False,
+        name: str = None) -> Callable:
     if fn is None:
         return functools.partial(jit, static_argnums=static_argnums,
                                  static_argnames=static_argnames,
-                                 donate_argnums=donate_argnums, device=device)
+                                 donate_argnums=donate_argnums, device=device,
+                                 instrument=instrument, name=name)
+    if instrument:
+        # compile introspection (ISSUE 4): trace/lower/compile spans,
+        # compile_seconds histogram, cache hit/miss counters
+        from paddle_tpu.observability.compile import instrumented_jit
+        return instrumented_jit(fn, name=name,
+                                static_argnums=static_argnums,
+                                static_argnames=static_argnames,
+                                donate_argnums=donate_argnums)
     return jax.jit(fn, static_argnums=static_argnums, static_argnames=static_argnames,
                    donate_argnums=donate_argnums)
 

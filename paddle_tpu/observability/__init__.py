@@ -16,6 +16,21 @@ Three layers, all host-side and CPU-safe:
 Built-in instrumentation (serving engine, Trainer, checkpoints, elastic
 restarts, collectives, fault injection) emits through these singletons;
 ``metrics_snapshot()``/``dump()`` give a one-call export of everything.
+
+The second layer (ISSUE 4) turns the registry into an operable
+telemetry pipeline:
+
+  * :mod:`paddle_tpu.observability.flight` — :data:`FLIGHT`, the
+    bounded ring of structured runtime events, atomically dumped to
+    ``flight_<step>.json`` on crash/give-up/watchdog trip.
+  * :mod:`paddle_tpu.observability.compile` — :func:`instrumented_jit`,
+    compile spans + cache hit/miss counters + cost_analysis FLOPs.
+  * :mod:`paddle_tpu.observability.shipper` — the ``pt-metrics-shipper``
+    thread appending registry snapshots (with deltas) to a rotating
+    JSONL ring on disk.
+  * :mod:`paddle_tpu.observability.health` — :data:`HEALTH`, declarative
+    OK/WARN/CRIT rules served at ``/healthz`` (with ``/flight``) by the
+    metrics HTTP server.
 """
 from __future__ import annotations
 
@@ -29,6 +44,14 @@ from paddle_tpu.observability.flops import (PEAK_BF16, chip_peak_flops, mfu,
 from paddle_tpu.observability.httpd import (MetricsServer,
                                             start_metrics_server,
                                             stop_metrics_server)
+from paddle_tpu.observability.flight import FLIGHT, FlightRecorder
+from paddle_tpu.observability.compile import InstrumentedJit, instrumented_jit
+from paddle_tpu.observability.shipper import (MetricsShipper,
+                                              start_metrics_shipper,
+                                              stop_metrics_shipper)
+from paddle_tpu.observability.health import (HEALTH, HealthEvaluator,
+                                             HealthRule,
+                                             install_default_rules)
 
 __all__ = [
     "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -36,6 +59,10 @@ __all__ = [
     "TRACER", "Tracer", "span", "instant", "export_chrome_trace",
     "PEAK_BF16", "chip_peak_flops", "mfu", "record_throughput",
     "MetricsServer", "start_metrics_server", "stop_metrics_server",
+    "FLIGHT", "FlightRecorder",
+    "InstrumentedJit", "instrumented_jit",
+    "MetricsShipper", "start_metrics_shipper", "stop_metrics_shipper",
+    "HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
     "enable", "disable", "metrics_snapshot", "dump",
 ]
 

@@ -55,15 +55,35 @@ _TOKENS_PER_SEC = METRICS.gauge(
     "train_tokens_per_sec", "training throughput, tokens/sec")
 _MFU = METRICS.gauge(
     "train_mfu", "model FLOPs utilisation vs the chip peak-bf16 table")
+_MFU_OVERLAP = METRICS.gauge(
+    "train_mfu_overlap", "MFU with host time hidden behind in-flight "
+    "device steps subtracted from the wall-clock denominator")
 
 
 def record_throughput(tokens_per_sec: float, flops_per_token: float = 0.0,
-                      peak_flops: float = 0.0) -> float:
+                      peak_flops: float = 0.0, hidden_host_s: float = 0.0,
+                      window_s: float = 0.0) -> float:
     """Single choke point for throughput/MFU accounting: computes MFU
     from the shared table's peak, sets the ``train_tokens_per_sec`` and
-    ``train_mfu`` gauges, returns the MFU. Trainer, StepTimer, and
-    bench.py all land here — there is exactly one FLOPs model."""
+    ``train_mfu`` gauges, returns the (naive) MFU. Trainer, StepTimer,
+    and bench.py all land here — there is exactly one FLOPs model.
+
+    ``hidden_host_s``/``window_s`` enable the overlap-aware variant
+    (ROADMAP leftover): the pipelined trainer measures how much host
+    input/dispatch time rode in the shadow of in-flight device steps
+    during the ``window_s``-second logging window; that time belongs to
+    neither the device nor the critical path, so the overlap-aware MFU
+    removes it from the denominator —
+    ``mfu(tps * window / (window - hidden), ...)``. With no overlap
+    information (sync loop, StepTimer, bench baseline) the overlap gauge
+    mirrors the naive value, so the two series are always comparable."""
     m = mfu(tokens_per_sec, flops_per_token, peak_flops)
+    if window_s > 0.0 and 0.0 < hidden_host_s < window_s:
+        m_ov = mfu(tokens_per_sec * window_s / (window_s - hidden_host_s),
+                   flops_per_token, peak_flops)
+    else:
+        m_ov = m
     _TOKENS_PER_SEC.set(tokens_per_sec)
     _MFU.set(m)
+    _MFU_OVERLAP.set(m_ov)
     return m

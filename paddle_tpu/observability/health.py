@@ -1,0 +1,189 @@
+"""Health/SLO evaluator (ISSUE 4): declarative rules over the registry.
+
+The registry answers "how many"; operators need "is it healthy". A
+:class:`HealthRule` names one scalar derived from registry values (a
+counter ratio, a histogram quantile, a gauge) plus WARN/CRIT thresholds;
+:class:`HealthEvaluator.evaluate` runs every rule and folds the per-rule
+statuses into one overall ``OK``/``WARN``/``CRIT`` — what ``/healthz``
+on :mod:`paddle_tpu.observability.httpd` serves (HTTP 503 on CRIT, so a
+dumb TCP health checker needs zero JSON parsing).
+
+Rules are *greater-is-worse*: value >= crit → CRIT, >= warn → WARN.
+A rule with no data yet (empty histogram → NaN quantile, zero-count
+ratio) reports OK — absence of traffic is not an incident. Getters
+never raise out of ``evaluate``: a getter that throws marks its rule
+CRIT with the error attached (a broken health probe IS unhealthy).
+
+The module-global :data:`HEALTH` ships with the default rule set
+(:func:`install_default_rules`): NaN-skip rate, serving queue-wait p95,
+prefetch stall ratio, checkpoint CRC failures, elastic restart count.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+from paddle_tpu.observability.metrics import METRICS, Histogram
+
+__all__ = ["HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
+           "counter_value", "gauge_value", "counter_ratio",
+           "histogram_quantile", "histogram_sum_ratio"]
+
+_ORDER = {"OK": 0, "WARN": 1, "CRIT": 2}
+
+
+# ------------------------------------------------------------ getter factories
+def _series_total(inst) -> float:
+    """Sum of every label series of a counter/gauge (0.0 when absent)."""
+    if inst is None:
+        return 0.0
+    return float(sum(cell[0] for cell in inst._series.values()))
+
+
+def counter_value(name: str, registry=None) -> Callable[[], float]:
+    """Current value of a counter, summed across label series."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        return _series_total(reg.get(name))
+    return get
+
+
+gauge_value = counter_value      # same read path for gauges
+
+
+def counter_ratio(num: str, den: str, registry=None) -> Callable[[], float]:
+    """num/den over two counters; 0.0 while the denominator is zero."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        d = _series_total(reg.get(den))
+        return _series_total(reg.get(num)) / d if d else 0.0
+    return get
+
+
+def histogram_quantile(name: str, q: float,
+                       registry=None) -> Callable[[], float]:
+    """q-quantile of an unlabeled histogram; NaN while empty/absent."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        h = reg.get(name)
+        if not isinstance(h, Histogram):
+            return float("nan")
+        return h.quantile(q)
+    return get
+
+
+def histogram_sum_ratio(num: str, den: str,
+                        registry=None) -> Callable[[], float]:
+    """sum(num histogram) / sum(den histogram) — e.g. seconds stalled in
+    prefetch per second spent stepping; 0.0 while the denominator is 0."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        def hsum(n):
+            h = reg.get(n)
+            if not isinstance(h, Histogram):
+                return 0.0
+            return float(sum(s.sum for s in h._series.values()))
+        d = hsum(den)
+        return hsum(num) / d if d else 0.0
+    return get
+
+
+# --------------------------------------------------------------------- rules
+class HealthRule:
+    """One named scalar + WARN/CRIT thresholds (greater is worse)."""
+
+    def __init__(self, name: str, getter: Callable[[], float],
+                 warn: float, crit: float, description: str = ""):
+        if crit < warn:
+            raise ValueError(
+                f"rule {name!r}: crit ({crit}) must be >= warn ({warn})")
+        self.name = name
+        self.getter = getter
+        self.warn = warn
+        self.crit = crit
+        self.description = description
+
+    def evaluate(self) -> dict:
+        try:
+            v = float(self.getter())
+        except Exception as e:        # a broken probe IS unhealthy
+            return {"name": self.name, "value": None, "status": "CRIT",
+                    "warn": self.warn, "crit": self.crit,
+                    "error": f"{type(e).__name__}: {e}"}
+        if math.isnan(v):             # no data yet — not an incident
+            status, v_out = "OK", None
+        elif v >= self.crit:
+            status, v_out = "CRIT", v
+        elif v >= self.warn:
+            status, v_out = "WARN", v
+        else:
+            status, v_out = "OK", v
+        return {"name": self.name, "value": v_out, "status": status,
+                "warn": self.warn, "crit": self.crit}
+
+
+class HealthEvaluator:
+    """An ordered rule list + one ``evaluate()`` fold."""
+
+    def __init__(self, rules: Optional[List[HealthRule]] = None):
+        self.rules: List[HealthRule] = list(rules or [])
+
+    def add_rule(self, rule: HealthRule) -> HealthRule:
+        """Add (or replace, by name) one rule."""
+        self.rules = [r for r in self.rules if r.name != rule.name]
+        self.rules.append(rule)
+        return rule
+
+    def rule(self, name: str, getter, warn: float, crit: float,
+             description: str = "") -> HealthRule:
+        return self.add_rule(HealthRule(name, getter, warn, crit,
+                                        description))
+
+    def remove_rule(self, name: str):
+        self.rules = [r for r in self.rules if r.name != name]
+
+    def clear(self):
+        self.rules = []
+
+    def evaluate(self) -> dict:
+        """{"status": worst-of-rules, "rules": [per-rule dicts]}.
+        No rules installed → OK (an unconfigured probe must not page)."""
+        results = [r.evaluate() for r in self.rules]
+        worst = max((r["status"] for r in results),
+                    key=_ORDER.__getitem__, default="OK")
+        return {"status": worst, "rules": results}
+
+
+def install_default_rules(ev: HealthEvaluator,
+                          registry=None) -> HealthEvaluator:
+    """The stock rule set. Thresholds are deliberately loose — they flag
+    "clearly on fire", not "worth a look"; tighten per deployment via
+    ``HEALTH.rule(...)`` (same name replaces)."""
+    ev.rule("nan_skip_rate",
+            counter_ratio("train_nan_skips_total", "train_steps_total",
+                          registry),
+            warn=0.05, crit=0.25,
+            description="fraction of optimizer steps skipped on "
+                        "non-finite loss")
+    ev.rule("serving_queue_wait_p95_s",
+            histogram_quantile("serving_queue_wait_seconds", 0.95, registry),
+            warn=1.0, crit=5.0,
+            description="p95 submission→admission wait")
+    ev.rule("prefetch_stall_ratio",
+            histogram_sum_ratio("io_prefetch_stall_seconds",
+                                "train_step_seconds", registry),
+            warn=0.2, crit=0.5,
+            description="host seconds stalled waiting on the input "
+                        "pipeline per second of stepping")
+    ev.rule("ckpt_crc_failures",
+            counter_value("ckpt_crc_failures_total", registry),
+            warn=1, crit=3,
+            description="array CRC mismatches caught on checkpoint load")
+    ev.rule("elastic_restarts",
+            counter_value("elastic_restarts_total", registry),
+            warn=1, crit=3,
+            description="elastic restarts taken after failures")
+    return ev
+
+
+HEALTH = install_default_rules(HealthEvaluator())

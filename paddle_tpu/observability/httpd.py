@@ -5,6 +5,9 @@ process-global registry the way a Prometheus scraper expects:
 
   * ``GET /metrics``       → text exposition format 0.0.4
   * ``GET /metrics.json``  → the one-line JSON snapshot
+  * ``GET /healthz``       → HEALTH.evaluate() JSON; HTTP 503 on CRIT so
+    a TCP/status-code health checker needs zero JSON parsing
+  * ``GET /flight``        → the flight recorder's current ring as JSON
   * anything else          → 404
 
 Usage::
@@ -21,6 +24,7 @@ two lines and not hold a handle. The serving thread is named
 """
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -35,16 +39,33 @@ _PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server API
         path = self.path.split("?", 1)[0]
+        status = 200
         if path == "/metrics":
             body = METRICS.to_prometheus().encode()
             ctype = _PROM_CTYPE
         elif path == "/metrics.json":
             body = (METRICS.to_json() + "\n").encode()
             ctype = "application/json"
+        elif path == "/healthz":
+            from paddle_tpu.observability.health import HEALTH
+            report = HEALTH.evaluate()
+            if report["status"] == "CRIT":
+                status = 503
+            body = (json.dumps(report, sort_keys=True) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/flight":
+            from paddle_tpu.observability.flight import FLIGHT
+            doc = {"last_step": FLIGHT.last_step,
+                   "capacity": FLIGHT.capacity,
+                   "total_recorded": FLIGHT.total_recorded,
+                   "events": FLIGHT.events()}
+            body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+            ctype = "application/json"
         else:
-            self.send_error(404, "try /metrics or /metrics.json")
+            self.send_error(
+                404, "try /metrics, /metrics.json, /healthz or /flight")
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

@@ -228,6 +228,32 @@ class Histogram(_Instrument):
         """{"buckets": {le: cumulative}, "sum", "count"} for one series."""
         return self._value_key(self._key(labels))
 
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated q-quantile (Prometheus ``histogram_quantile``
+        semantics): linear interpolation inside the bucket containing
+        rank ``q*count``, assuming observations spread uniformly within
+        it. Rank landing in the +Inf bucket returns the highest finite
+        bound; an empty (or never-observed) series returns NaN."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"{self.name}: quantile q must be in [0, 1], "
+                             f"got {q}")
+        s = self._series.get(self._key(labels))
+        if s is None:
+            return float("nan")
+        with self._lock:
+            counts, count = list(s.counts), s.count
+        if count == 0:
+            return float("nan")
+        rank = q * count
+        cum = 0.0
+        for i, bound in enumerate(self.buckets):
+            prev_cum = cum
+            cum += counts[i]
+            if cum >= rank and counts[i] > 0:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                return lo + (bound - lo) * ((rank - prev_cum) / counts[i])
+        return self.buckets[-1]
+
     def _value_key(self, key: tuple):
         return self._snapshot_series(self._series.get(
             key, _HistSeries(len(self.buckets))))

@@ -37,6 +37,7 @@ from paddle_tpu.models.paged import (PagedKVCache, PrefixCachingBlockManager,
                                      _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
                                      _PREFILL_JIT, _TICK_JIT)
 from paddle_tpu.observability import METRICS, span as _span
+from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.utils.faults import fault_point
 
 # module-level so its compile cache persists across admissions
@@ -401,6 +402,8 @@ class LLMEngine:
         self.stats["timeouts" if reason == "timeout" else "cancelled"] += 1
         (_TIMEOUTS if reason == "timeout" else _CANCELLED).inc()
         _FINISHED.inc(reason=reason)
+        FLIGHT.record("serving.timeout" if reason == "timeout"
+                      else "serving.cancel", rid=req_id)
         return True
 
     def _expire(self):
@@ -951,6 +954,8 @@ class LLMEngine:
         self.queue.appendleft(req)
         self.stats["preemptions"] += 1
         _PREEMPTED.inc()
+        FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
+                      phase="prefill")
         return True
 
     def _preempt_from(self, cand) -> bool:
@@ -978,6 +983,8 @@ class LLMEngine:
         self.queue.appendleft(req)
         self.stats["preemptions"] += 1
         _PREEMPTED.inc()
+        FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
+                      phase="decode")
         return True
 
     def _allocate_or_preempt(self, rid: int, n_tokens: int, protect=None):

@@ -37,6 +37,7 @@ import numpy as np
 
 from paddle_tpu.core.module import Module, _path_to_str
 from paddle_tpu.observability import METRICS, span as _span
+from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.utils.faults import fault_point
 
 # Checkpoint telemetry (ISSUE 2): durations/bytes of successful saves
@@ -252,11 +253,24 @@ class CheckpointManager:
 
     def save(self, step: int, state) -> None:
         if self.use_orbax:
+            # same instruments as the native path (ROADMAP leftover) —
+            # an operator must not lose ckpt telemetry by switching
+            # backends. Import stays inside the branch: tier-1 is
+            # orbax-free.
             import orbax.checkpoint as ocp
-            self._mgr.save(step, args=ocp.args.StandardSave(
-                jax.tree_util.tree_map(np.asarray, state,
-                                       is_leaf=lambda x: x is None)))
-            self._mgr.wait_until_finished()
+            t0 = time.monotonic()
+            with _span("ckpt.save", backend="orbax", step=step):
+                self._mgr.save(step, args=ocp.args.StandardSave(
+                    jax.tree_util.tree_map(np.asarray, state,
+                                           is_leaf=lambda x: x is None)))
+                self._mgr.wait_until_finished()
+            _CKPT_SAVES.inc()
+            _CKPT_SAVE_S.observe(time.monotonic() - t0)
+            nbytes = self._orbax_step_bytes(step)
+            if nbytes:
+                _CKPT_BYTES.inc(nbytes)
+                _CKPT_LAST_BYTES.set(nbytes)
+            FLIGHT.record("ckpt.save", step=step, backend="orbax")
             return
         if self.async_save:
             return self._save_async(step, state)
@@ -265,6 +279,18 @@ class CheckpointManager:
         # line leaves ``latest`` on the previous good checkpoint
         self._write_latest(step)
         self._gc()
+        FLIGHT.record("ckpt.save", step=step)
+
+    def _orbax_step_bytes(self, step: int) -> int:
+        """On-disk size of one orbax step directory (0 when the layout
+        is not where we expect it — size is advisory telemetry only)."""
+        try:
+            d = self.dir / str(step)
+            if not d.is_dir():
+                return 0
+            return sum(p.stat().st_size for p in d.rglob("*") if p.is_file())
+        except OSError:
+            return 0
 
     def _save_async(self, step: int, state) -> None:
         # one save in flight, ever: a prior writer finishes (and its
@@ -288,6 +314,7 @@ class CheckpointManager:
                 # on the previous good checkpoint
                 self._write_latest(step)
                 self._gc()
+                FLIGHT.record("ckpt.save", step=step, mode="async")
             except BaseException as e:   # surfaced by wait()/next save()
                 self._writer_exc = e
             finally:
@@ -338,16 +365,23 @@ class CheckpointManager:
             if step is None:
                 return None
             import orbax.checkpoint as ocp
-            restored = self._mgr.restore(step, args=ocp.args.StandardRestore(
-                jax.tree_util.tree_map(np.asarray, state_like,
-                                       is_leaf=lambda x: x is None)))
+            t0 = time.monotonic()
+            with _span("ckpt.restore", backend="orbax", step=step):
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(
+                        jax.tree_util.tree_map(
+                            np.asarray, state_like,
+                            is_leaf=lambda x: x is None)))
             flat_new = jax.tree_util.tree_leaves(restored, is_leaf=lambda x: x is None)
             _, treedef = jax.tree_util.tree_flatten(state_like, is_leaf=lambda x: x is None)
             self.last_restored_step = step
-            return jax.tree_util.tree_unflatten(treedef, [
+            out = jax.tree_util.tree_unflatten(treedef, [
                 jnp.asarray(n, dtype=o.dtype) if isinstance(o, (jax.Array, np.ndarray)) else n
                 for n, o in zip(flat_new, jax.tree_util.tree_leaves(
                     state_like, is_leaf=lambda x: x is None))])
+            _CKPT_RESTORES.inc()
+            _CKPT_RESTORE_S.observe(time.monotonic() - t0)
+            return out
         if step is not None:
             # explicit step: strict — restoring some OTHER step than the
             # one asked for would be silent time-travel

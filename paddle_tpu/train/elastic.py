@@ -14,6 +14,7 @@ from typing import Callable, Optional
 
 
 from paddle_tpu.observability import METRICS, instant as _trace_instant
+from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.utils.watchdog import StallWatchdog, WatchdogTrip
 
 __all__ = ["ElasticRunner", "run_elastic"]
@@ -71,8 +72,13 @@ class ElasticRunner:
                 _RESTARTS.inc()
                 _trace_instant("elastic.restart", restart=self.restarts,
                                cause=type(e).__name__)
+                FLIGHT.record("elastic.restart", restart=self.restarts,
+                              cause=type(e).__name__)
                 if self.restarts > self.max_restarts:
                     _GIVEUPS.inc()
+                    FLIGHT.record("elastic.giveup", restarts=self.restarts,
+                                  cause=type(e).__name__)
+                    FLIGHT.dump(reason="elastic.giveup")
                     raise RuntimeError(
                         f"elastic: gave up after {self.max_restarts} restarts; "
                         f"failures={self.failures}") from e

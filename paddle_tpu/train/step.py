@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
 from paddle_tpu.distributed.mesh import HybridMesh
 from paddle_tpu.distributed.sharded import partition_specs, shard_module
+from paddle_tpu.observability.compile import instrumented_jit
 
 
 @jax.tree_util.register_pytree_node_class
@@ -53,7 +54,8 @@ def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[HybridMesh] = N
         model, opt_state = optimizer.step(state.model, grads, state.opt_state)
         return TrainState(model, opt_state, rng), loss
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return instrumented_jit(step, name="train.step",
+                            donate_argnums=(0,) if donate else ())
 
 
 def init_state(model: Module, optimizer, mesh: Optional[HybridMesh] = None,

@@ -28,6 +28,8 @@ import numpy as np
 
 from paddle_tpu.core.module import Module, combine, partition_trainable, value_and_grad
 from paddle_tpu.observability import METRICS, span as _span
+from paddle_tpu.observability.compile import instrumented_jit
+from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.observability.flops import record_throughput
 from paddle_tpu.train.checkpoint import CheckpointManager
 from paddle_tpu.train.step import TrainState, init_state
@@ -131,7 +133,10 @@ class Trainer:
                     new_opt, state.opt_state, is_leaf=lambda x: x is None)
             return TrainState(new_model, new_opt, state.rng), loss
 
-        return jax.jit(step, donate_argnums=(0,))
+        # compile introspection (ISSUE 4): spans + compile_seconds +
+        # cache hit/miss counters, and cost_analysis FLOPs that back the
+        # MFU gauges when no analytic flops_per_token was configured
+        return instrumented_jit(step, name="train.step", donate_argnums=(0,))
 
     def resume(self):
         mgr = CheckpointManager(self.args.ckpt_dir)
@@ -141,9 +146,30 @@ class Trainer:
         return self
 
     def fit(self, data_iter, eval_fn: Optional[Callable] = None):
-        if self.args.pipeline_depth > 0:
-            return self._fit_pipelined(data_iter, eval_fn)
-        return self._fit_sync(data_iter, eval_fn)
+        try:
+            if self.args.pipeline_depth > 0:
+                return self._fit_pipelined(data_iter, eval_fn)
+            return self._fit_sync(data_iter, eval_fn)
+        except BaseException as e:
+            # last event of a dead run; the dump is a no-op unless a
+            # flight dir is configured (PT_FLIGHT_DIR / FLIGHT.dir). No
+            # int(state.step) here — syncing a poisoned device state in
+            # a crash path can hang; FLIGHT already tracks last_step.
+            FLIGHT.record("train.crash",
+                          error=f"{type(e).__name__}: {e}")
+            FLIGHT.dump(reason=f"train.crash:{type(e).__name__}")
+            raise
+
+    def _flops_per_token(self, steps: int, tokens: int) -> float:
+        """Analytic FLOPs model when configured, else derived from the
+        newest XLA cost_analysis estimate of the instrumented step
+        (flops-per-call × steps ÷ tokens over the logging window)."""
+        if self.args.flops_per_token:
+            return self.args.flops_per_token
+        fpc = getattr(self._step_fn, "flops_per_call", 0.0)
+        if fpc and steps and tokens:
+            return fpc * steps / tokens
+        return 0.0
 
     def _fit_sync(self, data_iter, eval_fn: Optional[Callable] = None):
         args = self.args
@@ -152,6 +178,7 @@ class Trainer:
         accum = args.grad_accum_steps
         t_last = time.perf_counter()
         tokens_since = 0
+        steps_since = 0
         start_step = int(self.state.step)
         if start_step >= args.max_steps:
             return self.state       # already done — consume nothing
@@ -183,6 +210,7 @@ class Trainer:
             _STEP_S.observe(time.monotonic() - t_step)
             _STEPS.inc()
             _LOSS.set(loss_val)
+            FLIGHT.record("train.step", step=step_no, loss=loss_val)
 
             if args.nan_guard:
                 if not np.isfinite(loss_val):
@@ -192,20 +220,27 @@ class Trainer:
                     self._bad_steps += 1
                     self.stats["nan_skips"] += 1
                     _NAN_SKIPS.inc()
+                    FLIGHT.record("train.nan_skip", step=step_no,
+                                  streak=self._bad_steps)
                     self.stats["bad_streak_max"] = max(
                         self.stats["bad_streak_max"], self._bad_steps)
                     if self._bad_steps >= args.max_bad_steps:
                         from paddle_tpu.utils.watchdog import WatchdogTrip
+                        FLIGHT.record("train.giveup", step=step_no,
+                                      streak=self._bad_steps)
                         raise WatchdogTrip(
                             f"{self._bad_steps} consecutive non-finite losses")
                     if args.nan_backoff_s > 0:
                         _NAN_BACKOFF.inc()
+                        FLIGHT.record("train.nan_backoff", step=step_no,
+                                      streak=self._bad_steps)
                         time.sleep(min(
                             args.nan_backoff_s * 2 ** (self._bad_steps - 1),
                             args.nan_backoff_cap_s))
                 else:
                     self._bad_steps = 0
 
+            steps_since += 1
             tokens_since += sum(int(np.prod(b[0].shape[:2])) for b in micro
                                 if hasattr(b[0], "shape") and b[0].ndim >= 2)
             if args.log_every and step_no % args.log_every == 0:
@@ -214,17 +249,17 @@ class Trainer:
                 rec = {"step": step_no, "loss": loss_val,
                        "steps_per_sec": args.log_every / dt if dt > 0 else 0.0,
                        "lr": self.optimizer.get_lr(self.state.opt_state)}
-                if args.flops_per_token and tokens_since and dt > 0:
+                fpt = self._flops_per_token(steps_since, tokens_since)
+                if fpt and tokens_since and dt > 0:
                     rec["tokens_per_sec"] = tokens_since / dt
                     # one MFU model for trainer, StepTimer, and bench.py:
                     # the shared gauges in observability.flops
                     rec["mfu"] = record_throughput(
-                        tokens_since / dt, args.flops_per_token,
-                        args.peak_flops)
+                        tokens_since / dt, fpt, args.peak_flops)
                 self.history.append(rec)
                 for h in self.hooks:
                     h(rec)
-                t_last, tokens_since = now, 0
+                t_last, tokens_since, steps_since = now, 0, 0
             if mgr and step_no % args.ckpt_every == 0:
                 mgr.save(step_no, self.state)
             if eval_fn and args.log_every and step_no % (args.log_every * 10) == 0:
@@ -264,6 +299,11 @@ class Trainer:
         last_loss = float("nan")
         t_last = time.perf_counter()
         tokens_since = 0
+        steps_since = 0
+        # host input/dispatch seconds that rode in the shadow of in-flight
+        # device steps this logging window — the overlap-aware MFU
+        # (ROADMAP leftover) subtracts them from the wall-clock window
+        hidden_host_s = 0.0
         boundary_done = start_step   # last step boundary actions ran for
 
         def is_boundary(s: int) -> bool:
@@ -275,7 +315,7 @@ class Trainer:
                         and s % (args.log_every * 10) == 0))
 
         def drain_one():
-            nonlocal drained, last_loss, tokens_since
+            nonlocal drained, last_loss, tokens_since, steps_since
             loss, t_disp, ntok = window.popleft()
             with _span("train.drain", step=drained + 1,
                        inflight=len(window) + 1):
@@ -291,21 +331,29 @@ class Trainer:
             _STEP_S.observe(time.monotonic() - t_disp)
             _STEPS.inc()
             _LOSS.set(loss_val)
+            FLIGHT.record("train.step", step=step_no, loss=loss_val)
             last_loss = loss_val
             tokens_since += ntok
+            steps_since += 1
             if args.nan_guard:
                 if not np.isfinite(loss_val):
                     self._bad_steps += 1
                     self.stats["nan_skips"] += 1
                     _NAN_SKIPS.inc()
+                    FLIGHT.record("train.nan_skip", step=step_no,
+                                  streak=self._bad_steps)
                     self.stats["bad_streak_max"] = max(
                         self.stats["bad_streak_max"], self._bad_steps)
                     if self._bad_steps >= args.max_bad_steps:
                         from paddle_tpu.utils.watchdog import WatchdogTrip
+                        FLIGHT.record("train.giveup", step=step_no,
+                                      streak=self._bad_steps)
                         raise WatchdogTrip(
                             f"{self._bad_steps} consecutive non-finite losses")
                     if args.nan_backoff_s > 0:
                         _NAN_BACKOFF.inc()
+                        FLIGHT.record("train.nan_backoff", step=step_no,
+                                      streak=self._bad_steps)
                         time.sleep(min(
                             args.nan_backoff_s * 2 ** (self._bad_steps - 1),
                             args.nan_backoff_cap_s))
@@ -315,7 +363,8 @@ class Trainer:
         def run_boundaries():
             """Log/ckpt/eval for the (fully drained) current step — same
             order and conditions as the synchronous loop."""
-            nonlocal t_last, tokens_since, boundary_done
+            nonlocal t_last, tokens_since, steps_since, hidden_host_s, \
+                boundary_done
             step_no = drained
             if step_no <= boundary_done:
                 return
@@ -326,15 +375,17 @@ class Trainer:
                 rec = {"step": step_no, "loss": last_loss,
                        "steps_per_sec": args.log_every / dt if dt > 0 else 0.0,
                        "lr": self.optimizer.get_lr(self.state.opt_state)}
-                if args.flops_per_token and tokens_since and dt > 0:
+                fpt = self._flops_per_token(steps_since, tokens_since)
+                if fpt and tokens_since and dt > 0:
                     rec["tokens_per_sec"] = tokens_since / dt
                     rec["mfu"] = record_throughput(
-                        tokens_since / dt, args.flops_per_token,
-                        args.peak_flops)
+                        tokens_since / dt, fpt, args.peak_flops,
+                        hidden_host_s=hidden_host_s, window_s=dt)
                 self.history.append(rec)
                 for h in self.hooks:
                     h(rec)
-                t_last, tokens_since = now, 0
+                t_last, tokens_since, steps_since = now, 0, 0
+                hidden_host_s = 0.0
             if mgr and step_no % args.ckpt_every == 0:
                 # the window is empty: self.state IS step `step_no`
                 mgr.save(step_no, self.state)
@@ -348,10 +399,15 @@ class Trainer:
             # prediction replaces int(state.step), which would sync
             fault_point("train.step", step=drained + len(window),
                         trainer=self)
+            in_flight_before = len(window)
             t_disp = time.monotonic()
             with _span("train.step", step=drained + len(window)):
                 micro = [self._to_batch(next(it)) for _ in range(accum)]
                 self.state, loss = self._step_fn(self.state, *micro)
+            if in_flight_before > 0:
+                # host input/dispatch time spent while device steps were
+                # already executing — hidden from the critical path
+                hidden_host_s += time.monotonic() - t_disp
             ntok = sum(int(np.prod(b[0].shape[:2])) for b in micro
                        if hasattr(b[0], "shape") and b[0].ndim >= 2)
             window.append((loss, t_disp, ntok))

@@ -44,6 +44,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from paddle_tpu.observability import METRICS, instant as _trace_instant
+from paddle_tpu.observability.flight import FLIGHT
 
 # chaos runs are self-describing: every firing increments this counter
 # (labelled by site) and drops an instant event on the trace timeline
@@ -179,6 +180,7 @@ class FaultRegistry:
                 self.log.append((site, hit))
                 _INJECTED.inc(site=site)
                 _trace_instant(f"fault:{site}", hit=hit)
+                FLIGHT.record("fault", site=site, hit=hit)
                 out = rule.fire(ctx)
         return out
 

@@ -32,6 +32,13 @@ _STEPTIMER_S = METRICS.histogram(
 _DEV_MEM = METRICS.gauge(
     "device_bytes_in_use", "per-device bytes in use (0 when the backend "
     "does not report memory stats)", labelnames=("device",))
+_DEV_MEM_PEAK = METRICS.gauge(
+    "device_bytes_peak", "per-device peak bytes in use (0 when the "
+    "backend does not report memory stats)", labelnames=("device",))
+_DEV_MEM_LIMIT = METRICS.gauge(
+    "device_bytes_limit", "per-device memory capacity visible to the "
+    "allocator (0 when the backend does not report it)",
+    labelnames=("device",))
 
 
 class Profiler:
@@ -112,6 +119,8 @@ def device_memory_stats() -> dict:
                    "peak_bytes_in_use": 0, "bytes_limit": 0}
         out[str(d)] = rec
         _DEV_MEM.set(rec["bytes_in_use"] or 0, device=str(d))
+        _DEV_MEM_PEAK.set(rec["peak_bytes_in_use"] or 0, device=str(d))
+        _DEV_MEM_LIMIT.set(rec["bytes_limit"] or 0, device=str(d))
     return out
 
 

@@ -46,6 +46,15 @@ class StallWatchdog:
         while not self._stop.wait(min(self.timeout_s / 4, 30.0)):
             if time.monotonic() - self._last_poke > self.timeout_s:
                 self._tripped.set()
+                # the trip is detected on THIS thread — record + dump
+                # here so a hung main thread (the very thing a watchdog
+                # exists for) still leaves its flight file behind
+                try:
+                    from paddle_tpu.observability.flight import FLIGHT
+                    FLIGHT.record("watchdog.trip", timeout_s=self.timeout_s)
+                    FLIGHT.dump(reason="watchdog.trip")
+                except Exception:
+                    pass
                 if self.on_trip:
                     try:
                         self.on_trip()

@@ -67,14 +67,25 @@ def _no_leaked_threads():
 @pytest.fixture(autouse=True)
 def _clear_observability():
     """Telemetry hygiene: every test starts with zeroed metric series,
-    an empty span buffer, and the tracer disabled (its default)."""
-    from paddle_tpu.observability import METRICS, TRACER
+    an empty span buffer, the tracer disabled (its default), and an
+    empty flight-recorder ring with NO dump directory — a chaos test
+    that crashes a trainer must not scatter flight_*.json into the
+    repo. Tests that want dumps set FLIGHT.dir (or pass directory=)
+    themselves; capacity/dir are restored afterwards either way."""
+    from paddle_tpu.observability import FLIGHT, METRICS, TRACER
     METRICS.reset()
     METRICS.enable()
     TRACER.disable()
     TRACER.clear()
+    FLIGHT.clear()
+    saved_dir, saved_cap = FLIGHT.dir, FLIGHT.capacity
+    FLIGHT.dir = None
     yield
     METRICS.reset()
     METRICS.enable()
     TRACER.disable()
     TRACER.clear()
+    FLIGHT.clear()
+    FLIGHT.dir = saved_dir
+    if FLIGHT.capacity != saved_cap:
+        FLIGHT.set_capacity(saved_cap)

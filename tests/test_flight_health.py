@@ -1,0 +1,388 @@
+"""ISSUE 4 surfaces: flight recorder, compile introspection, metrics
+shipper, health/SLO evaluator, overlap-aware MFU, /healthz + /flight."""
+import json
+import math
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.observability import (
+    FLIGHT,
+    HEALTH,
+    METRICS,
+    TRACER,
+    FlightRecorder,
+    HealthEvaluator,
+    HealthRule,
+    MetricsServer,
+    MetricsShipper,
+    install_default_rules,
+    instrumented_jit,
+)
+from paddle_tpu.observability.flops import record_throughput
+from paddle_tpu.observability.health import (
+    counter_ratio,
+    counter_value,
+    histogram_quantile,
+)
+from paddle_tpu.train.trainer import Trainer, TrainerArgs
+from paddle_tpu.utils.watchdog import WatchdogTrip
+
+
+def _http_get(url):
+    """(status, parsed-json body) — 503 arrives as HTTPError, same body."""
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_ring_bounds_and_orders():
+    fr = FlightRecorder(capacity=4, directory=None)
+    for i in range(10):
+        fr.record("tick", step=i)
+    evs = fr.events()
+    assert len(evs) == 4                       # ring bounded
+    assert [e["step"] for e in evs] == [6, 7, 8, 9]   # newest kept, in order
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    assert fr.total_recorded == 10
+    assert fr.last_step == 9
+    assert all(e["kind"] == "tick" for e in evs)
+
+
+def test_flight_set_capacity_keeps_newest():
+    fr = FlightRecorder(capacity=8, directory=None)
+    for i in range(6):
+        fr.record("tick", step=i)
+    fr.set_capacity(2)
+    assert fr.capacity == 2
+    assert [e["step"] for e in fr.events()] == [4, 5]
+    with pytest.raises(ValueError):
+        fr.set_capacity(0)
+
+
+def test_flight_dump_atomic_and_parseable(tmp_path):
+    fr = FlightRecorder(capacity=4, directory=str(tmp_path))
+    for i in range(7):
+        fr.record("train.step", step=i, loss=float(i))
+    path = fr.dump(reason="unit")
+    assert path == str(tmp_path / "flight_00000006.json")
+    assert not list(tmp_path.glob("*.tmp"))    # atomic: no partial left
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "unit"
+    assert doc["last_step"] == 6
+    assert doc["total_recorded"] == 7
+    assert doc["dropped"] == 3                 # 7 recorded, ring of 4
+    assert [e["step"] for e in doc["events"]] == [3, 4, 5, 6]
+    assert fr.dumps == 1
+
+
+def test_flight_dump_without_destination_is_noop():
+    fr = FlightRecorder(capacity=4, directory=None)
+    fr.record("tick")
+    assert fr.dump(reason="nowhere") is None
+    assert fr.dumps == 0
+
+
+# --------------------------------------------------- chaos acceptance scenario
+@pytest.mark.chaos
+def test_nan_storm_leaves_flight_dump_and_crit_health(tmp_path):
+    """The acceptance path end-to-end: a NaN storm kills the trainer via
+    WatchdogTrip; the crash leaves a parseable flight_*.json holding the
+    give-up and the steps leading up to it, and /healthz flips from OK
+    to CRIT (HTTP 503) on the nan_skip_rate rule."""
+    from paddle_tpu.utils.faults import FAULTS
+    pt.seed(0)
+    FLIGHT.dir = str(tmp_path)
+    srv = MetricsServer(port=0, host="127.0.0.1")
+    try:
+        status, body = _http_get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert (status, body["status"]) == (200, "OK")   # before the storm
+
+        m = nn.Linear(4, 1)
+        tr = Trainer(m, opt.SGD(0.1),
+                     lambda mod, x, y: nn.functional.mse_loss(mod(x), y),
+                     TrainerArgs(max_steps=50, log_every=0, max_bad_steps=3))
+        FAULTS.install("train.loss", every=1, action=lambda c: float("nan"))
+        rs = np.random.RandomState(1)
+        data = ((rs.randn(2, 4).astype(np.float32),
+                 rs.randn(2, 1).astype(np.float32)) for _ in range(50))
+        with pytest.raises(WatchdogTrip, match="non-finite"):
+            tr.fit(data)
+
+        dumps = sorted(tmp_path.glob("flight_*.json"))
+        assert dumps, "crash left no flight dump"
+        with open(dumps[-1]) as f:
+            doc = json.load(f)
+        kinds = [e["kind"] for e in doc["events"]]
+        assert doc["reason"].startswith("train.crash:WatchdogTrip")
+        assert "train.giveup" in kinds          # the triggering event
+        assert "train.crash" in kinds
+        assert kinds.count("fault") == 3        # every chaos hit on record
+        assert kinds.count("train.nan_skip") == 3
+
+        status, body = _http_get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 503                    # dumb TCP checkers see it
+        assert body["status"] == "CRIT"
+        by_name = {r["name"]: r for r in body["rules"]}
+        assert by_name["nan_skip_rate"]["status"] == "CRIT"
+
+        status, body = _http_get(f"http://127.0.0.1:{srv.port}/flight")
+        assert status == 200
+        assert any(e["kind"] == "train.giveup" for e in body["events"])
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------- compile introspection
+def _counter(snap, name, fn):
+    return snap["counters"].get(f'{name}{{fn="{fn}"}}', 0)
+
+
+def test_instrumented_jit_hit_miss_and_span_accounting():
+    TRACER.enable()
+    f = instrumented_jit(lambda x: x * 2 + 1, name="toy")
+
+    out = f(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(out), [1, 3, 5, 7])
+    snap = METRICS.snapshot()
+    assert _counter(snap, "compile_cache_misses_total", "toy") == 1
+    assert _counter(snap, "compile_cache_hits_total", "toy") == 0
+    assert snap["histograms"]['compile_seconds{fn="toy"}']["count"] == 1
+    compiles_before = sum(
+        1 for e in TRACER.export()["traceEvents"] if e["name"] == "jit.compile")
+    assert compiles_before == 1
+
+    f(np.arange(4, dtype=np.float32) + 1)      # same signature → cache hit
+    snap = METRICS.snapshot()
+    assert _counter(snap, "compile_cache_hits_total", "toy") == 1
+    assert _counter(snap, "compile_cache_misses_total", "toy") == 1
+    assert snap["histograms"]['compile_seconds{fn="toy"}']["count"] == 1
+    compiles_after = sum(
+        1 for e in TRACER.export()["traceEvents"] if e["name"] == "jit.compile")
+    assert compiles_after == compiles_before   # a hit opens no compile span
+
+    f(np.arange(8, dtype=np.float32))          # new shape → second compile
+    snap = METRICS.snapshot()
+    assert _counter(snap, "compile_cache_misses_total", "toy") == 2
+    assert f.cache_size == 2
+    assert f.flops_per_call > 0                # CPU cost_analysis reports
+    assert [e["kind"] for e in FLIGHT.events()].count("compile") == 2
+
+
+def test_instrumented_jit_kill_switch(monkeypatch):
+    monkeypatch.setenv("PT_COMPILE_INTROSPECTION", "0")
+    f = instrumented_jit(lambda x: x + 1, name="off")
+    assert not hasattr(f, "cache_size")        # bare jax.jit, no wrapper
+    np.testing.assert_allclose(np.asarray(f(np.ones(2))), [2, 2])
+    assert _counter(METRICS.snapshot(), "compile_cache_misses_total", "off") == 0
+
+
+def test_instrumented_jit_falls_back_when_aot_breaks(monkeypatch):
+    f = instrumented_jit(lambda x: x * 3, name="brittle")
+
+    def boom(args, kwargs):
+        raise RuntimeError("no AOT on this backend")
+    monkeypatch.setattr(f, "_compile", boom)
+    out = f(np.ones(2, dtype=np.float32))      # still computes via plain jit
+    np.testing.assert_allclose(np.asarray(out), [3, 3])
+    assert f._broken and f.cache_size == 0
+    misses = _counter(METRICS.snapshot(), "compile_cache_misses_total",
+                      "brittle")
+    f(np.ones(2, dtype=np.float32))            # broken → counters frozen
+    assert _counter(METRICS.snapshot(), "compile_cache_misses_total",
+                    "brittle") == misses
+
+
+def test_trainer_step_compiles_once_then_hits():
+    pt.seed(0)
+    m = nn.Linear(4, 1)
+    tr = Trainer(m, opt.SGD(0.1),
+                 lambda mod, x, y: nn.functional.mse_loss(mod(x), y),
+                 TrainerArgs(max_steps=4, log_every=0))
+    rs = np.random.RandomState(0)
+    data = ((rs.randn(2, 4).astype(np.float32),
+             rs.randn(2, 1).astype(np.float32)) for _ in range(4))
+    tr.fit(data)
+    snap = METRICS.snapshot()
+    assert _counter(snap, "compile_cache_misses_total", "train.step") == 1
+    assert _counter(snap, "compile_cache_hits_total", "train.step") == 3
+
+
+# ------------------------------------------------------------ metrics shipper
+def test_shipper_ships_deltas(tmp_path):
+    path = str(tmp_path / "ship.jsonl")
+    c = METRICS.counter("ship_unit_total")
+    sh = MetricsShipper(path, interval_s=60)
+    c.inc(5)
+    sh.ship_now()
+    c.inc(2)
+    sh.ship_now()
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert recs[0]["deltas"] == {}             # first ship has no baseline
+    assert recs[0]["snapshot"]["counters"]["ship_unit_total"] == 5.0
+    assert recs[1]["deltas"]["ship_unit_total"] == 2.0
+    assert recs[1]["snapshot"]["counters"]["ship_unit_total"] == 7.0
+
+
+def test_shipper_rotation_caps_disk(tmp_path):
+    path = str(tmp_path / "ship.jsonl")
+    sh = MetricsShipper(path, interval_s=60, max_bytes=300, max_files=3)
+    for _ in range(40):
+        sh.ship_now()
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == ["ship.jsonl", "ship.jsonl.1", "ship.jsonl.2"]
+    for p in tmp_path.iterdir():               # every generation parseable
+        with open(p) as f:
+            assert all(isinstance(json.loads(line), dict) for line in f)
+    with pytest.raises(ValueError):
+        MetricsShipper(path, max_files=0)
+
+
+def test_shipper_thread_lifecycle(tmp_path):
+    sh = MetricsShipper(str(tmp_path / "s.jsonl"), interval_s=30)
+    sh.start()
+    names = [t.name for t in threading.enumerate()]
+    assert "pt-metrics-shipper" in names       # leak fixture needs the prefix
+    sh.stop()
+    assert "pt-metrics-shipper" not in [t.name for t in threading.enumerate()]
+    assert sh.shipped >= 1                     # stop() takes a final ship
+    assert sh.errors == 0
+
+
+# ------------------------------------------------------------- http endpoints
+def test_healthz_and_flight_endpoints():
+    srv = MetricsServer(port=0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _http_get(base + "/healthz")
+        assert (status, body["status"]) == (200, "OK")
+        assert {r["name"] for r in body["rules"]} >= {
+            "nan_skip_rate", "elastic_restarts"}
+
+        FLIGHT.record("unit.event", step=3)
+        status, body = _http_get(base + "/flight")
+        assert status == 200
+        assert body["last_step"] == 3
+        assert body["events"][-1]["kind"] == "unit.event"
+
+        HEALTH.rule("unit_always_crit", lambda: 10.0, warn=1.0, crit=5.0)
+        try:
+            status, body = _http_get(base + "/healthz")
+            assert (status, body["status"]) == (503, "CRIT")
+        finally:
+            HEALTH.remove_rule("unit_always_crit")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------- histogram quantile
+def test_histogram_quantile_units():
+    h = METRICS.histogram("hq_unit_seconds", "t", buckets=(1.0, 2.0, 4.0))
+    assert math.isnan(h.quantile(0.5))         # empty → NaN, not 0
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    assert h.quantile(0.5) == pytest.approx(1.5)    # interpolated in (1,2]
+    assert h.quantile(1.0) == pytest.approx(4.0)
+    h.observe(100.0)                           # lands in +Inf
+    assert h.quantile(1.0) == pytest.approx(4.0)    # clamped to top bound
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+# ---------------------------------------------------------- overlap-aware MFU
+def test_record_throughput_overlap_math():
+    # 100 tok/s measured over a 2 s window where 1 s of host work hid
+    # under device compute → device-side rate is 2× the naive one
+    m = record_throughput(100.0, flops_per_token=2.0, peak_flops=1000.0,
+                          hidden_host_s=1.0, window_s=2.0)
+    g = METRICS.snapshot()["gauges"]
+    assert m == pytest.approx(0.2)
+    assert g["train_mfu"] == pytest.approx(0.2)
+    assert g["train_mfu_overlap"] == pytest.approx(0.4)
+
+    record_throughput(100.0, flops_per_token=2.0, peak_flops=1000.0)
+    g = METRICS.snapshot()["gauges"]
+    assert g["train_mfu_overlap"] == pytest.approx(g["train_mfu"])  # no window
+
+
+# ------------------------------------------------------------ health semantics
+def test_health_rule_thresholds():
+    mk = lambda v: HealthRule("r", lambda: v, warn=2.0, crit=5.0)
+    assert mk(1.0).evaluate()["status"] == "OK"
+    assert mk(2.0).evaluate()["status"] == "WARN"    # thresholds inclusive
+    assert mk(5.0).evaluate()["status"] == "CRIT"
+    nan = mk(float("nan")).evaluate()
+    assert (nan["status"], nan["value"]) == ("OK", None)   # no data ≠ incident
+    with pytest.raises(ValueError):
+        HealthRule("bad", lambda: 0, warn=5.0, crit=2.0)
+
+
+def test_health_broken_getter_is_crit():
+    def boom():
+        raise RuntimeError("probe wiring broke")
+    r = HealthRule("probe", boom, warn=1.0, crit=2.0).evaluate()
+    assert r["status"] == "CRIT"
+    assert "probe wiring broke" in r["error"]
+
+
+def test_health_evaluator_fold_and_replace():
+    ev = HealthEvaluator()
+    assert ev.evaluate()["status"] == "OK"     # unconfigured must not page
+    ev.rule("a", lambda: 0.0, warn=1.0, crit=2.0)
+    ev.rule("b", lambda: 1.5, warn=1.0, crit=2.0)
+    assert ev.evaluate()["status"] == "WARN"   # worst rule wins
+    ev.rule("b", lambda: 0.0, warn=1.0, crit=2.0)     # same name replaces
+    assert len(ev.rules) == 2
+    assert ev.evaluate()["status"] == "OK"
+    ev.remove_rule("a")
+    assert [r.name for r in ev.rules] == ["b"]
+
+
+def test_default_rules_track_registry():
+    ev = install_default_rules(HealthEvaluator())
+    assert ev.evaluate()["status"] == "OK"     # fresh registry → all quiet
+    METRICS.counter("train_steps_total", "t").inc(10)
+    METRICS.counter("train_nan_skips_total", "t").inc(1)
+    rep = {r["name"]: r for r in ev.evaluate()["rules"]}
+    assert rep["nan_skip_rate"]["status"] == "WARN"   # 0.1 ≥ warn 0.05
+    assert rep["nan_skip_rate"]["value"] == pytest.approx(0.1)
+
+
+def test_health_getter_factories():
+    METRICS.counter("hg_num_total", "t").inc(3)
+    METRICS.counter("hg_den_total", "t").inc(12)
+    assert counter_value("hg_num_total")() == 3.0
+    assert counter_ratio("hg_num_total", "hg_den_total")() == 0.25
+    assert counter_ratio("hg_num_total", "hg_absent_total")() == 0.0
+    assert math.isnan(histogram_quantile("hg_absent_seconds", 0.5)())
+
+
+# ------------------------------------------------------------- orbax satellite
+def test_orbax_checkpoint_instrumented(tmp_path):
+    ocp = pytest.importorskip("orbax.checkpoint")  # noqa: F841
+    from paddle_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "ck"), use_orbax=True)
+    state = {"w": np.arange(6, dtype=np.float32), "step": np.int64(7)}
+    mgr.save(7, state)
+    restored = mgr.restore(state)
+    np.testing.assert_allclose(np.asarray(restored["w"]), state["w"])
+    snap = METRICS.snapshot()
+    assert snap["counters"]["ckpt_saves_total"] == 1
+    assert snap["counters"]["ckpt_restores_total"] == 1
+    assert snap["histograms"]["ckpt_save_seconds"]["count"] == 1
+    assert snap["histograms"]["ckpt_restore_seconds"]["count"] == 1
+    kinds = [(e["kind"], e.get("backend")) for e in FLIGHT.events()]
+    assert ("ckpt.save", "orbax") in kinds

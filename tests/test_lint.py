@@ -115,6 +115,12 @@ def test_pipeline_shard_map_body_lints_clean():
 # (timestamps for humans, not durations) go on the allowlist with a reason.
 _WALLCLOCK_ALLOWLIST = {
     # e.g. "paddle_tpu/some/module.py": "emits human-readable timestamps",
+    "paddle_tpu/observability/flight.py":
+        "t_wall in dump artifacts — humans correlate crash dumps by wall "
+        "clock; every duration in the module rides time.monotonic()",
+    "paddle_tpu/observability/shipper.py":
+        "t_wall in shipped JSONL records — cross-process correlation "
+        "timestamp; intervals/deltas ride time.monotonic()",
 }
 
 
@@ -134,6 +140,37 @@ def test_no_wall_clock_durations_in_paddle_tpu():
         "wall-clock time.time() used for timing (use time.monotonic() or "
         "the observability span API, or allowlist with a reason):\n"
         + "\n".join(offenders))
+
+
+# ---------------------------------------------------------- thread-name lint
+# Every background thread paddle_tpu spawns must carry a "pt-" name so the
+# conftest leak fixture (and an operator's py-spy dump) can attribute any
+# survivor to its subsystem. Same allowlist mechanism as the clock lint.
+_THREAD_NAME_ALLOWLIST = {
+    # e.g. "paddle_tpu/some/module.py": "thread name set post-construction",
+}
+
+
+def test_threads_carry_pt_name_prefix():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    pkg = root / "paddle_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        if rel in _THREAD_NAME_ALLOWLIST:
+            continue
+        text = path.read_text()
+        for m in re.finditer(r"\bthreading\.Thread\s*\(", text):
+            # the constructor call may span lines — scan a window past
+            # the open paren for the name= kwarg
+            window = text[m.start():m.start() + 500]
+            if not re.search(r"""name\s*=\s*f?["']pt-""", window):
+                lineno = text.count("\n", 0, m.start()) + 1
+                offenders.append(f"{rel}:{lineno}")
+    assert not offenders, (
+        'threading.Thread without a name="pt-..." (the leak fixture cannot '
+        "attribute unnamed survivors; allowlist with a reason if the name "
+        "is set elsewhere):\n" + "\n".join(offenders))
 
 
 def test_pipeline_divergent_handoff_flagged():
