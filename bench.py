@@ -84,6 +84,49 @@ def _probe_backend(env, timeout=PROBE_TIMEOUT_S):
         return None
 
 
+def _run_cpu_legs(env, timeout=WORKER_TIMEOUT_S):
+    """Run only the backend-independent legs (host_overlap, serving_spec)
+    in a clean-env CPU subprocess; return their dict or None."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-legs"],
+            env=env, timeout=timeout, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    except subprocess.TimeoutExpired:
+        print(f"bench cpu-legs: timed out after {timeout}s", file=sys.stderr)
+        return None
+    if r.returncode != 0:
+        print(f"bench cpu-legs: rc={r.returncode} "
+              f"{r.stderr.strip()[-300:]}", file=sys.stderr)
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def cpu_legs_main():
+    """Worker entry for --cpu-legs: one JSON line with the
+    backend-independent metrics sub-objects."""
+    out = {}
+    for key, fn in (("host_overlap", bench_host_overlap),
+                    ("serving_spec", bench_serving_spec)):
+        try:
+            out[key] = fn()
+        except Exception as e:  # noqa: BLE001 — per-leg isolation
+            print(f"bench cpu leg {key} failed: {e!r}", file=sys.stderr)
+            out[key] = {"error": f"{type(e).__name__}: {e}"}
+    from paddle_tpu.observability import METRICS
+    out["counters"] = {
+        k: v for k, v in METRICS.snapshot()["counters"].items()
+        if k.startswith("serving_spec_")}
+    print(json.dumps(out))
+
+
 def _run_worker(env, timeout=WORKER_TIMEOUT_S):
     """Run the real bench in a subprocess; return parsed JSON dict or None."""
     try:
@@ -141,6 +184,14 @@ def orchestrate():
     if harvested is not None:
         print("bench: tunnel unavailable now, replaying the on-chip result "
               "harvested earlier this round", file=sys.stderr)
+        # the harvested artifact predates backend-independent legs added
+        # since it was taken: re-run the CPU-safe legs fresh (subprocess —
+        # the orchestrator stays jax-free) and graft them in
+        cpu_legs = _run_cpu_legs(dict(CLEAN_ENV))
+        if cpu_legs is not None:
+            m = harvested.setdefault("metrics", {})
+            m.setdefault("counters", {}).update(cpu_legs.pop("counters", {}))
+            m.update(cpu_legs)
         print(json.dumps(harvested))
         return
     result = _run_worker(dict(CLEAN_ENV), timeout=WORKER_TIMEOUT_S)
@@ -319,7 +370,11 @@ def bench_gpt3_tp(on_tpu, sync):
         dt = _timeit(one, sync, iters)
     return {"value": round(batch * seq / dt, 1), "unit": "tokens/sec",
             "step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
-            "tp": n, "params": model.num_parameters()}
+            "tp": n, "params": model.num_parameters(),
+            # honest labelling: the on-chip geometry keeps the 1.3B
+            # hidden/head shape but cuts depth 24->8 to fit one chip's
+            # Adam state — this is NOT a 1.3B run (~510M params)
+            "depth_cut": True}
 
 
 def bench_moe_ep(on_tpu, sync):
@@ -453,6 +508,82 @@ def bench_host_overlap():
             "mfu_overlap": g.get("train_mfu_overlap", 0.0)}
 
 
+def bench_serving_spec():
+    """Speculative-decoding serving leg (ISSUE 5): engine decode
+    tokens/sec with speculation off vs on. Calibrated — the draft is a
+    1-layer model SHARING the target's embedding, first layer, norm and
+    head, and the target's deeper layers have o_proj/down_proj zeroed
+    (residual-identity), so draft(x) == target(x) exactly: acceptance is
+    ~100% while the per-token compute ratio stays real (8 layers vs 1).
+    That isolates the engine mechanics (drafting, batched verify, rewind)
+    from draft quality, which is a model-selection concern, not an
+    engine one. CPU-safe; greedy, so the off/on outputs must match."""
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import LLMEngine, Request
+
+    import paddle_tpu as pt
+    pt.seed(0)
+    kw = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+              num_attention_heads=8, num_key_value_heads=4,
+              max_position_embeddings=256)
+    target = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=8, **kw))
+    for lyr in target.model.layers[1:]:
+        lyr.self_attn.o_proj = jnp.zeros_like(lyr.self_attn.o_proj)
+        lyr.mlp.down_proj = jnp.zeros_like(lyr.mlp.down_proj)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1, **kw))
+    draft.model.embed_tokens = target.model.embed_tokens
+    draft.model.layers[0] = target.model.layers[0]
+    draft.model.norm = target.model.norm
+    draft.lm_head = target.lm_head
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 512, (int(l),))
+               for l in rs.randint(4, 24, size=8)]
+    max_new = 48
+
+    def make(spec):
+        ekw = dict(num_slots=4, block_size=8, max_prompt_len=32,
+                   max_seq_len=96)
+        if spec:
+            ekw.update(draft_model=draft, spec_k=4)
+        return LLMEngine(target, **ekw)
+
+    def run(eng, ps):
+        for p in ps:
+            eng.add_request(Request(p, max_new_tokens=max_new))
+        return eng.run()
+
+    run(make(False), prompts[:2])          # warmup / compile both paths
+    run(make(True), prompts[:2])
+
+    results = {}
+    for label, spec in (("off", False), ("on", True)):
+        eng = make(spec)
+        t0 = time.perf_counter()
+        out = run(eng, prompts)
+        dt = time.perf_counter() - t0
+        ntok = sum(len(t) for t in out.values())
+        results[label] = (ntok / dt, {r: list(map(int, t))
+                                      for r, t in out.items()}, eng)
+    off_tps, off_out, _ = results["off"]
+    on_tps, on_out, eng_on = results["on"]
+    from paddle_tpu.observability import METRICS
+    snap = METRICS.snapshot()
+    return {
+        "spec_off_tokens_per_sec": round(off_tps, 1),
+        "spec_on_tokens_per_sec": round(on_tps, 1),
+        "speedup": round(on_tps / off_tps, 3),
+        "match": on_out == off_out,        # greedy: must be identical
+        "acceptance_rate": round(
+            snap["gauges"].get("serving_spec_acceptance_rate", 0.0), 4),
+        "spec_proposed": eng_on.stats["spec_proposed"],
+        "spec_accepted": eng_on.stats["spec_accepted"],
+        "spec_k": 4,
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -571,6 +702,15 @@ def main():
         print(f"bench config host_overlap failed: {e!r}", file=sys.stderr)
         host_overlap = {"error": f"{type(e).__name__}: {e}"}
 
+    # serving speculative decoding: decode tokens/sec off vs on with a
+    # calibrated target+draft pair — backend-independent, lands in
+    # "metrics" next to its acceptance counters
+    try:
+        serving_spec = bench_serving_spec()
+    except Exception as e:  # noqa: BLE001 — per-config isolation
+        print(f"bench config serving_spec failed: {e!r}", file=sys.stderr)
+        serving_spec = {"error": f"{type(e).__name__}: {e}"}
+
     # honest config label: the CPU-smoke fallback runs LlamaConfig.tiny(),
     # not the 0.8B geometry — name the metric by what actually ran
     size_tag = f"{n_params / 1e9:.1f}b" if n_params >= 5e7 else f"{n_params:,}-param smoke"
@@ -599,8 +739,10 @@ def main():
         "mfu_overlap": headline_gauges.get("train_mfu_overlap", 0.0),
         "compile": compile_obj,
         "counters": {k: v for k, v in snap["counters"].items()
-                     if k.startswith(("collective_", "faults_"))},
+                     if k.startswith(("collective_", "faults_",
+                                      "serving_spec_"))},
         "host_overlap": host_overlap,
+        "serving_spec": serving_spec,
     }
     print(json.dumps({
         "metric": f"llama-{size_tag} bf16 train step tokens/sec/chip (MFU in extra)",
@@ -624,6 +766,8 @@ def main():
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         main()
+    elif "--cpu-legs" in sys.argv:
+        cpu_legs_main()
     else:
         try:
             orchestrate()

@@ -788,7 +788,8 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
 
 
 def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
-                              cache: PagedKVCache, slot_ids, table_rows):
+                              cache: PagedKVCache, slot_ids, table_rows,
+                              full_logits=False):
     """CONTINUE a prefill: write chunk tokens at positions
     ``offsets[a] .. offsets[a]+chunk_lens[a]-1`` of their slots and attend
     each chunk query over the slot's WHOLE pool prefix (gather-based) —
@@ -801,7 +802,12 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
     already in the pool), slot_ids [A] (sentinel >= num_slots drops the
     row), table_rows [A, max_blocks] CURRENT tables covering
     offset+chunk. Dynamic-NTK rope is refused (chunk-end bases would
-    desync across chunks)."""
+    desync across chunks).
+
+    ``full_logits=True`` returns the whole [A, C, V] logit block instead
+    of each row's last position — the speculative VERIFY forward: logit i
+    of a row judges the proposal at position offset+i+1, so the engine
+    needs every chunk position, not just the last."""
     cfg = model.cfg
     if (getattr(cfg, "rope_scaling", None) or {}).get("type") == "dynamic":
         raise NotImplementedError(
@@ -881,10 +887,13 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         x = x + lyr.mlp(lyr.post_attention_layernorm(x))
     x = model.model.norm(x)
     logits = model.logits(x)
+    new_cache = PagedKVCache(k_pools, v_pools, new_tables, new_lens)
+    if full_logits:
+        return logits, new_cache
     last = jnp.take_along_axis(
         logits, jnp.maximum(chunk_lens - 1, 0)[:, None, None].astype(
             jnp.int32), axis=1)[:, 0]
-    return last, PagedKVCache(k_pools, v_pools, new_tables, new_lens)
+    return last, new_cache
 
 
 def _scatter_decode_chunk(pool, vals, tables, offsets, chunk_lens, nb, bs):
@@ -907,3 +916,90 @@ def _scatter_decode_chunk(pool, vals, tables, offsets, chunk_lens, nb, bs):
 
 _PREFILL_CHUNK_JIT = jax.jit(llama_prefill_chunk_paged,
                              donate_argnums=(4,))
+
+
+# ------------------------------------------------ speculative helpers
+# The multi-append/rewind primitives speculation needs, shared by the
+# standalone generators (models/speculative.py) and the serving engine:
+# the target VERIFY forward is the chunk prefill with full logits (multi-
+# token append through the block tables), and the rollback past rejected
+# positions is a pure LENGTH rewind — block tables untouched, because
+# stale KV beyond a row's length pointer is masked by attention and
+# positionally overwritten by the next append.
+
+def llama_verify_chunk_paged(model, input_ids, chunk_lens, offsets,
+                             cache: PagedKVCache, slot_ids, table_rows):
+    """Speculative verify: one chunk forward returning [A, C, V] logits
+    (see ``llama_prefill_chunk_paged`` — same append semantics, every
+    chunk position's logits kept for accept/reject)."""
+    return llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
+                                     cache, slot_ids, table_rows,
+                                     full_logits=True)
+
+
+def spec_rewind_lens(cache: PagedKVCache, slot_ids, new_lens):
+    """Roll the given slots' length pointers back past rejected
+    speculative positions. Block tables are NOT touched: the blocks
+    holding rejected KV stay owned by their sequences, their stale
+    contents unreachable (attention masks ``pos >= lens``) until the next
+    append overwrites them. slot_ids sentinel >= num_slots drops the
+    row."""
+    slot_ids = jnp.asarray(slot_ids, jnp.int32)
+    lens = cache.lens.at[slot_ids].set(
+        jnp.asarray(new_lens, jnp.int32), mode="drop")
+    return PagedKVCache(cache.k_pools, cache.v_pools, cache.block_tables,
+                        lens)
+
+
+def spec_advance_frontiers(pos, draft_pos, n_new):
+    """Commit one speculative round: the target frontier advances by the
+    ``n_new`` committed tokens (accepted prefix + correction/bonus) and
+    the draft frontier rolls back past everything it proposed beyond the
+    new frontier — its stale cache entries get positionally overwritten
+    by the next round's feed. Works on scalars or per-row arrays."""
+    new_pos = pos + n_new
+    return new_pos, np.minimum(draft_pos, new_pos)
+
+
+def greedy_accept_length(verify_tokens, proposals):
+    """Longest matching prefix between the target's argmax tokens and the
+    draft's proposals — the greedy accept rule. ``verify_tokens`` may be
+    longer than ``proposals`` (it usually carries the bonus position);
+    works on [gamma] rows or [B, gamma] batches, returning a scalar or
+    [B] counts."""
+    v = np.asarray(verify_tokens)
+    p = np.asarray(proposals)
+    match = np.cumprod(v[..., : p.shape[-1]] == p, axis=-1)
+    return match.sum(axis=-1)
+
+
+def stochastic_accept_row(props, qs, ps, rng):
+    """The Leviathan/Chen accept-reject rule over ONE row: accept
+    proposal x_i with probability min(1, p_i(x_i)/q_i(x_i)); the first
+    rejection resamples from the residual norm(max(0, p_i - q_i)); a
+    fully accepted row draws the bonus token from p_gamma. ``ps`` holds
+    len(props)+1 distributions (the extra one is the bonus position).
+    Returns (committed tokens, n_accepted); the emitted stream is
+    distributed exactly as sampling from ``ps`` alone, for ANY proposal
+    distribution ``qs``."""
+    new: list[int] = []
+    n_acc = 0
+    for i, x in enumerate(props):
+        x = int(x)
+        if rng.uniform() < min(1.0, float(ps[i][x])
+                               / max(float(qs[i][x]), 1e-20)):
+            new.append(x)
+            n_acc += 1
+        else:
+            resid = np.maximum(ps[i] - qs[i], 0.0)
+            z = resid.sum()
+            resid = resid / z if z > 0 else ps[i]
+            new.append(int(rng.choice(resid.size, p=resid)))
+            break
+    else:
+        new.append(int(rng.choice(ps[len(props)].size, p=ps[len(props)])))
+    return new, n_acc
+
+
+_VERIFY_CHUNK_JIT = jax.jit(llama_verify_chunk_paged, donate_argnums=(4,))
+_REWIND_LENS_JIT = jax.jit(spec_rewind_lens, donate_argnums=(0,))

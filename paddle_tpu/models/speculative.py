@@ -26,12 +26,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.models.decoding import KVCache, llama_forward_with_cache
+from paddle_tpu.models.paged import (greedy_accept_length,
+                                     spec_advance_frontiers,
+                                     stochastic_accept_row)
 from paddle_tpu.ops import attention as A
 from paddle_tpu.quantization import wo_matmul as _wo
 
 
 def _forward_rows(model, input_ids, cache: KVCache, row_pos,
-                  chunk_end_len=None):
+                  chunk_end_len=None, chunk_lens=None):
     """Chunk forward with PER-ROW positions: row b's tokens occupy cache
     positions ``row_pos[b] .. row_pos[b]+C-1`` (rope, cache writes, and
     causal visibility all per-row). This is what makes speculation
@@ -47,7 +50,15 @@ def _forward_rows(model, input_ids, cache: KVCache, row_pos,
     it each position uses its own base alpha(pos+1), matching the
     one-token-per-step decode that verify chunks must reproduce. Prefill
     MUST pass it or long-prompt dynamic-NTK caches desync from plain
-    ``generate()``."""
+    ``generate()``.
+
+    ``chunk_lens`` ([B] int32): per-row WRITE mask — row b commits only
+    its first chunk_lens[b] positions to the cache (a row at 0 writes
+    nothing at all). The serving engine's ragged draft feeds need this:
+    slots propose different k, so padding columns — and whole padding
+    rows — must not clobber cache entries. Masked writes are routed
+    out-of-bounds and dropped (NOT clamped: the scatter default would
+    silently corrupt position cap-1)."""
     cfg = model.cfg
     if getattr(cfg, "sliding_window", None):
         raise NotImplementedError("speculative rows-forward: no window")
@@ -83,6 +94,11 @@ def _forward_rows(model, input_ids, cache: KVCache, row_pos,
     cache_len = cache.k[0].shape[1]
     vis = (jnp.arange(cache_len)[None, None, :]
            <= positions[:, :, None])[:, None]            # [B,1,C,L]
+    if chunk_lens is None:
+        wpos = positions
+    else:
+        wpos = jnp.where(jnp.arange(c, dtype=jnp.int32)[None, :]
+                         < chunk_lens[:, None], positions, cache_len)
     new_k, new_v = [], []
     for li, lyr in enumerate(model.model.layers):
         h = lyr.input_layernorm(x)
@@ -95,8 +111,8 @@ def _forward_rows(model, input_ids, cache: KVCache, row_pos,
         q = rope(q.reshape(b, c, nh, hd))
         k = rope(k.reshape(b, c, nkv, hd))
         v = v.reshape(b, c, nkv, hd)
-        k_c = cache.k[li].at[row, positions].set(k)
-        v_c = cache.v[li].at[row, positions].set(v)
+        k_c = cache.k[li].at[row, wpos].set(k, mode="drop")
+        v_c = cache.v[li].at[row, wpos].set(v, mode="drop")
         new_k.append(k_c)
         new_v.append(v_c)
         out = A.xla_attention(q, k_c, v_c, attn_mask=vis)
@@ -196,20 +212,15 @@ def speculative_generate(target, draft, input_ids, max_new_tokens: int = 32,
         tl, cache_t = fwd(target, chunk_t, cache_t, pos)
         vs = np.asarray(jnp.argmax(tl.astype(jnp.float32), axis=-1))[0]
         # vs[i] = target's token for position pos+1+i
-        n_acc = 0
-        while n_acc < gamma and vs[n_acc] == props[n_acc]:
-            n_acc += 1
+        n_acc = int(greedy_accept_length(vs[:gamma], props))
         # accepted prefix + the target's own next token (correction, or the
         # bonus token when every proposal matched — n_acc == gamma)
         new = props[:n_acc] + [int(vs[n_acc])]
         committed.extend(new)
         accepted_total += n_acc
-        pos += n_acc + 1
+        pos, draft_pos = spec_advance_frontiers(pos, draft_pos, len(new))
+        pos, draft_pos = int(pos), int(draft_pos)
         c = committed[-1]
-        # draft cache holds proposals up to draft_pos-1; positions beyond
-        # the new committed frontier are stale but will be overwritten (its
-        # next chunk write starts at the frontier) — reset the pointer
-        draft_pos = min(draft_pos, pos)
 
     committed = committed[:max_new_tokens]
     if eos_token_id is not None and eos_token_id in committed:
@@ -324,8 +335,7 @@ def speculative_generate_batched(target, draft, input_ids, prompt_lens=None,
                                     jnp.asarray(pos, jnp.int32))
         vs = np.asarray(jnp.argmax(tl.astype(jnp.float32), axis=-1))
 
-        match = np.cumprod(vs[:, :gamma] == props, axis=1).astype(bool)
-        n_acc = match.sum(axis=1)                  # [B]
+        n_acc = greedy_accept_length(vs[:, :gamma], props)     # [B]
         for r in range(b):                         # per ROUND, not per token
             if done[r]:
                 continue
@@ -333,9 +343,9 @@ def speculative_generate_batched(target, draft, input_ids, prompt_lens=None,
             new = list(props[r, :na]) + [int(vs[r, na])]
             committed[r].extend(int(t) for t in new)
             accepted_total += na
-            pos[r] += na + 1
+            pos[r], draft_pos[r] = spec_advance_frontiers(
+                int(pos[r]), int(draft_pos[r]), len(new))
             c[r] = committed[r][-1]
-            draft_pos[r] = min(int(draft_pos[r]), int(pos[r]))
             done[r] = row_done(r)
 
     out = np.zeros((b, s + max_new_tokens), ids_np.dtype)
@@ -429,27 +439,12 @@ def speculative_sample(target, draft, input_ids, max_new_tokens: int = 32,
         tl, cache_t = fwd(target, chunk_t, cache_t, pos)
         ps = [probs(tl[:, i]) for i in range(gamma + 1)]
 
-        n_acc = 0
-        new: list[int] = []
-        for i, x in enumerate(props):
-            if rs.uniform() < min(1.0, ps[i][x] / max(qs[i][x], 1e-20)):
-                new.append(x)
-                n_acc += 1
-            else:
-                resid = np.maximum(ps[i] - qs[i], 0.0)
-                z = resid.sum()
-                resid = resid / z if z > 0 else ps[i]
-                new.append(int(rs.choice(resid.size, p=resid)))
-                break
-        else:
-            # every proposal accepted: bonus token from the target's
-            # distribution at the chunk end
-            new.append(int(rs.choice(ps[gamma].size, p=ps[gamma])))
+        new, n_acc = stochastic_accept_row(props, qs, ps, rs)
         committed.extend(new)
         accepted_total += n_acc
-        pos += len(new)
+        pos, draft_pos = spec_advance_frontiers(pos, draft_pos, len(new))
+        pos, draft_pos = int(pos), int(draft_pos)
         c = committed[-1]
-        draft_pos = min(draft_pos, pos)
 
     committed = committed[:max_new_tokens]
     if eos_token_id is not None and eos_token_id in committed:

@@ -23,6 +23,7 @@ condition mid-decode (there is no preemption to recover with).
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -31,11 +32,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.models.decoding import _sample_rows
+from paddle_tpu.models.decoding import KVCache, _sample_rows
 from paddle_tpu.models.paged import (PagedKVCache, PrefixCachingBlockManager,
                                      _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
                                      _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
-                                     _PREFILL_JIT, _TICK_JIT)
+                                     _PREFILL_JIT, _REWIND_LENS_JIT,
+                                     _TICK_JIT, _VERIFY_CHUNK_JIT,
+                                     greedy_accept_length,
+                                     stochastic_accept_row)
+from paddle_tpu.models.speculative import _FWD_ROWS_JIT
 from paddle_tpu.observability import METRICS, span as _span
 from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.utils.faults import fault_point
@@ -87,6 +92,23 @@ _TICK = METRICS.histogram(
 _DRAIN = METRICS.histogram(
     "serving_drain_seconds", "wall time of graceful drain",
     buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
+# speculative decoding (ISSUE 5): proposal/acceptance accounting plus the
+# per-tick commit size — tokens_per_tick > 1 is the whole point
+_SPEC_PROPOSED = METRICS.counter(
+    "serving_spec_proposed_total", "draft tokens proposed for verification")
+_SPEC_ACCEPTED = METRICS.counter(
+    "serving_spec_accepted_total", "draft tokens accepted by the target")
+_SPEC_FALLBACKS = METRICS.counter(
+    "serving_spec_fallbacks_total",
+    "spec ticks abandoned before verify (fault injection) — the engine "
+    "fell back to the one-token tick")
+_SPEC_RATE = METRICS.gauge(
+    "serving_spec_acceptance_rate",
+    "cumulative accepted/proposed draft-token ratio")
+_SPEC_TOKENS = METRICS.histogram(
+    "serving_spec_tokens_per_tick",
+    "tokens committed per slot per speculative tick",
+    buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
 
 
 class QueueFullError(RuntimeError):
@@ -170,7 +192,8 @@ class LLMEngine:
                  max_prompt_len=128, max_seq_len=None, num_blocks=None,
                  eos_token_id=None, temperature=0.0, top_k=None, top_p=None,
                  seed=0, prefix_caching=True, preemption=False,
-                 max_queue_len=None, clock=None):
+                 max_queue_len=None, clock=None, draft_model=None,
+                 spec_k=4, spec_adaptive=True):
         cfg = model.cfg
         self.model = model
         self.num_slots = num_slots
@@ -212,6 +235,34 @@ class LLMEngine:
         # resume-prompt = prompt + generated-so-far and recomputes
         self.preemption = bool(preemption)
 
+        # ---- speculative decoding (ISSUE 5): draft-and-verify tick ----
+        # ``draft_model`` enables it; each eligible slot drafts up to
+        # spec_k tokens through a per-slot dense draft cache, then ONE
+        # batched target chunk forward verifies them through the paged
+        # pool. PT_SPEC_DECODE=0 is the kill switch (checked every tick,
+        # so it also disables a live engine); beam slots always take the
+        # one-token path.
+        self.draft_model = draft_model
+        self.spec_k = int(spec_k)
+        self.spec_adaptive = bool(spec_adaptive)
+        if draft_model is not None:
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if self.window is not None or \
+                    getattr(draft_model.cfg, "sliding_window", None):
+                raise NotImplementedError(
+                    "speculative decoding needs full (un-windowed) caches "
+                    "on both models — rewind relies on masked stale KV")
+            if self._dyn_rope:
+                raise NotImplementedError(
+                    "speculative decoding with dynamic-NTK rope is not "
+                    "supported (the verify chunk shares the chunked-"
+                    "prefill forward, which refuses per-chunk bases)")
+            if draft_model.cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}")
+
         self.cache = PagedKVCache.init(
             cfg.num_hidden_layers, num_blocks, block_size,
             cfg.num_key_value_heads,
@@ -226,6 +277,27 @@ class LLMEngine:
         self.max_gen = np.zeros(num_slots, np.int64)
         self.table_len = np.zeros(num_slots, np.int64)
         self.last_tok = np.zeros(num_slots, np.int32)
+
+        # spec-decode per-slot state (allocated tiny even when spec is
+        # off, so reset sites need no guards). ``draft_cur``: committed-
+        # sequence positions 0..draft_cur-1 are in the draft cache — 0
+        # means empty, which is how eviction "frees" a draft cache and
+        # replay rebuilds it (the re-admitted slot re-feeds from scratch).
+        self.draft_cur = np.zeros(num_slots, np.int64)
+        self.slot_k = np.full(num_slots, self.spec_k, np.int64)
+        self._acc_ema = np.ones(num_slots, np.float64)
+        self._draft_cache = None
+        if draft_model is not None:
+            dcfg = draft_model.cfg
+            self._draft_cache = KVCache.init(
+                dcfg.num_hidden_layers, num_slots,
+                self.max_seq_len + self.spec_k + 2,
+                dcfg.num_key_value_heads,
+                dcfg.hidden_size // dcfg.num_attention_heads, dcfg.dtype)
+            # host RNG for draft sampling + accept/reject (temperature>0):
+            # the accept rule preserves the target distribution for any
+            # uniform source, so this stream need not match the engine key
+            self._spec_rs = np.random.RandomState((seed ^ 0x5eed) & 0x7fffffff)
 
         self.is_beam = np.zeros(num_slots, bool)
         self.groups: dict[int, _BeamGroup] = {}
@@ -246,7 +318,8 @@ class LLMEngine:
         # jitted tick incl. the [num_slots] token fetch
         self.stats = {"host_s": 0.0, "device_s": 0.0, "ticks": 0,
                       "preemptions": 0, "timeouts": 0, "cancelled": 0,
-                      "rejected": 0}
+                      "rejected": 0, "spec_ticks": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "spec_fallbacks": 0}
         self._adm_counter = 0                # admission recency, per slot
         self.adm_order = np.zeros(num_slots, np.int64)
         # robustness: bounded admission queue (None = unbounded), a
@@ -616,6 +689,11 @@ class LLMEngine:
                                 else req.temperature)
             self.top_ps[slot] = (self.default_top_p if req.top_p is None
                                  else req.top_p)
+            # fresh draft state: an evicted slot's draft cache was "freed"
+            # by zeroing this frontier — replay rebuilds it from scratch
+            self.draft_cur[slot] = 0
+            self.slot_k[slot] = self.spec_k
+            self._acc_ema[slot] = 1.0
         n = len(admits)
         beams = []
         self._staged_admits = frozenset(r.req_id for _, r in admits)
@@ -897,6 +975,9 @@ class LLMEngine:
                 self.table_len[slot] = len(t)
                 self.temps[slot] = row_t[i]
                 self.top_ps[slot] = row_p[i]
+                self.draft_cur[slot] = 0
+                self.slot_k[slot] = self.spec_k
+                self._acc_ema[slot] = 1.0
                 emitted += self._emit(slot, int(first[i]))
         return emitted
 
@@ -980,6 +1061,7 @@ class LLMEngine:
         self._need.pop(rid, None)
         self.active[slot] = False
         self.slot_req[slot] = -1
+        self.draft_cur[slot] = 0     # draft cache freed with the slot
         self.queue.appendleft(req)
         self.stats["preemptions"] += 1
         _PREEMPTED.inc()
@@ -1042,14 +1124,294 @@ class LLMEngine:
                         protect_rid=protect):
                     raise
 
+    # ------------------------------------------------- speculative decode
+    def _spec_probs(self, logits_row, temp, top_p):
+        """Host mirror of ``decoding._sample_rows``'s filtered target
+        distribution for one row (temperature > 0): temperature scale →
+        static top_k cut → nucleus (top_p) cut → renormalise. The accept
+        rule must compare proposals against EXACTLY the distribution the
+        non-spec tick samples from, or speculation would change the
+        output law."""
+        scaled = np.asarray(logits_row, np.float64) / temp
+        if self.top_k is not None and self.top_k > 0:
+            kth = np.sort(scaled)[-self.top_k]
+            scaled = np.where(scaled < kth, -1e30, scaled)
+        srt = np.sort(scaled)[::-1]
+        e = np.exp(srt - srt[0])
+        cum = np.cumsum(e / e.sum())
+        cutoff = srt[int((cum < top_p).sum())]
+        scaled = np.where(scaled < cutoff, -1e30, scaled)
+        e = np.exp(scaled - scaled.max())
+        return e / e.sum()
+
+    def _committed_seq(self, slot: int) -> np.ndarray:
+        """The slot's committed sequence: effective prompt + tokens
+        generated SINCE activation (earlier generations are already baked
+        into the resume prompt). Its last token is ``last_tok`` — sampled
+        but not yet written to the target cache — so len == cur + 1."""
+        req = self.requests[int(self.slot_req[slot])]
+        g = int(self.gen[slot])
+        toks = np.asarray(req.tokens[len(req.tokens) - g:], np.int32)
+        return np.concatenate([self._pr(req), toks])
+
+    def _spec_draft(self, staged, seqs):
+        """Draft phase: catch each staged slot's draft cache up to its
+        committed frontier (chunked, for freshly admitted/replayed slots
+        whose draft cache is empty), then autoregressively propose up to
+        k_eff tokens per slot. Returns (props, qs) keyed by slot; qs[slot]
+        is None for greedy rows, else the per-proposal draft
+        distributions the accept rule needs."""
+        ns = self.num_slots
+        draft = self.draft_model
+        kmax = max(k for _, _, k in staged)
+        all_greedy = all(float(self.temps[s]) == 0.0 for s, _, _ in staged)
+        Cs = self.spec_k + 1
+
+        # ---- catch-up: wide chunks until every pending suffix fits the
+        # steady feed (pending >= 1 always — last_tok is never in cache)
+        CH = max(self.max_prompt_len, Cs)
+        while True:
+            pend_len = {s: len(seqs[s]) - int(self.draft_cur[s])
+                        for s, _, _ in staged}
+            if max(pend_len.values()) <= Cs:
+                break
+            ids = np.zeros((ns, CH), np.int32)
+            cl = np.zeros(ns, np.int32)
+            rp = np.zeros(ns, np.int32)
+            for s, _, _ in staged:
+                if pend_len[s] <= Cs:
+                    continue               # already caught up: no writes
+                n = min(pend_len[s] - 1, CH)   # keep >= 1 for the steady feed
+                dc = int(self.draft_cur[s])
+                ids[s, :n] = seqs[s][dc: dc + n]
+                cl[s] = n
+                rp[s] = dc
+            _, self._draft_cache = _FWD_ROWS_JIT(
+                draft, jnp.asarray(ids), self._draft_cache,
+                jnp.asarray(rp, jnp.int32), None,
+                jnp.asarray(cl, jnp.int32))
+            for s, _, _ in staged:
+                self.draft_cur[s] += int(cl[s])
+
+        # ---- steady feed: the pending suffix (<= k+1 tokens) in one
+        # fixed-width chunk; its last logit seeds the first proposal
+        ids = np.zeros((ns, Cs), np.int32)
+        cl = np.zeros(ns, np.int32)
+        rp = np.zeros(ns, np.int32)
+        for s, _, _ in staged:
+            dc = int(self.draft_cur[s])
+            pend = seqs[s][dc:]
+            ids[s, :len(pend)] = pend
+            cl[s] = len(pend)
+            rp[s] = dc
+        dl, self._draft_cache = _FWD_ROWS_JIT(
+            draft, jnp.asarray(ids), self._draft_cache,
+            jnp.asarray(rp, jnp.int32), None, jnp.asarray(cl, jnp.int32))
+        for s, _, _ in staged:
+            self.draft_cur[s] += int(cl[s])      # == cur + 1 now
+        dlast = jnp.take_along_axis(
+            dl, jnp.maximum(jnp.asarray(cl, jnp.int32) - 1,
+                            0)[:, None, None], axis=1)[:, 0]
+
+        props = {s: [] for s, _, _ in staged}
+        qs = {s: (None if float(self.temps[s]) == 0.0 else [])
+              for s, _, _ in staged}
+
+        def pick(slot, row):
+            temp = float(self.temps[slot])
+            if temp == 0.0:
+                return int(np.argmax(row))
+            z = np.asarray(row, np.float64) / temp
+            e = np.exp(z - z.max())
+            q = e / e.sum()
+            qs[slot].append(q)
+            return int(self._spec_rs.choice(q.size, p=q))
+
+        def pick_all(logits_2d, rows_feeding):
+            if all_greedy:       # fetch [ns] ints, never the [ns, V] block
+                am = np.asarray(jnp.argmax(
+                    logits_2d.astype(jnp.float32), axis=-1))
+                for s in rows_feeding:
+                    props[s].append(int(am[s]))
+            else:
+                full = np.asarray(logits_2d.astype(jnp.float32))
+                for s in rows_feeding:
+                    props[s].append(pick(s, full[s]))
+
+        pick_all(dlast, [s for s, _, _ in staged])
+        # ---- autoregressive proposal rounds (single-token feeds)
+        for r in range(1, kmax):
+            feeding = [s for s, _, k in staged if k > r]
+            if not feeding:
+                break
+            ids1 = np.zeros((ns, 1), np.int32)
+            cl1 = np.zeros(ns, np.int32)
+            rp1 = np.zeros(ns, np.int32)
+            for s in feeding:
+                ids1[s, 0] = props[s][-1]
+                cl1[s] = 1
+                rp1[s] = int(self.draft_cur[s])
+            dl1, self._draft_cache = _FWD_ROWS_JIT(
+                draft, jnp.asarray(ids1), self._draft_cache,
+                jnp.asarray(rp1, jnp.int32), None,
+                jnp.asarray(cl1, jnp.int32))
+            for s in feeding:
+                self.draft_cur[s] += 1           # == cur + r + 1
+            pick_all(dl1[:, 0], feeding)
+        return props, qs
+
+    def _spec_tick(self, elig):
+        """One draft-and-verify round for the eligible slots. Returns
+        (handled mask, emitted): handled slots advanced up to k_eff+1
+        tokens and skip this tick's one-token path.
+
+        Staging allocates verify coverage (cur + k_eff + 1 tokens) per
+        slot BEFORE any device work, protecting already-staged rows from
+        preemption — mirrors ``_prefill_chunks``. The ``serving.spec_verify``
+        fault point fires before the donating verify jit, so an injected
+        exception aborts with the cache, tables, and ledgers exactly as
+        the staging left them (staged blocks live in request tables — the
+        normal free path reclaims them) and the tick falls back to
+        one-token decode for every slot."""
+        handled = np.zeros(self.num_slots, bool)
+        emitted: list = []
+        ns = self.num_slots
+        # ---- stage: clamp k, allocate coverage for the worst case ----
+        staged = []                        # (slot, rid, k_eff)
+        staged_rids: set = set()
+        for slot in np.nonzero(elig)[0]:
+            slot = int(slot)
+            if not self.active[slot]:
+                continue                   # evicted by an earlier staging
+            rid = int(self.slot_req[slot])
+            k_cap = int(self.slot_k[slot]) if self.spec_adaptive \
+                else self.spec_k
+            k_eff = min(k_cap, int(self.max_gen[slot] - self.gen[slot]) - 1)
+            if k_eff < 1:
+                continue
+            t = self._allocate_or_preempt(
+                rid, int(self.cur[slot]) + k_eff + 1, protect=staged_rids)
+            if t is None:
+                continue                   # dry pool: one-token path today
+            self._update_resv(rid)
+            self.table_len[slot] = len(t)
+            staged.append((slot, rid, k_eff))
+            staged_rids.add(rid)
+        staged = [(s, r, k) for s, r, k in staged if self.active[s]]
+        if not staged:
+            return handled, emitted
+
+        seqs = {s: self._committed_seq(s) for s, _, _ in staged}
+        with _span("serving.draft", slots=len(staged)):
+            props, qs = self._spec_draft(staged, seqs)
+
+        # ---- verify: ONE batched target chunk over (slots, k_eff+1) ----
+        C = self.spec_k + 1
+        ids = np.zeros((ns, C), np.int32)
+        clens = np.zeros(ns, np.int32)
+        offs = np.zeros(ns, np.int32)
+        slot_ids = np.full(ns, ns, np.int32)
+        rows = np.full((ns, self.max_blocks_per_seq), self.mgr.num_blocks,
+                       np.int32)
+        for slot, rid, k_eff in staged:
+            ids[slot, 0] = self.last_tok[slot]
+            ids[slot, 1: 1 + k_eff] = props[slot][:k_eff]
+            clens[slot] = k_eff + 1
+            offs[slot] = self.cur[slot]
+            slot_ids[slot] = slot
+            t = self.mgr.tables[rid]
+            rows[slot, :len(t)] = t
+        try:
+            # chaos hook BEFORE the donating jit: an exception here must
+            # leave self.cache intact (exception atomicity) — after the
+            # donation there is no cache to fall back to
+            fault_point("serving.spec_verify", engine=self,
+                        slots=[s for s, _, _ in staged])
+        except Exception as e:
+            self.stats["spec_fallbacks"] += 1
+            _SPEC_FALLBACKS.inc()
+            FLIGHT.record("serving.spec_fallback",
+                          error=f"{type(e).__name__}: {e}")
+            # draft frontiers ran ahead of the commit that never came;
+            # roll them back so the next round re-feeds from the frontier
+            for slot, _, _ in staged:
+                self.draft_cur[slot] = min(int(self.draft_cur[slot]),
+                                           int(self.cur[slot]) + 1)
+            return np.zeros(self.num_slots, bool), []
+        t_dev = time.perf_counter()
+        with _span("serving.verify", slots=len(staged)):
+            logits, self.cache = _VERIFY_CHUNK_JIT(
+                self.model, jnp.asarray(ids), jnp.asarray(clens),
+                jnp.asarray(offs), self.cache, jnp.asarray(slot_ids),
+                jnp.asarray(rows))
+            logits = np.asarray(logits.astype(jnp.float32))
+        self.stats["device_s"] += time.perf_counter() - t_dev
+
+        # ---- accept/commit per slot; ONE batched length rewind after ----
+        rw_slots = np.full(ns, ns, np.int32)
+        rw_lens = np.zeros(ns, np.int32)
+        for slot, rid, k_eff in staged:
+            temp = float(self.temps[slot])
+            row = logits[slot]                        # [C, V]
+            if temp == 0.0:
+                vs = row[: k_eff + 1].argmax(axis=-1)
+                n_acc = int(greedy_accept_length(vs[:k_eff],
+                                                 props[slot][:k_eff]))
+                new = [int(x) for x in props[slot][:n_acc]] \
+                    + [int(vs[n_acc])]
+            else:
+                ps = [self._spec_probs(row[i], temp,
+                                       float(self.top_ps[slot]))
+                      for i in range(k_eff + 1)]
+                new, n_acc = stochastic_accept_row(
+                    props[slot][:k_eff], qs[slot], ps, self._spec_rs)
+            cur0 = int(self.cur[slot])
+            cur1 = cur0 + n_acc + 1
+            self.cur[slot] = cur1
+            rw_slots[slot] = slot
+            rw_lens[slot] = cur1
+            # draft frontier rolls back past rejected positions (stale
+            # entries are overwritten by the next round's feed)
+            self.draft_cur[slot] = min(int(self.draft_cur[slot]), cur1)
+            if self.spec_adaptive:
+                self._acc_ema[slot] = (0.5 * self._acc_ema[slot]
+                                       + 0.5 * (n_acc / k_eff))
+                self.slot_k[slot] = int(np.clip(
+                    round(self._acc_ema[slot] * self.spec_k), 1,
+                    self.spec_k))
+            self.stats["spec_proposed"] += k_eff
+            self.stats["spec_accepted"] += n_acc
+            _SPEC_PROPOSED.inc(k_eff)
+            _SPEC_ACCEPTED.inc(n_acc)
+            _SPEC_TOKENS.observe(len(new))
+            handled[slot] = True
+            for tok in new:
+                emitted += self._emit(slot, int(tok))
+                if self.slot_req[slot] < 0:
+                    break      # EOS/length finished the request mid-list:
+                    #            the rest of the accepted tokens is moot
+        if self.stats["spec_proposed"]:
+            _SPEC_RATE.set(self.stats["spec_accepted"]
+                           / self.stats["spec_proposed"])
+        # one rewind for all staged rows: length pointers only — verify
+        # wrote k_eff+1 positions, the commit kept n_acc+1 of them
+        self.cache = _REWIND_LENS_JIT(self.cache, jnp.asarray(rw_slots),
+                                      jnp.asarray(rw_lens))
+        self.stats["spec_ticks"] += 1
+        return handled, emitted
+
     # ------------------------------------------------------------- decode
-    def _grow_tables(self):
+    def _grow_tables(self, mask=None):
         """At most one new block per slot per tick; returns the incremental
-        (rows, cols, vals) update triple (sentinel-padded, fixed shape)."""
+        (rows, cols, vals) update triple (sentinel-padded, fixed shape).
+        ``mask`` restricts growth to those slots (spec-handled slots skip
+        the normal tick, so their updates must not ride a tick that may
+        never run — their tables grow in the verify staging instead)."""
         rows = np.full(self.num_slots, self.num_slots, np.int32)
         cols = np.zeros(self.num_slots, np.int32)
         vals = np.zeros(self.num_slots, np.int32)
-        crossing = self.active & ~self.is_beam & (
+        base = (self.active & ~self.is_beam) if mask is None else mask
+        crossing = base & (
             self.cur // self.block_size >= self.table_len)
         for slot in np.nonzero(crossing)[0]:     # ≤ once per bs ticks/slot
             if not self.active[slot]:
@@ -1153,16 +1515,35 @@ class LLMEngine:
         emitted += self._prefill_chunks()
         if not self.active.any():
             return emitted
+        # speculative draft-and-verify for eligible slots; the plain
+        # one-token tick then covers only what speculation did not handle
+        # (beam slots, final-token slots, fallback after an injected
+        # verify fault). PT_SPEC_DECODE=0 kills the whole path.
+        spec_handled = np.zeros(self.num_slots, bool)
+        if (self.draft_model is not None
+                and os.environ.get("PT_SPEC_DECODE", "1") != "0"):
+            elig = (self.active & ~self.is_beam
+                    & (self.max_gen - self.gen >= 2))
+            if elig.any():
+                spec_handled, spec_emitted = self._spec_tick(elig)
+                emitted += spec_emitted
+        run_mask = self.active & ~spec_handled
+        if not run_mask.any():
+            # every active slot advanced speculatively: the whole point —
+            # this tick paid ONE target forward for k+1 positions per slot
+            return emitted
         t0 = perf_counter()
-        rows, cols, vals = self._grow_tables()
+        rows, cols, vals = self._grow_tables(run_mask & ~self.is_beam)
+        # growth may have preempted slots — recompute the mask after it
+        run_mask = self.active & ~spec_handled
         self.rng, sub = jax.random.split(self.rng)
         t1 = perf_counter()
         nxt, logp, self.cache = _TICK_JIT(
             self.model, jnp.asarray(self.last_tok), self.cache,
-            jnp.asarray(self.active), jnp.asarray(rows), jnp.asarray(cols),
+            jnp.asarray(run_mask), jnp.asarray(rows), jnp.asarray(cols),
             jnp.asarray(vals), sub, jnp.asarray(self.temps),
             jnp.asarray(self.top_ps), self.top_k, bool(self.groups))
-        was_active = self.active.copy()
+        was_active = run_mask.copy()
         nxt = np.asarray(nxt)                 # the one per-tick host fetch
         t2 = perf_counter()
         for g in self.groups.values():        # device-resident, lazy gather

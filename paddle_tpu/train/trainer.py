@@ -76,6 +76,13 @@ class TrainerArgs:
     # save() snapshots to host and returns; the tmp+fsync+rename protocol
     # runs on a writer thread. fit() calls mgr.wait() at exit either way.
     async_ckpt: bool = False
+    # device-side double-buffered input: while step N executes on the
+    # accelerator, step N+1's microbatches are fetched from the iterator
+    # and shipped with jax.device_put, so the next dispatch never waits
+    # on a host->device transfer. Composes with any pipeline_depth
+    # (including 0); the dispatch sequence is unchanged, so losses stay
+    # bit-identical to the synchronous loop.
+    device_double_buffer: bool = False
 
 
 class Trainer:
@@ -147,7 +154,7 @@ class Trainer:
 
     def fit(self, data_iter, eval_fn: Optional[Callable] = None):
         try:
-            if self.args.pipeline_depth > 0:
+            if self.args.pipeline_depth > 0 or self.args.device_double_buffer:
                 return self._fit_pipelined(data_iter, eval_fn)
             return self._fit_sync(data_iter, eval_fn)
         except BaseException as e:
@@ -393,7 +400,9 @@ class Trainer:
                     and step_no % (args.log_every * 10) == 0):
                 eval_fn(self.state.model)
 
-        for _ in range(start_step, args.max_steps):
+        dbuf = args.device_double_buffer
+        staged_next = None      # step i+1's microbatches, already on device
+        for i in range(start_step, args.max_steps):
             # chaos hook rides the dispatch side (an exception here must
             # reach the elastic restart net immediately); the host step
             # prediction replaces int(state.step), which would sync
@@ -402,7 +411,10 @@ class Trainer:
             in_flight_before = len(window)
             t_disp = time.monotonic()
             with _span("train.step", step=drained + len(window)):
-                micro = [self._to_batch(next(it)) for _ in range(accum)]
+                if staged_next is not None:
+                    micro, staged_next = staged_next, None
+                else:
+                    micro = [self._to_batch(next(it)) for _ in range(accum)]
                 self.state, loss = self._step_fn(self.state, *micro)
             if in_flight_before > 0:
                 # host input/dispatch time spent while device steps were
@@ -411,6 +423,16 @@ class Trainer:
             ntok = sum(int(np.prod(b[0].shape[:2])) for b in micro
                        if hasattr(b[0], "shape") and b[0].ndim >= 2)
             window.append((loss, t_disp, ntok))
+            if dbuf and i + 1 < args.max_steps:
+                # the step just dispatched is executing: fetch the NEXT
+                # step's batches and start their host->device transfers
+                # now so the next dispatch finds them resident. device_put
+                # is async — this overlaps transfer with compute.
+                t_pf = time.monotonic()
+                staged_next = [
+                    tuple(jax.device_put(x) for x in self._to_batch(b))
+                    for b in [next(it) for _ in range(accum)]]
+                hidden_host_s += time.monotonic() - t_pf
             while len(window) > depth:
                 drain_one()
             # drain fully when the just-dispatched step lands on a

@@ -12,6 +12,9 @@ rule is installed). Tests install rules against site names:
     serving.alloc    block allocation inside the engine (MemoryError)
     serving.tick     top of ``LLMEngine.step`` (exception / stall)
     serving.preempt  induced preemption (rule action receives the engine)
+    serving.spec_verify  before the speculative verify forward — an
+                     exception aborts the spec round exception-atomically
+                     and the tick falls back to one-token decode
     train.step       top of each trainer step (exception / stall)
     train.loss       loss override — return value replaces the real loss
                      (NaN injection)

@@ -200,6 +200,50 @@ def test_pipelined_fit_emits_drain_spans():
     assert names.count("train.drain") == 4
 
 
+@pytest.mark.parametrize("depth", [0, 2])
+def test_device_double_buffer_bit_identical(depth):
+    """device_double_buffer stages step N+1's microbatches while step N
+    executes; the dispatch sequence is unchanged, so losses and params
+    must be bit-identical to the plain loop — including at depth 0,
+    where double-buffering alone routes through the pipelined loop."""
+    data = _fixed_batches()
+    s_ref = _make_trainer(12, depth).fit(iter(data))
+    tr_ref = _make_trainer(12, depth)
+    s_ref = tr_ref.fit(iter(data))
+    tr_db = _make_trainer(12, depth, device_double_buffer=True)
+    s_db = tr_db.fit(iter(data))
+    assert int(s_db.step) == int(s_ref.step) == 12
+    for ha, hb in zip(tr_ref.history, tr_db.history):
+        assert ha["step"] == hb["step"]
+        assert ha["loss"] == hb["loss"]          # bit-identical
+    for pa, pb in zip(jax.tree_util.tree_leaves(s_ref.model),
+                      jax.tree_util.tree_leaves(s_db.model)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_device_double_buffer_consumes_no_extra_batches():
+    """The lookahead must stop one step early — exactly max_steps*accum
+    batches are drawn, same as the synchronous loop (a finite iterator
+    sized to the run must not StopIteration)."""
+    data = _fixed_batches(n=8)               # exactly 8 steps of batches
+    tr = _make_trainer(8, 0, device_double_buffer=True,
+                       grad_accum_steps=1)
+    state = tr.fit(iter(data))               # would raise if it over-read
+    assert int(state.step) == 8
+
+
+def test_device_double_buffer_with_grad_accum_bit_identical():
+    data = _fixed_batches(n=12)
+    tr_ref = _make_trainer(6, 0, grad_accum_steps=2)
+    s_ref = tr_ref.fit(iter(data))
+    tr_db = _make_trainer(6, 2, grad_accum_steps=2,
+                          device_double_buffer=True)
+    s_db = tr_db.fit(iter(data))
+    for pa, pb in zip(jax.tree_util.tree_leaves(s_ref.model),
+                      jax.tree_util.tree_leaves(s_db.model)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
 def test_pipelined_async_ckpt_end_to_end(tmp_path):
     """pipeline_depth + async_ckpt together: fit() returning implies the
     final checkpoint is durable (fit calls mgr.wait() at exit)."""

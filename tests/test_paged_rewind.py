@@ -1,0 +1,193 @@
+"""Paged multi-append + rewind helpers (ISSUE 5): the length-pointer
+rollback that speculative verification relies on. Rewind touches ONLY
+``cache.lens`` — block tables stay intact, stale pool entries beyond
+the new length are masked by attention and positionally overwritten by
+the next append."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.paged import (PagedKVCache, RefBlockManager,
+                                     greedy_accept_length,
+                                     llama_prefill_chunk_paged,
+                                     llama_prefill_paged,
+                                     llama_verify_chunk_paged,
+                                     spec_advance_frontiers,
+                                     spec_rewind_lens,
+                                     stochastic_accept_row)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _fresh(cfg, nb=16, bs=4, slots=2, mb=8):
+    return PagedKVCache.init(cfg.num_hidden_layers, nb, bs,
+                             cfg.num_key_value_heads,
+                             cfg.hidden_size // cfg.num_attention_heads,
+                             slots, mb, cfg.dtype)
+
+
+def _prefill(model, cache, slot, seq, mgr, key, mb=8):
+    t = mgr.allocate(key, len(seq))
+    rows = np.full((1, mb), mgr.num_blocks, np.int32)
+    rows[0, :len(t)] = t
+    last, cache = llama_prefill_paged(
+        model, jnp.asarray(np.asarray(seq)[None]),
+        jnp.asarray([len(seq)]), cache,
+        jnp.asarray([slot], jnp.int32), jnp.asarray(rows))
+    return last, cache, t
+
+
+# ------------------------------------------------------- pure-state unit
+
+def test_rewind_touches_only_lens(model):
+    cache = _fresh(model.cfg)
+    cache = PagedKVCache(cache.k_pools, cache.v_pools, cache.block_tables,
+                         cache.lens.at[:].set(jnp.asarray([11, 5])))
+    tables_before = np.asarray(cache.block_tables).copy()
+    out = spec_rewind_lens(cache, jnp.asarray([0], jnp.int32),
+                           jnp.asarray([7], jnp.int32))
+    assert np.asarray(out.lens).tolist() == [7, 5]
+    np.testing.assert_array_equal(np.asarray(out.block_tables),
+                                  tables_before)
+    # sentinel slot ids (OOB) must drop, not clamp onto the last row
+    out2 = spec_rewind_lens(out, jnp.asarray([0, 99], jnp.int32),
+                            jnp.asarray([3, 1], jnp.int32))
+    assert np.asarray(out2.lens).tolist() == [3, 5]
+
+
+def test_advance_frontiers_scalar_and_array():
+    pos, dpos = spec_advance_frontiers(10, 12, 3)
+    assert (pos, dpos) == (13, 12)
+    pos, dpos = spec_advance_frontiers(10, 15, 2)
+    assert (pos, dpos) == (12, 12)      # frontier clamped back to pos
+    p, d = spec_advance_frontiers(np.array([4, 8]), np.array([9, 8]),
+                                  np.array([1, 3]))
+    assert p.tolist() == [5, 11] and d.tolist() == [5, 8]
+
+
+def test_greedy_accept_length_shapes():
+    assert int(greedy_accept_length(np.array([3, 5, 7]), [3, 5, 9])) == 2
+    assert int(greedy_accept_length(np.array([3, 5, 7]), [1, 5, 7])) == 0
+    assert int(greedy_accept_length(np.array([3, 5, 7]), [3, 5, 7])) == 3
+    out = greedy_accept_length(np.array([[1, 2], [1, 2]]),
+                               np.array([[1, 9], [1, 2]]))
+    assert out.tolist() == [1, 2]
+
+
+def test_stochastic_accept_row_extremes():
+    rs = np.random.RandomState(0)
+    V = 8
+    q = np.zeros(V); q[3] = 1.0
+    # p == q on the proposal: always accepted, bonus from p[last]
+    p_acc = [q.copy(), q.copy()]
+    bonus = np.zeros(V); bonus[5] = 1.0
+    new, n_acc = stochastic_accept_row([3], [q], [q, bonus], rs)
+    assert (new, n_acc) == ([3, 5], 1)
+    # p puts zero mass on the proposal: rejected, resample from p - q
+    p0 = np.zeros(V); p0[6] = 1.0
+    new, n_acc = stochastic_accept_row([3], [q], [p0, bonus], rs)
+    assert (new, n_acc) == ([6], 0)
+
+
+# -------------------------------------------- functional rewind + reuse
+
+def test_rewind_past_block_boundary_then_reappend(model):
+    """Verify writes 5 tokens crossing into a third block (lens 6→11),
+    rewind keeps one (lens 7 — back across the block-2 boundary at 8),
+    then appending the real continuation over the stale region yields
+    logits identical to a straight prefill of the committed sequence."""
+    cfg = model.cfg
+    rs = np.random.RandomState(0)
+    seq0 = rs.randint(0, 64, (6,))
+    vtoks = rs.randint(0, 64, (5,))          # speculative: positions 6..10
+    cont = rs.randint(0, 64, (3,))           # real continuation: 7..9
+
+    mgr = RefBlockManager(16, 4)
+    cache = _fresh(cfg)
+    _, cache, _ = _prefill(model, cache, 0, seq0, mgr, "a")
+    t = mgr.allocate("a", 11)                # cover the verify worst case
+    rows = np.full((1, 8), 16, np.int32)
+    rows[0, :len(t)] = t
+    _, cache = llama_verify_chunk_paged(
+        model, jnp.asarray(vtoks[None]), jnp.asarray([5], jnp.int32),
+        jnp.asarray([6], jnp.int32), cache, jnp.asarray([0], jnp.int32),
+        jnp.asarray(rows))
+    assert int(np.asarray(cache.lens)[0]) == 11
+    cache = spec_rewind_lens(cache, jnp.asarray([0], jnp.int32),
+                             jnp.asarray([7], jnp.int32))
+    assert int(np.asarray(cache.lens)[0]) == 7
+    last, cache = llama_prefill_chunk_paged(
+        model, jnp.asarray(cont[None]), jnp.asarray([3], jnp.int32),
+        jnp.asarray([7], jnp.int32), cache, jnp.asarray([0], jnp.int32),
+        jnp.asarray(rows))
+
+    committed = np.concatenate([seq0, vtoks[:1], cont])
+    ref_last, _, _ = _prefill(model, _fresh(cfg), 0, committed,
+                              RefBlockManager(16, 4), "ref")
+    np.testing.assert_allclose(np.asarray(last, np.float32),
+                               np.asarray(ref_last, np.float32),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_rewind_to_zero_reuses_slot(model):
+    """Full rollback: lens→0 leaves the slot reusable for an unrelated
+    sequence over the same block rows."""
+    cfg = model.cfg
+    rs = np.random.RandomState(1)
+    seq0 = rs.randint(0, 64, (9,))
+    seq1 = rs.randint(0, 64, (7,))
+
+    mgr = RefBlockManager(16, 4)
+    cache = _fresh(cfg)
+    _, cache, t = _prefill(model, cache, 0, seq0, mgr, "a")
+    cache = spec_rewind_lens(cache, jnp.asarray([0], jnp.int32),
+                             jnp.asarray([0], jnp.int32))
+    assert int(np.asarray(cache.lens)[0]) == 0
+    rows = np.full((1, 8), 16, np.int32)
+    rows[0, :len(t)] = t
+    last, cache = llama_prefill_chunk_paged(
+        model, jnp.asarray(seq1[None]), jnp.asarray([7], jnp.int32),
+        jnp.asarray([0], jnp.int32), cache, jnp.asarray([0], jnp.int32),
+        jnp.asarray(rows))
+    ref_last, _, _ = _prefill(model, _fresh(cfg), 0, seq1,
+                              RefBlockManager(16, 4), "ref")
+    np.testing.assert_allclose(np.asarray(last, np.float32),
+                               np.asarray(ref_last, np.float32),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_rewind_after_preempt_replay_in_engine(model):
+    """Engine-level: rewinds interleaved with evict/replay (the draft
+    frontier resets to zero on preemption) still produce the exact
+    greedy chain."""
+    from paddle_tpu.serving import LLMEngine, Request
+    from paddle_tpu.utils.faults import FAULTS
+    rs = np.random.RandomState(4)
+    prompts = [rs.randint(0, 64, (int(l),)) for l in rs.randint(4, 12, 3)]
+
+    def run(eng):
+        for p in prompts:
+            eng.add_request(Request(p, max_new_tokens=8))
+        return {r: list(map(int, t)) for r, t in eng.run().items()}
+
+    base = run(LLMEngine(model, num_slots=2, block_size=4,
+                         max_prompt_len=16, max_seq_len=32,
+                         preemption=True))
+    FAULTS.clear()
+    FAULTS.install("serving.preempt", every=4, times=5,
+                   action=lambda ctx: ctx["engine"]._preempt())
+    eng = LLMEngine(model, draft_model=model, spec_k=3, num_slots=2,
+                    block_size=4, max_prompt_len=16, max_seq_len=32,
+                    preemption=True)
+    spec = run(eng)
+    FAULTS.clear()
+    assert eng.stats["preemptions"] > 0
+    assert spec == base
+    eng.assert_quiescent()
