@@ -114,7 +114,8 @@ def cpu_legs_main():
     backend-independent metrics sub-objects."""
     out = {}
     for key, fn in (("host_overlap", bench_host_overlap),
-                    ("serving_spec", bench_serving_spec)):
+                    ("serving_spec", bench_serving_spec),
+                    ("serving_moe", bench_serving_moe)):
         try:
             out[key] = fn()
         except Exception as e:  # noqa: BLE001 — per-leg isolation
@@ -123,7 +124,7 @@ def cpu_legs_main():
     from paddle_tpu.observability import METRICS
     out["counters"] = {
         k: v for k, v in METRICS.snapshot()["counters"].items()
-        if k.startswith("serving_spec_")}
+        if k.startswith(("serving_spec_", "serving_prefix_", "moe_"))}
     print(json.dumps(out))
 
 
@@ -379,8 +380,19 @@ def bench_gpt3_tp(on_tpu, sync):
 
 def bench_moe_ep(on_tpu, sync):
     """BASELINE config 5: ERNIE-MoE-class expert-parallel LM (top-2 gate,
-    sort-based dispatch; the ep all_to_all is exercised whenever the mesh
-    has ep>1 — ep=1 on the single bench chip). tokens/sec."""
+    DROPLESS sort-based dispatch through the grouped GEMM; the ep
+    all_to_all is exercised whenever the mesh has ep>1 — ep=1 on the
+    single bench chip). Times the train step under both MoE lowerings —
+    PT_GROUPED_GEMM=0 (capacity-padded dense dispatch) vs grouped — and
+    reports both; ``value`` is the grouped (shipping-path) number.
+
+    Leg reshape vs r05 (recorded below): previously capacity_factor=1.25
+    with moe_every=2 on LlamaConfig.tiny, dense path only. Dropless mode
+    makes the comparison meaningful — the dense fallback must pad every
+    expert to the worst case (cap = T rows, an E/k x FLOPs tax; 4x here)
+    while the grouped GEMM does exactly sum(counts)=T*k rows."""
+    import os
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -398,14 +410,18 @@ def bench_moe_ep(on_tpu, sync):
                            intermediate_size=2816, num_hidden_layers=8,
                            num_attention_heads=16, num_key_value_heads=16,
                            dtype=jnp.bfloat16, remat=True)
-        mcfg = MoEConfig(base=base, num_experts=8, top_k=2, moe_every=2)
+        mcfg = MoEConfig(base=base, num_experts=8, top_k=2, moe_every=2,
+                         capacity_factor=None)
         batch, seq, iters = 4, 1024, 10
     else:
-        mcfg = MoEConfig(base=LlamaConfig.tiny(), num_experts=4, top_k=2,
-                         moe_every=2)
-        batch, seq, iters = 2, 32, 2
-    pt.seed(0)
-    model = MoEForCausalLM(mcfg)
+        # MoE-heavy smoke: every layer routed, fat experts relative to
+        # attention, so the dispatch lowering is what the clock sees
+        base = LlamaConfig.tiny(hidden_size=128, intermediate_size=512,
+                                num_attention_heads=4,
+                                num_key_value_heads=2)
+        mcfg = MoEConfig(base=base, num_experts=8, top_k=2, moe_every=1,
+                         capacity_factor=None)
+        batch, seq, iters = 2, 256, 3
     optimizer = opt.AdamW(learning_rate=2e-4)
     rs = np.random.RandomState(0)
     v = mcfg.base.vocab_size
@@ -417,21 +433,57 @@ def bench_moe_ep(on_tpu, sync):
         return m.loss(ids, labels)
 
     mesh = HybridMesh(ep=n)
-    with mesh:
-        state = init_state(model, optimizer, mesh)
-        step = make_train_step(loss_fn, optimizer, mesh)
-        carry = [state]
+    saved = os.environ.get("PT_GROUPED_GEMM")
+    legs = {}
+    try:
+        with mesh:
+            # PT_GROUPED_GEMM is read at trace time, so each leg builds
+            # its own model/state/step (the step DONATES its state — a
+            # shared init would be a deleted buffer on the second leg)
+            for label, env in (("dense", "0"), ("grouped", "1")):
+                os.environ["PT_GROUPED_GEMM"] = env
+                pt.seed(0)
+                model = MoEForCausalLM(mcfg)
+                step = make_train_step(loss_fn, optimizer, mesh)
+                carry = [init_state(model, optimizer, mesh)]
 
-        def one():
-            carry[0], loss = step(carry[0], ids, labels)
-            return loss
+                def one():
+                    carry[0], loss = step(carry[0], ids, labels)
+                    return loss
 
-        sync(one())
-        sync(one())
-        dt = _timeit(one, sync, iters)
-    return {"value": round(batch * seq / dt, 1), "unit": "tokens/sec",
-            "step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
-            "ep": n, "experts": mcfg.num_experts}
+                sync(one())
+                sync(one())
+                legs[label] = _timeit(one, sync, iters)
+    finally:
+        if saved is None:
+            os.environ.pop("PT_GROUPED_GEMM", None)
+        else:
+            os.environ["PT_GROUPED_GEMM"] = saved
+
+    # the dropless layer never drops — feed the counter the measured
+    # truth (a capacity-mode deployment would land its real drop count).
+    # Probe a fresh layer: the benched model's buffers were donated away.
+    from paddle_tpu.distributed.moe import MoELayer
+    from paddle_tpu.serving import _MOE_DROPPED
+    pt.seed(0)
+    probe = MoELayer(mcfg.base.hidden_size, mcfg.base.intermediate_size,
+                     mcfg.num_experts, k=mcfg.top_k,
+                     capacity_factor=mcfg.capacity_factor,
+                     dtype=mcfg.base.dtype)
+    _, _, m = probe(jnp.asarray(
+        rs.standard_normal((1, seq, mcfg.base.hidden_size)),
+        mcfg.base.dtype), return_metrics=True)
+    _MOE_DROPPED.inc(int(round(float(m["drop_rate"]) * seq * mcfg.top_k)))
+
+    tps = batch * seq / legs["grouped"]
+    return {"value": round(tps, 1), "unit": "tokens/sec",
+            "dense_tokens_per_sec": round(batch * seq / legs["dense"], 1),
+            "grouped_speedup": round(legs["dense"] / legs["grouped"], 3),
+            "step_ms": round(legs["grouped"] * 1e3, 2),
+            "batch": batch, "seq": seq,
+            "ep": n, "experts": mcfg.num_experts, "dropless": True,
+            # r05 value under the old leg shape, for continuity
+            "r05_dense_capacity_tokens_per_sec": 53300.0}
 
 
 def bench_host_overlap():
@@ -584,6 +636,80 @@ def bench_serving_spec():
     }
 
 
+def bench_serving_moe():
+    """MoE serving leg (ISSUE 6): engine decode tokens/sec through a
+    small Mixtral-shaped model, grouped GEMM vs the dense capacity
+    fallback (PT_GROUPED_GEMM=0). Mixtral routes dropless, so the dense
+    fallback pads every expert to cap=T rows — an E/k x FLOPs tax (4x at
+    8 experts top-2) the grouped path never pays. The config is
+    MLP-heavy (intermediate 4x hidden, every layer routed) so expert
+    dispatch dominates decode the way it does at scale. Greedy, so the
+    off/on token streams must be identical. CPU-safe."""
+    import os
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    from paddle_tpu.models.paged import clear_jit_caches
+    from paddle_tpu.serving import LLMEngine, Request
+
+    pt.seed(0)
+    cfg = MixtralConfig.tiny(vocab_size=512, hidden_size=128,
+                             intermediate_size=512, num_hidden_layers=2,
+                             num_attention_heads=4, num_key_value_heads=2,
+                             num_local_experts=8, num_experts_per_tok=2,
+                             max_position_embeddings=128)
+    model = MixtralForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    # continuous-batching regime: the grouped GEMM pays a fixed sort/
+    # segment cost per tick, so its win shows above ~128 decode tokens
+    # per tick — exactly where a production engine runs (vLLM-style
+    # hundreds of slots), and where the dense fallback's cap=T padding
+    # explodes quadratically (experts x tokens rows per tick)
+    n_req, n_slots = 192, 192
+    prompts = [rs.randint(0, cfg.vocab_size, (int(l),))
+               for l in rs.randint(4, 16, size=n_req)]
+    max_new = 16
+
+    def run(ps):
+        eng = LLMEngine(model, num_slots=n_slots, block_size=8,
+                        max_prompt_len=16, max_seq_len=48)
+        for p in ps:
+            eng.add_request(Request(p, max_new_tokens=max_new))
+        return eng.run()
+
+    saved = os.environ.get("PT_GROUPED_GEMM")
+    results = {}
+    try:
+        for label, env in (("dense", "0"), ("grouped", "1")):
+            os.environ["PT_GROUPED_GEMM"] = env
+            clear_jit_caches()      # env is baked in at trace time
+            run(prompts[:2])        # warmup / compile this lowering
+            # (the tick is fixed-shape over num_slots, so a 2-request
+            # warmup compiles the same programs the full batch runs)
+            t0 = time.perf_counter()
+            out = run(prompts)
+            dt = time.perf_counter() - t0
+            ntok = sum(len(t) for t in out.values())
+            results[label] = (ntok / dt,
+                              {r: list(map(int, t)) for r, t in out.items()})
+    finally:
+        if saved is None:
+            os.environ.pop("PT_GROUPED_GEMM", None)
+        else:
+            os.environ["PT_GROUPED_GEMM"] = saved
+        clear_jit_caches()
+    dense_tps, dense_out = results["dense"]
+    grouped_tps, grouped_out = results["grouped"]
+    return {
+        "dense_tokens_per_sec": round(dense_tps, 1),
+        "grouped_tokens_per_sec": round(grouped_tps, 1),
+        "speedup": round(grouped_tps / dense_tps, 3),
+        "match": grouped_out == dense_out,   # greedy: must be identical
+        "experts": cfg.num_local_experts, "top_k": cfg.num_experts_per_tok,
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -711,6 +837,14 @@ def main():
         print(f"bench config serving_spec failed: {e!r}", file=sys.stderr)
         serving_spec = {"error": f"{type(e).__name__}: {e}"}
 
+    # MoE serving: decode tokens/sec grouped GEMM vs the dense capacity
+    # fallback on a Mixtral-shaped engine — backend-independent
+    try:
+        serving_moe = bench_serving_moe()
+    except Exception as e:  # noqa: BLE001 — per-config isolation
+        print(f"bench config serving_moe failed: {e!r}", file=sys.stderr)
+        serving_moe = {"error": f"{type(e).__name__}: {e}"}
+
     # honest config label: the CPU-smoke fallback runs LlamaConfig.tiny(),
     # not the 0.8B geometry — name the metric by what actually ran
     size_tag = f"{n_params / 1e9:.1f}b" if n_params >= 5e7 else f"{n_params:,}-param smoke"
@@ -740,9 +874,11 @@ def main():
         "compile": compile_obj,
         "counters": {k: v for k, v in snap["counters"].items()
                      if k.startswith(("collective_", "faults_",
-                                      "serving_spec_"))},
+                                      "serving_spec_", "serving_prefix_",
+                                      "moe_"))},
         "host_overlap": host_overlap,
         "serving_spec": serving_spec,
+        "serving_moe": serving_moe,
     }
     print(json.dumps({
         "metric": f"llama-{size_tag} bf16 train step tokens/sec/chip (MFU in extra)",

@@ -19,6 +19,9 @@ def main():
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--fsdp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--ep", type=int, default=2,
+                    help="expert-parallel width for the MoE loss-equality "
+                         "leg (0/1 skips it)")
     args = ap.parse_args()
 
     # flags must be in place BEFORE the backend initialises (first
@@ -66,6 +69,25 @@ def main():
             state, loss = step(state, ids, labels)
             print(f"step {i} loss {float(loss):.4f} "
                   f"(mesh dp={args.dp} fsdp={args.fsdp} tp={args.tp})")
+
+    if args.ep > 1:
+        # expert-parallel leg: the MoE loss under an ep mesh (experts
+        # sharded, tokens all-to-all'd through the grouped GEMM) must
+        # equal the single-device loss on the same batch
+        from paddle_tpu.models.moe_llm import MoEConfig, MoEForCausalLM
+        pt.seed(0)
+        moe_cfg = MoEConfig(base=cfg, num_experts=4, top_k=2,
+                            capacity_factor=None, moe_every=1)
+        moe = MoEForCausalLM(moe_cfg)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (2, 16)))
+        labels = jnp.concatenate(
+            [ids[:, 1:], -100 * jnp.ones((2, 1), ids.dtype)], axis=1)
+        ref = float(moe.loss(ids, labels))
+        ep_mesh = HybridMesh(ep=args.ep, devices=jax.devices()[:args.ep])
+        with ep_mesh:
+            ep_loss = float(moe.loss(ids, labels))
+        print(f"moe loss single={ref:.6f} ep{args.ep}={ep_loss:.6f}")
+        np.testing.assert_allclose(ep_loss, ref, rtol=2e-5)
     return float(loss)
 
 

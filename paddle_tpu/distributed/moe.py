@@ -20,10 +20,21 @@ TPU-native design, two layers:
   local tokens with LOCAL capacity (the reference's per-rank capacity
   semantics), builds an ``[E, C_local, H]`` send buffer, and a
   ``lax.all_to_all`` exchanges expert slices — the literal ``c_alltoall``
-  the reference hand-codes, here riding ICI. Experts then run on
-  ``[E_local, ep·C_local, H]`` and a reverse all_to_all returns results.
-  Token results are invariant to slot order, so with no drops this equals
-  the single-device layer exactly.
+  the reference hand-codes, here riding ICI. Token results are invariant
+  to slot order, so with no drops this equals the single-device layer
+  exactly.
+
+**Expert compute is a grouped GEMM** (``ops/pallas/grouped_matmul``): the
+sorted route already lays tokens out contiguously per expert, so the MLP
+runs directly over the ragged row partition — per-expert row offsets, no
+``[E, C]`` slot padding in the FLOPs (MegaBlocks-style dropless; with
+``capacity_factor=None`` nothing is ever dropped). On the EP path the
+``[E, C_local, H]`` all_to_all wire format is kept, but each rank compacts
+the received slots (occupancy counts ride a second tiny all_to_all) and
+runs its local experts over ``sum(counts)`` rows instead of
+``E_local·ep·C_local`` padded slots. ``PT_GROUPED_GEMM=0`` restores the
+dense capacity-padded dispatch/compute path bit-for-bit (read at trace
+time; re-trace after flipping).
 
 The gate also reports a **drop rate** (fraction of routing choices that
 overflowed capacity) so saturation is observable (the reference exposes
@@ -38,6 +49,10 @@ from jax.sharding import PartitionSpec as P
 from paddle_tpu.core.dtypes import get_default_dtype
 from paddle_tpu.core.module import Module
 from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops.pallas.grouped_matmul import (
+    grouped_gemm_enabled,
+    grouped_matmul,
+)
 
 
 def _gate_probs(logits, k, renormalize=True):
@@ -107,6 +122,10 @@ def top_k_route(logits, k: int, capacity: int, renormalize: bool = True):
       keep  bool   pos < capacity (False = dropped)
       gate  f32    renormalised combine weight
 
+    plus ``counts`` — the [E] per-expert assignment totals (pre-drop):
+    exactly the segment sizes of the sorted layout, i.e. the
+    ``group_sizes`` argument of the grouped GEMM.
+
     Identical keep/drop decisions to ``top_k_gate`` by construction: the
     flat assignment list is laid out choice-major (all j=0 entries before
     j=1) and the stable argsort preserves that order within each expert.
@@ -130,7 +149,7 @@ def top_k_route(logits, k: int, capacity: int, renormalize: bool = True):
     # me/ce ride along so a distributed caller can pmean them for the
     # exact global aux loss without recomputing the gate
     route = dict(tok=flat_tok[order], expert=se, pos=pos, keep=keep,
-                 gate=flat_gate[order], me=me, ce=ce)
+                 gate=flat_gate[order], me=me, ce=ce, counts=counts)
     aux = e * jnp.sum(me * ce)
     drop_rate = 1.0 - jnp.mean(keep.astype(jnp.float32))
     return route, aux, drop_rate
@@ -186,6 +205,30 @@ def expert_mlp_apply(x_e, gate_up, down):
     return jnp.einsum("ecm,emh->ech", act, down)
 
 
+def grouped_mlp_apply(x_sorted, gate_up, down, group_sizes):
+    """SwiGLU over the ragged sorted layout: ``x_sorted`` [N, H] rows
+    contiguous per expert, ``group_sizes`` [E] segment sizes. Two grouped
+    GEMMs — FLOPs track N, not E·capacity."""
+    gu = grouped_matmul(x_sorted, gate_up, group_sizes)
+    gate, up = jnp.split(gu, 2, axis=-1)
+    act = jax.nn.silu(gate) * up
+    return grouped_matmul(act, down, group_sizes)
+
+
+def grouped_forward(xt, route, gate_up, down, num_tokens: int):
+    """Sorted-layout expert forward + combine: gather tokens into
+    expert-sorted rows (``route`` is already sorted), run the grouped
+    SwiGLU over segment offsets, scatter-add back by source token with
+    gate x keep weights. Dropped assignments ride through the GEMM with
+    weight zero — identical results to the capacity path, without the
+    ``[E, C, H]`` dispatch buffer."""
+    x_sorted = xt[route["tok"]]
+    y_sorted = grouped_mlp_apply(x_sorted, gate_up, down, route["counts"])
+    wgt = (route["gate"] * route["keep"]).astype(y_sorted.dtype)
+    yt = jnp.zeros((num_tokens, xt.shape[1]), y_sorted.dtype)
+    return yt.at[route["tok"]].add(y_sorted * wgt[:, None], mode="drop")
+
+
 class MoELayer(Module):
     """Drop-in MLP replacement (ref MoELayer). Sort-based routing
     everywhere; under a mesh with ep > 1 the forward is a shard_map whose
@@ -233,9 +276,13 @@ class MoELayer(Module):
         logits = xt.astype(jnp.float32) @ self.gate_w
         route, aux, drop = top_k_route(logits, self.k, cap,
                                        self.norm_topk_prob)
-        x_e, dest = sparse_dispatch(xt, route, e, cap)
-        y_e = self.experts(x_e)
-        yt = sparse_combine(y_e, route, dest, t)
+        if grouped_gemm_enabled():
+            yt = grouped_forward(xt, route, self.experts.gate_up,
+                                 self.experts.down, t)
+        else:
+            x_e, dest = sparse_dispatch(xt, route, e, cap)
+            y_e = self.experts(x_e)
+            yt = sparse_combine(y_e, route, dest, t)
         return yt.reshape(b, s, h), aux, drop
 
     # -- expert-parallel path: shard_map + all_to_all over the ep axis ------
@@ -246,25 +293,30 @@ class MoELayer(Module):
         if e % ep != 0:
             raise ValueError(f"num_experts={e} not divisible by ep={ep}")
         b, s, h = x.shape
-        # tokens are sharded over ALL data axes, not just ep
+        # tokens are sharded over ALL data axes, not just ep — over the
+        # FLATTENED token dim, so any (b, s) with b*s divisible by the
+        # shard count works (serving's chunked prefill runs b=1). When b
+        # itself divides, each shard gets the same whole sequences as the
+        # old batch-dim sharding (row-major flatten), so results are
+        # unchanged.
         data_shards = mesh.dp * mesh.fsdp * ep
-        if b % data_shards != 0:
+        t = b * s
+        if t % data_shards != 0:
             raise ValueError(
-                f"batch {b} not divisible by dp*fsdp*ep={data_shards} "
+                f"tokens {t} (= {b}x{s}) not divisible by "
+                f"dp*fsdp*ep={data_shards} "
                 "(tokens are sharded over the data axes)")
         # LOCAL capacity — the reference's per-rank semantics: each rank may
         # fill at most C_local slots of each (global) expert
-        cap = self._capacity((b // data_shards) * s)
+        cap = self._capacity(t // data_shards)
         k = self.k
         renorm = self.norm_topk_prob
 
         batch_axes = ("dp", "fsdp", "ep")
-        xspec = P(batch_axes, None, None)
+        xspec = P(batch_axes, None)
 
-        def local(xl, gate_w, gate_up, down):
-            bl, sl, hl = xl.shape
-            tl = bl * sl
-            xt = xl.reshape(tl, hl)
+        def local(xt, gate_w, gate_up, down):
+            tl, hl = xt.shape
             logits = xt.astype(jnp.float32) @ gate_w
             route, _, _ = top_k_route(logits, k, cap, renorm)
             # exact global aux loss: pmean the gate's ingredients
@@ -281,20 +333,53 @@ class MoELayer(Module):
             x_send = x_send.reshape(ep, e // ep, cap, hl)
             x_recv = jax.lax.all_to_all(x_send, "ep", split_axis=0,
                                         concat_axis=0)
-            # experts are row-independent: fold senders into the slot dim
-            x_loc = jnp.swapaxes(x_recv, 0, 1).reshape(e // ep, ep * cap, hl)
-            y_loc = expert_mlp_apply(x_loc, gate_up, down)
+            el = e // ep
+            if grouped_gemm_enabled():
+                # occupancy counts ride a second (tiny) all_to_all:
+                # cnt_recv[s, el] = slots shard s filled for my expert el.
+                # Kept assignments fill slots 0..kept-1 contiguously, so
+                # the received ragged rows compact into per-expert
+                # segments and the MLP runs over sum(counts) rows instead
+                # of el*ep*cap padded slots.
+                kept = route["keep"].astype(jnp.int32)
+                cnt_send = jnp.zeros((e,), jnp.int32).at[route["expert"]] \
+                    .add(kept).reshape(ep, el)
+                cnt_recv = jax.lax.all_to_all(cnt_send, "ep", split_axis=0,
+                                              concat_axis=0)
+                flat = jnp.swapaxes(x_recv, 0, 1).reshape(el * ep * cap, hl)
+                sizes = jnp.sum(cnt_recv, axis=0)             # [el]
+                seg_start = jnp.cumsum(sizes) - sizes
+                # rank of slot (el, s, c) within its expert's segment:
+                # senders before s, then c within sender s
+                before = (jnp.cumsum(cnt_recv, 0) - cnt_recv).T  # [el, ep]
+                c_idx = jnp.arange(cap)[None, None, :]
+                valid = c_idx < cnt_recv.T[:, :, None]
+                destc = jnp.where(
+                    valid,
+                    (seg_start[:, None] + before)[:, :, None] + c_idx,
+                    el * ep * cap).reshape(-1)
+                xc = jnp.zeros((el * ep * cap, hl), xt.dtype) \
+                    .at[destc].set(flat, mode="drop")
+                yc = grouped_mlp_apply(xc, gate_up, down, sizes)
+                y_flat = yc.at[destc].get(mode="fill", fill_value=0)
+                y_loc = y_flat.reshape(el, ep, cap, hl)
+            else:
+                # dense path: fold senders into the slot dim, padded MLP
+                x_loc = jnp.swapaxes(x_recv, 0, 1).reshape(el, ep * cap, hl)
+                y_loc = expert_mlp_apply(x_loc, gate_up, down) \
+                    .reshape(el, ep, cap, hl)
             # reverse exchange back to the senders
-            y_back = jnp.swapaxes(
-                y_loc.reshape(e // ep, ep, cap, hl), 0, 1)
+            y_back = jnp.swapaxes(y_loc, 0, 1)
             y_recv = jax.lax.all_to_all(y_back, "ep", split_axis=0,
                                         concat_axis=0)
             y_e = y_recv.reshape(e, cap, hl)
             yt = sparse_combine(y_e, route, dest, tl)
-            return yt.reshape(bl, sl, hl), aux, drop
+            return yt, aux, drop
 
         fn = shard_map(
             local, mesh=mesh.mesh,
             in_specs=(xspec, P(), P("ep", None, None), P("ep", None, None)),
             out_specs=(xspec, P(), P()))
-        return fn(x, self.gate_w, self.experts.gate_up, self.experts.down)
+        yt, aux, drop = fn(x.reshape(t, h), self.gate_w,
+                           self.experts.gate_up, self.experts.down)
+        return yt.reshape(b, s, h), aux, drop

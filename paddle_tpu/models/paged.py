@@ -242,7 +242,13 @@ class PrefixCachingBlockManager(RefBlockManager):
         self._hash_to_block: dict[bytes, int] = {}
         self._block_hash: dict[int, bytes] = {}
         self._evictable = collections.OrderedDict()   # blk -> None, LRU order
-        self.cache_stats = {"hit_blocks": 0, "evictions": 0}
+        # hit_blocks / evictions / lookup_blocks are CUMULATIVE — the
+        # engine exports them as serving_prefix_* metrics (deltas pushed
+        # at each gauge refresh); lookup_blocks counts the full prompt
+        # blocks every match_prefix probe COULD have hit, the hit-rate
+        # denominator
+        self.cache_stats = {"hit_blocks": 0, "evictions": 0,
+                            "lookup_blocks": 0}
 
     # ---- capacity: parked blocks are reclaimable, so they count as free
     @property
@@ -294,6 +300,7 @@ class PrefixCachingBlockManager(RefBlockManager):
         prompt. Capped at (len-1)//block_size so at least the last prompt
         token is always prefilled — its logits seed the first sample."""
         n_full = (len(tokens) - 1) // self.block_size
+        self.cache_stats["lookup_blocks"] += n_full
         blocks = []
         for d in self._chain_digests(tokens, n_full):
             blk = self._hash_to_block.get(d)
@@ -376,6 +383,39 @@ def _scatter_decode(pool, vals, tables, lens, active, num_blocks, block_size):
     return pool.at[blk, off].set(vals[:, 0], mode="drop")
 
 
+def _backbone(model):
+    """Decoder backbone holding embed_tokens/layers/norm. Llama-family
+    models wrap it in ``.model``; the MoE families (Mixtral, Qwen2-MoE,
+    MoEForCausalLM) hang the parts directly off the LM."""
+    return getattr(model, "model", model)
+
+
+def _model_logits(model, x):
+    """LM head: ``model.logits`` where it exists (weight-only-quant aware),
+    the plain ``lm_head`` matmul otherwise (MoE families)."""
+    fn = getattr(model, "logits", None)
+    if callable(fn):
+        return fn(x)
+    return x @ model.lm_head
+
+
+def _mlp_out(lyr, h):
+    """Per-layer MLP adapter: Mixtral-style layers carry an ``.moe``
+    MoELayer, Qwen2-MoE puts a sparse block (or a dense LlamaMLP) at
+    ``.mlp``. MoE blocks return ``(y, aux_loss)`` — the aux loss is a
+    training regulariser, dropped at inference."""
+    blk = lyr.moe if hasattr(lyr, "moe") else lyr.mlp
+    out = blk(h)
+    return out[0] if isinstance(out, (tuple, list)) else out
+
+
+def is_moe_model(model) -> bool:
+    """True when any decoder layer routes through an MoE block (drives
+    the ``serving.moe_dispatch`` chaos site in LLMEngine)."""
+    return any(hasattr(lyr, "moe") or getattr(lyr, "sparse", False)
+               for lyr in getattr(_backbone(model), "layers", ()))
+
+
 def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
                         slot_ids=None, table_rows=None):
     """Prefill padded ragged prompts [B, S]; returns (last_logits, cache).
@@ -409,7 +449,7 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
         tables = jnp.asarray(table_rows, jnp.int32)   # [A, max_blocks]
         new_tables = cache.block_tables.at[slot_ids].set(tables, mode="drop")
         new_lens = cache.lens.at[slot_ids].set(prompt_lens, mode="drop")
-    x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
+    x = jnp.take(_backbone(model).embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
     scaling = getattr(cfg, "rope_scaling", None)
     cos, sin = A.rope_cos_sin(
@@ -421,7 +461,7 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
                  else None),
         allow_dynamic=False)
     k_pools, v_pools = [], []
-    for li, lyr in enumerate(model.model.layers):
+    for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
         qkv = _wo(h, att.qkv_proj)
@@ -440,9 +480,9 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
         v_pools.append(_scatter_prefill(cache.v_pools[li], v, tables,
                                         prompt_lens, nb, bs))
         x = x + _wo(out.reshape(b, s, nh * hd), att.o_proj)
-        x = x + lyr.mlp(lyr.post_attention_layernorm(x))
-    x = model.model.norm(x)
-    logits = model.logits(x)
+        x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
+    x = _backbone(model).norm(x)
+    logits = _model_logits(model, x)
     last = jnp.take_along_axis(
         logits, jnp.maximum(prompt_lens - 1, 0)[:, None, None].astype(jnp.int32),
         axis=1)[:, 0]
@@ -456,7 +496,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
     cfg = model.cfg
     b = tokens.shape[0]
     nb, bs = cache.num_blocks, cache.block_size
-    x = jnp.take(model.model.embed_tokens, tokens[:, None], axis=0)  # [B,1,E]
+    x = jnp.take(_backbone(model).embed_tokens, tokens[:, None], axis=0)  # [B,1,E]
     d = cfg.hidden_size // cfg.num_attention_heads
     cos, sin = _rope_rows(cache.lens, d, cfg.rope_theta,
                           getattr(cfg, "rope_scaling", None),
@@ -464,7 +504,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
     window = getattr(cfg, "sliding_window", None)
     k_pools, v_pools = [], []
     new_lens = jnp.where(active, cache.lens + 1, cache.lens)
-    for li, lyr in enumerate(model.model.layers):
+    for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
         qkv = _wo(h, att.qkv_proj)
@@ -488,9 +528,9 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
                                      cache.block_tables, new_lens,
                                      window=window)
         x = x + _wo(out.reshape(b, 1, nh * hd), att.o_proj)
-        x = x + lyr.mlp(lyr.post_attention_layernorm(x))
-    x = model.model.norm(x)
-    logits = model.logits(x)[:, 0]
+        x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
+    x = _backbone(model).norm(x)
+    logits = _model_logits(model, x)[:, 0]
     return logits, PagedKVCache(k_pools, v_pools, cache.block_tables,
                                 new_lens)
 
@@ -523,12 +563,33 @@ def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
     return nxt, logp, cache
 
 
+# The forwards above are structure-agnostic via _backbone/_model_logits/
+# _mlp_out, so they are ALSO the paged entry points for the MoE families
+# (Mixtral, Qwen2-MoE): expert routing runs inside the same jitted
+# prefill/decode, expert-parallel when traced under a mesh with ep > 1
+# (MoELayer shards tokens over the data axes and all_to_alls expert
+# slices via shard_map).
+moe_prefill_paged = llama_prefill_paged
+moe_decode_step_paged = llama_decode_step_paged
+moe_decode_tick = llama_decode_tick
+
+
 # module-level jit wrappers: their compile caches persist across
 # paged_generate calls (a per-call jax.jit would recompile every request)
 _PREFILL_JIT = jax.jit(llama_prefill_paged)
 _DECODE_JIT = jax.jit(llama_decode_step_paged)
 _TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(10, 11),
                     donate_argnums=(2,))
+
+
+def clear_jit_caches():
+    """Drop every module-level serving jit cache. Needed when trace-time
+    context changes under the same call signature — flipping
+    ``PT_GROUPED_GEMM`` or entering/leaving a mesh re-routes MoE layers,
+    but the jit caches key on shapes only."""
+    for f in (_PREFILL_JIT, _DECODE_JIT, _TICK_JIT, _PREFILL_CHUNK_JIT,
+              _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT):
+        f.clear_cache()
 
 
 def _copy_partial_blocks(pools, copy_src, copy_dst):
@@ -828,7 +889,7 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
                                            mode="drop")
     window = getattr(cfg, "sliding_window", None)
 
-    x = jnp.take(model.model.embed_tokens, input_ids, axis=0)
+    x = jnp.take(_backbone(model).embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
     positions = offsets[:, None] + jnp.arange(c, dtype=jnp.int32)  # [A, C]
     base, pos_div = A.resolve_rope_scaling(
@@ -860,7 +921,7 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
     tbl = jnp.minimum(tables, nb - 1)
 
     k_pools, v_pools = [], []
-    for li, lyr in enumerate(model.model.layers):
+    for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
         qkv = _wo(h, att.qkv_proj)
@@ -884,9 +945,9 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
                                                    nkv, hd)
         out = A.xla_attention(q, kg, vg, attn_mask=mask)
         x = x + _wo(out.reshape(a, c, nh * hd), att.o_proj)
-        x = x + lyr.mlp(lyr.post_attention_layernorm(x))
-    x = model.model.norm(x)
-    logits = model.logits(x)
+        x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
+    x = _backbone(model).norm(x)
+    logits = _model_logits(model, x)
     new_cache = PagedKVCache(k_pools, v_pools, new_tables, new_lens)
     if full_logits:
         return logits, new_cache

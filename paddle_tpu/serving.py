@@ -38,7 +38,7 @@ from paddle_tpu.models.paged import (PagedKVCache, PrefixCachingBlockManager,
                                      _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
                                      _PREFILL_JIT, _REWIND_LENS_JIT,
                                      _TICK_JIT, _VERIFY_CHUNK_JIT,
-                                     greedy_accept_length,
+                                     greedy_accept_length, is_moe_model,
                                      stochastic_accept_row)
 from paddle_tpu.models.speculative import _FWD_ROWS_JIT
 from paddle_tpu.observability import METRICS, span as _span
@@ -109,6 +109,24 @@ _SPEC_TOKENS = METRICS.histogram(
     "serving_spec_tokens_per_tick",
     "tokens committed per slot per speculative tick",
     buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
+# prefix cache: cumulative adopt/evict counts exported from the block
+# manager's cache_stats (deltas pushed each gauge refresh), plus the
+# lifetime hit rate (blocks adopted / blocks prefill would have written)
+_PREFIX_HITS = METRICS.counter(
+    "serving_prefix_hit_blocks_total",
+    "prompt blocks adopted from the prefix cache instead of prefilled")
+_PREFIX_EVICTIONS = METRICS.counter(
+    "serving_prefix_evictions_total",
+    "parked prefix blocks evicted to satisfy new allocations")
+_PREFIX_HIT_RATE = METRICS.gauge(
+    "serving_prefix_hit_rate",
+    "prefix-cache hit blocks / prompt blocks requested (lifetime)")
+# MoE serving: routing choices dropped by expert-capacity overflow
+# (always 0 for dropless models — Mixtral/Qwen2-MoE serve with
+# capacity_factor=None)
+_MOE_DROPPED = METRICS.counter(
+    "moe_dropped_tokens_total",
+    "MoE routing assignments dropped at expert capacity")
 
 
 class QueueFullError(RuntimeError):
@@ -208,6 +226,10 @@ class LLMEngine:
         # prefix blocks outright (prefill only runs on the uncached
         # suffix); with no sharing it behaves exactly like BlockManager
         self.mgr = PrefixCachingBlockManager(num_blocks, block_size)
+        self._prefix_pushed = dict(self.mgr.cache_stats)
+        # MoE models route tokens through expert all_to_alls inside the
+        # tick — give chaos a hook at that boundary (dead expert shard)
+        self._is_moe = is_moe_model(model)
         self.eos_token_id = eos_token_id
         # engine defaults; each request may override temperature/top_p
         # (top_k stays engine-global — it is a static compile parameter)
@@ -1479,6 +1501,18 @@ class LLMEngine:
         _KV_IN_USE.set(used)
         _KV_UTIL.set(used / self.mgr.num_blocks if self.mgr.num_blocks
                      else 0.0)
+        stats = getattr(self.mgr, "cache_stats", None)
+        if stats is not None:
+            # counters are process-global and cumulative; the manager's
+            # stats are per-engine — push only what this engine added
+            # since the last refresh
+            _PREFIX_HITS.inc(stats["hit_blocks"]
+                             - self._prefix_pushed["hit_blocks"])
+            _PREFIX_EVICTIONS.inc(stats["evictions"]
+                                  - self._prefix_pushed["evictions"])
+            self._prefix_pushed = dict(stats)
+            _PREFIX_HIT_RATE.set(stats["hit_blocks"]
+                                 / max(stats["lookup_blocks"], 1))
 
     def step(self):
         """One engine tick — see :meth:`_step_impl`. Wrapped here so the
@@ -1537,6 +1571,14 @@ class LLMEngine:
         # growth may have preempted slots — recompute the mask after it
         run_mask = self.active & ~spec_handled
         self.rng, sub = jax.random.split(self.rng)
+        if self._is_moe:
+            # chaos: a dead expert shard fails the token all_to_all. Fires
+            # BEFORE the donating tick jit, so an injected exception aborts
+            # the tick with the cache intact and every grown block still
+            # owned by its request's table — cancel/free reclaims them and
+            # assert_quiescent stays clean (exception-atomic).
+            fault_point("serving.moe_dispatch", engine=self,
+                        slots=np.nonzero(run_mask)[0])
         t1 = perf_counter()
         nxt, logp, self.cache = _TICK_JIT(
             self.model, jnp.asarray(self.last_tok), self.cache,

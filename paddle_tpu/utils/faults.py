@@ -15,6 +15,10 @@ rule is installed). Tests install rules against site names:
     serving.spec_verify  before the speculative verify forward — an
                      exception aborts the spec round exception-atomically
                      and the tick falls back to one-token decode
+    serving.moe_dispatch  before the decode tick of an MoE model (the
+                     expert all_to_all — a dead expert shard); an
+                     exception aborts the tick exception-atomically:
+                     no blocks leak and ``assert_quiescent`` stays clean
     train.step       top of each trainer step (exception / stall)
     train.loss       loss override — return value replaces the real loss
                      (NaN injection)
