@@ -115,7 +115,8 @@ def cpu_legs_main():
     out = {}
     for key, fn in (("host_overlap", bench_host_overlap),
                     ("serving_spec", bench_serving_spec),
-                    ("serving_moe", bench_serving_moe)):
+                    ("serving_moe", bench_serving_moe),
+                    ("serving_router", bench_serving_router)):
         try:
             out[key] = fn()
         except Exception as e:  # noqa: BLE001 — per-leg isolation
@@ -124,7 +125,8 @@ def cpu_legs_main():
     from paddle_tpu.observability import METRICS
     out["counters"] = {
         k: v for k, v in METRICS.snapshot()["counters"].items()
-        if k.startswith(("serving_spec_", "serving_prefix_", "moe_"))}
+        if k.startswith(("serving_spec_", "serving_prefix_", "moe_",
+                         "router_"))}
     print(json.dumps(out))
 
 
@@ -710,6 +712,116 @@ def bench_serving_moe():
     }
 
 
+def bench_serving_router():
+    """Multi-replica router leg (ISSUE 7): aggregate decode tokens/sec
+    for 1 vs 2 replicas, plus TTFT p50 for disaggregated vs colocated
+    prefill/decode. Calibrated — each request carries a ``stream``
+    callback that sleeps 2 ms per token, simulating the per-token client
+    egress (SSE flush / network write) a serving front end pays. Egress
+    burns no CPU, so a single replica serializes it with compute while
+    two replica threads overlap one replica's egress with the other's
+    ticks — the capacity gain a router actually buys, visible even on a
+    single core. Greedy, so routed output must match the single run.
+    The TTFT sub-leg uses long chunked prompts with decode-heavy
+    generations: colocated replicas make new arrivals wait for a slot
+    behind full generations, while a prefill-role replica recycles its
+    slots at handoff, so admission (and the first token) happens almost
+    immediately. CPU-safe."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import LLMEngine, Replica, Request, Router
+
+    pt.seed(0)
+    kw = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+              num_attention_heads=8, num_key_value_heads=4,
+              max_position_embeddings=256)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=8, **kw))
+
+    EGRESS_S = 0.003
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 512, (int(l),))
+               for l in rs.randint(4, 24, size=24)]
+    max_new = 32
+
+    def mk(role="both"):
+        eng = LLMEngine(model, num_slots=4, block_size=8,
+                        max_prompt_len=32, max_seq_len=160)
+        return Replica(eng, role=role)
+
+    def egress(req, tok):
+        time.sleep(EGRESS_S)
+
+    def reqs(stream=egress):
+        return [Request(p, max_new_tokens=max_new, stream=stream)
+                for p in prompts]
+
+    def run_single():
+        eng = mk().engine
+        for r in reqs():
+            eng.add_request(r)
+        t0 = time.perf_counter()
+        out = eng.run()
+        dt = time.perf_counter() - t0
+        return sum(len(t) for t in out.values()) / dt, out
+
+    def run_fleet():
+        router = Router([mk(), mk()])
+        for r in reqs():
+            router.add_request(r)
+        t0 = time.perf_counter()
+        out = router.run(parallel=True)
+        dt = time.perf_counter() - t0
+        return sum(len(t) for t in out.values()) / dt, out
+
+    run_single()                           # warmup / compile
+    single_tps, single_out = run_single()
+    fleet_tps, fleet_out = run_fleet()
+
+    # --- TTFT: disaggregated prefill/decode vs colocated ---
+    # oversubscribed on purpose: 20 requests onto 2x4 slots, so the
+    # median colocated arrival waits a full generation for a slot, while
+    # the prefill replica recycles its slots at handoff and reaches the
+    # first token at chunk cadence
+    long_prompts = [rs.randint(0, 512, (int(l),))
+                    for l in rs.randint(40, 64, size=20)]
+
+    def ttft_run(roles, ps):
+        ttft = {}
+        router = Router([mk(roles[0]), mk(roles[1])])
+        t0 = time.perf_counter()
+
+        def first_tok(req, tok):
+            ttft.setdefault(req.req_id, time.perf_counter() - t0)
+
+        for p in ps:
+            router.add_request(Request(p, max_new_tokens=48,
+                                       stream=first_tok))
+        router.run()
+        return float(np.percentile(list(ttft.values()), 50))
+
+    # warmup: the handoff gather/scatter jits only trace on the disagg
+    # path — keep that compile out of the timed runs
+    ttft_run(["prefill", "decode"], long_prompts[:2])
+    ttft_colocated = ttft_run(["both", "both"], long_prompts)
+    ttft_disagg = ttft_run(["prefill", "decode"], long_prompts)
+
+    norm = lambda o: {r: list(map(int, t)) for r, t in o.items()}  # noqa: E731
+    return {
+        "single_tokens_per_sec": round(single_tps, 1),
+        "fleet_tokens_per_sec": round(fleet_tps, 1),
+        "speedup": round(fleet_tps / single_tps, 3),
+        "match": norm(fleet_out) == norm(single_out),  # greedy: identical
+        "egress_ms_per_token": EGRESS_S * 1e3,
+        "replicas": 2,
+        "cpu_count": len(os.sched_getaffinity(0)),
+        "ttft_p50_colocated_s": round(ttft_colocated, 4),
+        "ttft_p50_disagg_s": round(ttft_disagg, 4),
+        "ttft_disagg_speedup": round(ttft_colocated / max(ttft_disagg, 1e-9),
+                                     3),
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -845,6 +957,14 @@ def main():
         print(f"bench config serving_moe failed: {e!r}", file=sys.stderr)
         serving_moe = {"error": f"{type(e).__name__}: {e}"}
 
+    # multi-replica router: aggregate decode tokens/sec 1 vs 2 replicas,
+    # plus disaggregated prefill/decode TTFT — backend-independent
+    try:
+        serving_router = bench_serving_router()
+    except Exception as e:  # noqa: BLE001 — per-config isolation
+        print(f"bench config serving_router failed: {e!r}", file=sys.stderr)
+        serving_router = {"error": f"{type(e).__name__}: {e}"}
+
     # honest config label: the CPU-smoke fallback runs LlamaConfig.tiny(),
     # not the 0.8B geometry — name the metric by what actually ran
     size_tag = f"{n_params / 1e9:.1f}b" if n_params >= 5e7 else f"{n_params:,}-param smoke"
@@ -875,10 +995,11 @@ def main():
         "counters": {k: v for k, v in snap["counters"].items()
                      if k.startswith(("collective_", "faults_",
                                       "serving_spec_", "serving_prefix_",
-                                      "moe_"))},
+                                      "moe_", "router_"))},
         "host_overlap": host_overlap,
         "serving_spec": serving_spec,
         "serving_moe": serving_moe,
+        "serving_router": serving_router,
     }
     print(json.dumps({
         "metric": f"llama-{size_tag} bf16 train step tokens/sec/chip (MFU in extra)",

@@ -26,7 +26,7 @@ from typing import Callable, List, Optional
 from paddle_tpu.observability.metrics import METRICS, Histogram
 
 __all__ = ["HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
-           "counter_value", "gauge_value", "counter_ratio",
+           "counter_value", "gauge_value", "counter_ratio", "gauge_imbalance",
            "histogram_quantile", "histogram_sum_ratio"]
 
 _ORDER = {"OK": 0, "WARN": 1, "CRIT": 2}
@@ -57,6 +57,22 @@ def counter_ratio(num: str, den: str, registry=None) -> Callable[[], float]:
         reg = registry if registry is not None else METRICS
         d = _series_total(reg.get(den))
         return _series_total(reg.get(num)) / d if d else 0.0
+    return get
+
+
+def gauge_imbalance(name: str, registry=None) -> Callable[[], float]:
+    """Spread across a labeled gauge's series: (max - min) / max(mean, 1),
+    e.g. per-replica outstanding-request counts — 0 when perfectly
+    balanced, large when one series hoards the load. NaN (→ OK) with
+    fewer than two series: imbalance needs something to compare."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        inst = reg.get(name)
+        if inst is None or len(inst._series) < 2:
+            return float("nan")
+        vals = [float(cell[0]) for cell in inst._series.values()]
+        mean = sum(vals) / len(vals)
+        return (max(vals) - min(vals)) / max(mean, 1.0)
     return get
 
 

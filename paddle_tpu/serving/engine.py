@@ -4,197 +4,65 @@ Ref capability: PaddleNLP ``llm/predict/predictor.py`` block-attention
 serving (request queue + block KV cache + ``fused_multi_transformer``'s
 block cache ops). TPU-native split:
 
-  * DEVICE — two fixed-shape jitted programs from ``models/paged.py``:
-    slot-aware prefill (admitted prompts written into their cache slots
-    while other slots keep decoding state) and the fused decode tick
-    (incremental block-table update + paged attention + on-device
-    sampling). Shapes never change across ticks, so nothing recompiles.
-  * HOST — this module: FCFS request queue, slot assignment, block
-    reservation/allocation (BlockManager), streaming outputs. All per-tick
-    bookkeeping is vectorised numpy; the only per-tick device→host
-    traffic is the [num_slots] sampled-token fetch.
+  * DEVICE — :class:`~paddle_tpu.serving.executor.ModelExecutor`: the
+    fixed-shape jitted programs from ``models/paged.py`` (slot-aware
+    prefill, chunked prefill/verify, the fused decode tick). Shapes
+    never change across ticks, so nothing recompiles.
+  * HOST — :class:`~paddle_tpu.serving.scheduler.Scheduler` (FCFS
+    queue, deadlines, preemption policy, backpressure) and
+    :class:`~paddle_tpu.serving.kv.KVManager` (block tables, prefix
+    cache, the reservation ledger). All per-tick bookkeeping is
+    vectorised numpy; the only per-tick device→host traffic is the
+    [num_slots] sampled-token fetch.
+
+``LLMEngine`` orchestrates the three: slot state lives here, policy in
+the scheduler, block accounting in the KV manager, device state in the
+executor. The pre-split attribute surface (``engine.mgr``,
+``engine.queue``, ``engine._reserved``, ...) is preserved as
+delegating properties — external callers and tests see the same API
+the monolithic ``serving.py`` exposed.
 
 Capacity discipline: a request is admitted only when the pool can cover
 its WHOLE worst case (prompt + max_new_tokens) net of other in-flight
 reservations — blocks are still allocated lazily (pool usage ≈ Σ live
 lengths), but an admitted request can never hit an out-of-blocks
 condition mid-decode (there is no preemption to recover with).
+
+Multi-replica serving (ISSUE 7): ``prefill_only=True`` stops the tick
+after chunked prefill — the replica admits and prefills but never
+decodes; a :class:`~paddle_tpu.serving.router.Router` extracts each
+finished sequence (``extract_sequence``) and installs it into a
+decode-role replica (``install_sequence``) via the KV-transfer seam.
 """
 from __future__ import annotations
 
-import itertools
 import os
 import time
-from collections import deque
-from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.models.decoding import KVCache, _sample_rows
-from paddle_tpu.models.paged import (PagedKVCache, PrefixCachingBlockManager,
-                                     _beam_finalize, _BEAM_GROUP_UPDATE_JIT,
-                                     _BEAM_SELECT_JIT, _PREFILL_CHUNK_JIT,
-                                     _PREFILL_JIT, _REWIND_LENS_JIT,
-                                     _TICK_JIT, _VERIFY_CHUNK_JIT,
+from paddle_tpu.models.paged import (_beam_finalize, _BEAM_SELECT_JIT,
                                      greedy_accept_length, is_moe_model,
                                      stochastic_accept_row)
-from paddle_tpu.models.speculative import _FWD_ROWS_JIT
-from paddle_tpu.observability import METRICS, span as _span
+from paddle_tpu.observability import span as _span
 from paddle_tpu.observability.flight import FLIGHT
+from paddle_tpu.serving.executor import ModelExecutor, _SAMPLE_ROWS_JIT  # noqa: F401  (re-exported)
+from paddle_tpu.serving.kv import KVManager
+from paddle_tpu.serving.scheduler import Scheduler
+from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
+                                          _DRAIN, _FINISHED, _KV_IN_USE,
+                                          _KV_UTIL, _QUEUE_DEPTH,
+                                          _SPEC_ACCEPTED, _SPEC_FALLBACKS,
+                                          _SPEC_PROPOSED, _SPEC_RATE,
+                                          _SPEC_TOKENS, _TICK, _TIMEOUTS,
+                                          _TOK_LAT, _TOKENS, _TTFT)
+from paddle_tpu.serving.transfer import (KVPayload, _GATHER_BLOCKS_JIT,
+                                         _INSTALL_BLOCKS_JIT)
+from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
+                                      Request, _BeamGroup)
 from paddle_tpu.utils.faults import fault_point
-
-# module-level so its compile cache persists across admissions
-_SAMPLE_ROWS_JIT = jax.jit(_sample_rows, static_argnums=(4,))
-
-# ---------------------------------------------------------- telemetry
-# Engine metrics (ISSUE 2). Request-relative timings (TTFT, inter-token
-# latency, queue wait) use the ENGINE clock — the swappable ``clock``
-# ctor arg — so deadline tests driving a fake clock see deterministic
-# histograms; host work timings (tick, drain) use the real monotonic
-# clock. All instruments live in the process-global registry: a serve
-# loop exports them with ``paddle_tpu.observability.dump(prefix)``.
-_ADMITTED = METRICS.counter(
-    "serving_admissions_total", "requests admitted into cache slots")
-_PREEMPTED = METRICS.counter(
-    "serving_preemptions_total", "requests evicted and re-queued")
-_TIMEOUTS = METRICS.counter(
-    "serving_timeouts_total", "requests expired (deadline_s/max_queue_s)")
-_CANCELLED = METRICS.counter(
-    "serving_cancellations_total", "requests cancelled by the caller")
-_REJECTED = METRICS.counter(
-    "serving_rejections_total", "admissions refused at intake",
-    labelnames=("reason",))
-_TOKENS = METRICS.counter(
-    "serving_tokens_total", "tokens sampled and emitted")
-_FINISHED = METRICS.counter(
-    "serving_finished_total", "requests finished, by finish_reason",
-    labelnames=("reason",))
-_QUEUE_DEPTH = METRICS.gauge(
-    "serving_queue_depth", "requests waiting for admission")
-_ACTIVE_SLOTS = METRICS.gauge(
-    "serving_active_slots", "cache slots actively decoding")
-_KV_IN_USE = METRICS.gauge(
-    "serving_kv_blocks_in_use", "paged KV blocks currently allocated")
-_KV_UTIL = METRICS.gauge(
-    "serving_kv_block_utilization", "allocated fraction of the KV pool")
-_TTFT = METRICS.histogram(
-    "serving_ttft_seconds", "submission → first token (engine clock)")
-_TOK_LAT = METRICS.histogram(
-    "serving_token_latency_seconds", "inter-token gap (engine clock)",
-    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-             1.0, 2.5))
-_QUEUE_WAIT = METRICS.histogram(
-    "serving_queue_wait_seconds", "submission → admission (engine clock)")
-_TICK = METRICS.histogram(
-    "serving_tick_seconds", "wall time of one engine tick")
-_DRAIN = METRICS.histogram(
-    "serving_drain_seconds", "wall time of graceful drain",
-    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
-# speculative decoding (ISSUE 5): proposal/acceptance accounting plus the
-# per-tick commit size — tokens_per_tick > 1 is the whole point
-_SPEC_PROPOSED = METRICS.counter(
-    "serving_spec_proposed_total", "draft tokens proposed for verification")
-_SPEC_ACCEPTED = METRICS.counter(
-    "serving_spec_accepted_total", "draft tokens accepted by the target")
-_SPEC_FALLBACKS = METRICS.counter(
-    "serving_spec_fallbacks_total",
-    "spec ticks abandoned before verify (fault injection) — the engine "
-    "fell back to the one-token tick")
-_SPEC_RATE = METRICS.gauge(
-    "serving_spec_acceptance_rate",
-    "cumulative accepted/proposed draft-token ratio")
-_SPEC_TOKENS = METRICS.histogram(
-    "serving_spec_tokens_per_tick",
-    "tokens committed per slot per speculative tick",
-    buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
-# prefix cache: cumulative adopt/evict counts exported from the block
-# manager's cache_stats (deltas pushed each gauge refresh), plus the
-# lifetime hit rate (blocks adopted / blocks prefill would have written)
-_PREFIX_HITS = METRICS.counter(
-    "serving_prefix_hit_blocks_total",
-    "prompt blocks adopted from the prefix cache instead of prefilled")
-_PREFIX_EVICTIONS = METRICS.counter(
-    "serving_prefix_evictions_total",
-    "parked prefix blocks evicted to satisfy new allocations")
-_PREFIX_HIT_RATE = METRICS.gauge(
-    "serving_prefix_hit_rate",
-    "prefix-cache hit blocks / prompt blocks requested (lifetime)")
-# MoE serving: routing choices dropped by expert-capacity overflow
-# (always 0 for dropless models — Mixtral/Qwen2-MoE serve with
-# capacity_factor=None)
-_MOE_DROPPED = METRICS.counter(
-    "moe_dropped_tokens_total",
-    "MoE routing assignments dropped at expert capacity")
-
-
-class QueueFullError(RuntimeError):
-    """Admission queue at ``max_queue_len`` — backpressure: the caller
-    should shed load or retry later, NOT buffer unboundedly here."""
-
-
-class EngineDrainingError(RuntimeError):
-    """``drain()`` was called — the engine finishes in-flight work but
-    admits nothing new."""
-
-
-@dataclass
-class Request:
-    """One generation request. ``stream`` (optional) is called as
-    ``stream(request, token)`` the tick each new token is sampled.
-    ``num_beams > 1``: beam search — the request occupies num_beams cache
-    slots, selection mirrors ``decoding.beam_search`` exactly, and the
-    BEST hypothesis lands in ``tokens`` when the request finishes (no
-    streaming; tail past a hypothesis' first EOS is EOS-filled)."""
-    prompt: object                       # 1-D int tokens
-    max_new_tokens: int = 32
-    req_id: int = None
-    stream: object = None
-    num_beams: int = 1
-    length_penalty: float = 1.0
-    # per-request sampling overrides (None = the engine's defaults):
-    temperature: float = None
-    top_p: float = None
-    # robustness knobs (None = unbounded):
-    #   deadline_s    total wall-clock budget from submission — expired
-    #                 requests finish with finish_reason="timeout"
-    #                 (whatever tokens were generated stay available)
-    #   max_queue_s   max time WAITING for admission; a request that
-    #                 can't enter a slot in time also times out
-    deadline_s: float = None
-    max_queue_s: float = None
-    # filled by the engine:
-    tokens: list = field(default_factory=list)   # generated tokens
-    done: bool = False
-    finish_reason: str = None
-    _submit_t: float = None              # engine clock at add_request
-    _first_tok_t: float = None           # engine clock at first token (TTFT)
-    _last_tok_t: float = None            # engine clock at newest token
-    beam_score: float = None
-    # set on preemption: prompt + tokens generated so far — the resume
-    # prefill recomputes the whole sequence (prefix-cache hits make the
-    # recompute cheap when its old blocks are still parked)
-    _resume: object = None
-
-    def __post_init__(self):
-        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
-
-
-@dataclass
-class _BeamGroup:
-    """Engine-side state of one in-flight beam request (K cache slots +
-    the device-resident selection state shared with paged_beam_search)."""
-    req: Request
-    slots: list
-    s: int                                # prompt length
-    i: int = 0                            # selects done
-    sid: dict = field(default_factory=dict)   # beam j -> BlockManager key
-    running_lp: object = None
-    seqs: object = None
-    fin_seqs: object = None
-    fin_scores: object = None
-    logp: object = None                   # [K, vocab] device, pre-select
 
 
 class LLMEngine:
@@ -211,7 +79,7 @@ class LLMEngine:
                  eos_token_id=None, temperature=0.0, top_k=None, top_p=None,
                  seed=0, prefix_caching=True, preemption=False,
                  max_queue_len=None, clock=None, draft_model=None,
-                 spec_k=4, spec_adaptive=True):
+                 spec_k=4, spec_adaptive=True, prefill_only=False):
         cfg = model.cfg
         self.model = model
         self.num_slots = num_slots
@@ -221,12 +89,6 @@ class LLMEngine:
         self.max_blocks_per_seq = -(-self.max_seq_len // block_size)
         if num_blocks is None:
             num_blocks = num_slots * self.max_blocks_per_seq
-        # refcounted + content-hashed: beam groups share prompt blocks
-        # copy-on-write; requests with equal prompt prefixes share the
-        # prefix blocks outright (prefill only runs on the uncached
-        # suffix); with no sharing it behaves exactly like BlockManager
-        self.mgr = PrefixCachingBlockManager(num_blocks, block_size)
-        self._prefix_pushed = dict(self.mgr.cache_stats)
         # MoE models route tokens through expert all_to_alls inside the
         # tick — give chaos a hook at that boundary (dead expert shard)
         self._is_moe = is_moe_model(model)
@@ -238,7 +100,6 @@ class LLMEngine:
         self.top_k = top_k
         self.temps = np.zeros(num_slots, np.float32)
         self.top_ps = np.ones(num_slots, np.float32)
-        self.rng = jax.random.PRNGKey(seed)
         # sliding-window models: blocks entirely below cur - window are
         # never attended again (the paged kernel KEEPS only positions
         # >= lens - window, masking everything below) — recycle them,
@@ -256,6 +117,10 @@ class LLMEngine:
         # preempt the youngest greedy slot — it re-queues with
         # resume-prompt = prompt + generated-so-far and recomputes
         self.preemption = bool(preemption)
+        # prefill-role replica (disaggregated serving): the tick stops
+        # after chunked prefill — slots activate with their first token
+        # but NEVER decode here; the router extracts and ships them
+        self.prefill_only = bool(prefill_only)
 
         # ---- speculative decoding (ISSUE 5): draft-and-verify tick ----
         # ``draft_model`` enables it; each eligible slot drafts up to
@@ -284,12 +149,19 @@ class LLMEngine:
                 raise ValueError(
                     f"draft vocab {draft_model.cfg.vocab_size} != target "
                     f"vocab {cfg.vocab_size}")
+            # host RNG for draft sampling + accept/reject (temperature>0):
+            # the accept rule preserves the target distribution for any
+            # uniform source, so this stream need not match the engine key
+            self._spec_rs = np.random.RandomState((seed ^ 0x5eed) & 0x7fffffff)
 
-        self.cache = PagedKVCache.init(
-            cfg.num_hidden_layers, num_blocks, block_size,
-            cfg.num_key_value_heads,
-            cfg.hidden_size // cfg.num_attention_heads,
-            num_slots, self.max_blocks_per_seq, cfg.dtype)
+        # ---- the three extracted layers ----
+        self.kv = KVManager(num_blocks, block_size)
+        self.sched = Scheduler(max_queue_len=max_queue_len, clock=clock)
+        self.exe = ModelExecutor(
+            model, num_slots=num_slots, num_blocks=num_blocks,
+            block_size=block_size, max_blocks_per_seq=self.max_blocks_per_seq,
+            top_k=top_k, seed=seed, draft_model=draft_model,
+            spec_k=self.spec_k, max_seq_len=self.max_seq_len)
 
         # host mirrors (vectorised bookkeeping — no per-token python loops)
         self.slot_req = np.full(num_slots, -1, np.int64)   # req_id or -1
@@ -308,18 +180,6 @@ class LLMEngine:
         self.draft_cur = np.zeros(num_slots, np.int64)
         self.slot_k = np.full(num_slots, self.spec_k, np.int64)
         self._acc_ema = np.ones(num_slots, np.float64)
-        self._draft_cache = None
-        if draft_model is not None:
-            dcfg = draft_model.cfg
-            self._draft_cache = KVCache.init(
-                dcfg.num_hidden_layers, num_slots,
-                self.max_seq_len + self.spec_k + 2,
-                dcfg.num_key_value_heads,
-                dcfg.hidden_size // dcfg.num_attention_heads, dcfg.dtype)
-            # host RNG for draft sampling + accept/reject (temperature>0):
-            # the accept rule preserves the target distribution for any
-            # uniform source, so this stream need not match the engine key
-            self._spec_rs = np.random.RandomState((seed ^ 0x5eed) & 0x7fffffff)
 
         self.is_beam = np.zeros(num_slots, bool)
         self.groups: dict[int, _BeamGroup] = {}
@@ -328,13 +188,7 @@ class LLMEngine:
         # tokens consumed); slots stay inactive until the last chunk
         self.prefilling: dict[int, tuple] = {}
 
-        self.queue: deque[Request] = deque()
-        self.requests: dict[int, Request] = {}
-        self._ids = itertools.count()
-        self._reserved = 0           # blocks promised to in-flight requests
         self._staged_admits = frozenset()   # this tick's pre-scatter rows
-        self._resv: dict[int, int] = {}    # req_id -> outstanding reserve
-        self._need: dict[int, int] = {}    # req_id -> worst-case blocks
         # host-vs-device split of decode ticks (admission ticks excluded):
         # stats["host_s"] is scheduling/bookkeeping, stats["device_s"] the
         # jitted tick incl. the [num_slots] token fetch
@@ -344,32 +198,98 @@ class LLMEngine:
                       "spec_accepted": 0, "spec_fallbacks": 0}
         self._adm_counter = 0                # admission recency, per slot
         self.adm_order = np.zeros(num_slots, np.int64)
-        # robustness: bounded admission queue (None = unbounded), a
-        # swappable clock (tests drive deadlines deterministically), and
-        # the drain flag (graceful shutdown: finish in-flight, admit
-        # nothing new)
-        self.max_queue_len = max_queue_len
-        self._clock = clock if clock is not None else time.monotonic
-        self._draining = False
-        self._has_deadlines = False
+
+    # ------------------------------------------- pre-split attribute surface
+    # The monolithic serving.py exposed all of this directly on the
+    # engine; tests and external callers still poke it, so every moved
+    # field delegates to the layer that now owns it.
+    @property
+    def mgr(self):
+        return self.kv.mgr
+
+    @property
+    def queue(self):
+        return self.sched.queue
+
+    @property
+    def requests(self):
+        return self.sched.requests
+
+    @property
+    def cache(self):
+        return self.exe.cache
+
+    @cache.setter
+    def cache(self, value):
+        self.exe.cache = value
+
+    @property
+    def rng(self):
+        return self.exe.rng
+
+    @rng.setter
+    def rng(self, value):
+        self.exe.rng = value
+
+    @property
+    def _draft_cache(self):
+        return self.exe._draft_cache
+
+    @_draft_cache.setter
+    def _draft_cache(self, value):
+        self.exe._draft_cache = value
+
+    @property
+    def _reserved(self):
+        return self.kv.reserved
+
+    @_reserved.setter
+    def _reserved(self, value):
+        self.kv.reserved = value
+
+    @property
+    def _resv(self):
+        return self.kv.resv
+
+    @property
+    def _need(self):
+        return self.kv.need
+
+    @property
+    def _draining(self):
+        return self.sched.draining
+
+    @_draining.setter
+    def _draining(self, value):
+        self.sched.draining = value
+
+    @property
+    def max_queue_len(self):
+        return self.sched.max_queue_len
+
+    @max_queue_len.setter
+    def max_queue_len(self, value):
+        self.sched.max_queue_len = value
+
+    @property
+    def _clock(self):
+        return self.sched.clock
+
+    @_clock.setter
+    def _clock(self, value):
+        self.sched.clock = value
+
+    @property
+    def _has_deadlines(self):
+        return self.sched.has_deadlines
+
+    @_has_deadlines.setter
+    def _has_deadlines(self, value):
+        self.sched.has_deadlines = value
 
     # ------------------------------------------------------------- intake
     def add_request(self, req: Request) -> int:
-        if self._draining:
-            self.stats["rejected"] += 1
-            _REJECTED.inc(reason="draining")
-            raise EngineDrainingError(
-                "engine is draining — finishing in-flight requests, "
-                "admitting nothing new")
-        if (self.max_queue_len is not None
-                and len(self.queue) >= self.max_queue_len):
-            # reject-on-full backpressure: push the load signal to the
-            # caller instead of buffering an unbounded deque
-            self.stats["rejected"] += 1
-            _REJECTED.inc(reason="queue_full")
-            raise QueueFullError(
-                f"admission queue full ({self.max_queue_len} waiting) — "
-                "shed load or retry later")
+        self.sched.check_backpressure(self.stats)
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "itself produces the first token)")
@@ -412,32 +332,15 @@ class LLMEngine:
             raise ValueError(
                 "request worst case exceeds the WHOLE block pool — it "
                 "could never be admitted (raise num_blocks)")
-        if req.req_id is None:
-            req.req_id = next(self._ids)
-        else:
-            if req.req_id in self.requests:
-                # a duplicate id would alias the BlockManager table AND
-                # the reservation ledger of the in-flight request
-                raise ValueError(f"req_id {req.req_id} already exists")
-            # keep auto ids from ever colliding with explicit ones
-            self._ids = itertools.count(
-                max(req.req_id + 1, next(self._ids)))
-        req._submit_t = self._clock()
-        if req.deadline_s is not None or req.max_queue_s is not None:
-            self._has_deadlines = True
-        self.requests[req.req_id] = req
-        self.queue.append(req)
+        rid = self.sched.enqueue(req)
         _QUEUE_DEPTH.set(len(self.queue))
-        return req.req_id
+        return rid
 
     def pop_finished(self) -> dict:
         """Remove and return completed requests ({req_id: Request}) — call
         periodically from a long-running serve loop so the engine does not
         retain every finished request's token list forever."""
-        done = {rid: r for rid, r in self.requests.items() if r.done}
-        for rid in done:
-            del self.requests[rid]
-        return done
+        return self.sched.pop_finished()
 
     def generate(self, prompt, **kw) -> int:
         return self.add_request(Request(prompt, **kw))
@@ -446,10 +349,14 @@ class LLMEngine:
         return (bool(self.queue) or bool(self.active.any())
                 or bool(self.groups) or bool(self.prefilling))
 
+    def outstanding(self) -> int:
+        """Requests accepted but not yet finished (queued, prefilling, or
+        decoding) — the router's least-outstanding-requests load signal."""
+        return sum(1 for r in self.requests.values() if not r.done)
+
     # --------------------------------------------- cancellation/deadlines
     def _release_ledger(self, rid: int):
-        self._reserved -= self._resv.pop(rid, 0)
-        self._need.pop(rid, None)
+        self.kv.release(rid)
 
     def cancel(self, req_id: int, reason: str = "cancelled") -> bool:
         """Terminate a request wherever it currently lives — queued,
@@ -462,35 +369,8 @@ class LLMEngine:
         req = self.requests.get(req_id)
         if req is None or req.done:
             return False
-        released = False
-        for i, q in enumerate(self.queue):          # still waiting
-            if q.req_id == req_id:
-                del self.queue[i]
-                released = True
-                break
-        if not released and req_id in self.prefilling:
-            slot, _ = self.prefilling.pop(req_id)
-            self.mgr.free(req_id)
-            self.slot_req[slot] = -1
-            released = True
-        if not released and req_id in self.groups:
-            g = self.groups.pop(req_id)
-            for sid in g.sid.values():
-                self.mgr.free(sid)
-            for slot in g.slots:
-                self.active[slot] = False
-                self.is_beam[slot] = False
-                self.slot_req[slot] = -1
-            released = True
-        if not released:
-            slots = np.nonzero(self.slot_req == req_id)[0]
-            if not len(slots):
-                return False                        # mid-transition: punt
-            slot = int(slots[0])
-            self.mgr.free(req_id)
-            self.active[slot] = False
-            self.slot_req[slot] = -1
-            released = True
+        if not self._detach(req_id):
+            return False                            # mid-transition: punt
         self._release_ledger(req_id)
         req.done = True
         req.finish_reason = reason
@@ -501,24 +381,56 @@ class LLMEngine:
                       else "serving.cancel", rid=req_id)
         return True
 
+    def _detach(self, req_id: int) -> bool:
+        """Free a live request's slot(s)/blocks wherever it currently is
+        (queue, chunk prefill, beam group, active slot) WITHOUT touching
+        the ledger or finishing it. Shared by cancel and the router's
+        pull-back path. Returns False when the request holds nothing
+        (unknown, or mid-transition)."""
+        for i, q in enumerate(self.queue):          # still waiting
+            if q.req_id == req_id:
+                del self.queue[i]
+                return True
+        if req_id in self.prefilling:
+            slot, _ = self.prefilling.pop(req_id)
+            self.mgr.free(req_id)
+            self.slot_req[slot] = -1
+            return True
+        if req_id in self.groups:
+            g = self.groups.pop(req_id)
+            for sid in g.sid.values():
+                self.mgr.free(sid)
+            for slot in g.slots:
+                self.active[slot] = False
+                self.is_beam[slot] = False
+                self.slot_req[slot] = -1
+            return True
+        slots = np.nonzero(self.slot_req == req_id)[0]
+        if not len(slots):
+            return False
+        slot = int(slots[0])
+        self.mgr.free(req_id)
+        self.active[slot] = False
+        self.slot_req[slot] = -1
+        self.draft_cur[slot] = 0
+        return True
+
+    def release_request(self, rid: int):
+        """Pull a live request OUT of the engine (router rebalancing /
+        replica death): free its slot(s), blocks, and reservation, and
+        forget it — WITHOUT marking it done. Returns the Request (with
+        whatever tokens it generated) so the caller can re-dispatch it,
+        or None for unknown/finished/mid-transition requests."""
+        req = self.requests.get(rid)
+        if req is None or req.done:
+            return None
+        if not self._detach(rid):
+            return None
+        self._release_ledger(rid)
+        return self.sched.release(rid)
+
     def _expire(self):
-        """Finish requests whose wall-clock budget ran out: absolute
-        ``deadline_s`` for everyone, ``max_queue_s`` additionally for
-        requests still waiting for admission. Runs at the top of every
-        tick — an expired request frees its slot/blocks THIS tick, so
-        deadlines double as livelock bounds."""
-        if not self._has_deadlines or not self.requests:
-            return
-        now = self._clock()
-        queued = {r.req_id for r in self.queue}
-        for rid, r in list(self.requests.items()):
-            if r.done or r._submit_t is None:
-                continue
-            age = now - r._submit_t
-            if ((r.deadline_s is not None and age >= r.deadline_s)
-                    or (rid in queued and r.max_queue_s is not None
-                        and age >= r.max_queue_s)):
-                self.cancel(rid, reason="timeout")
+        self.sched.expire(self.cancel)
 
     def drain(self, cancel_queued: bool = False) -> dict:
         """Graceful shutdown: stop admitting (``add_request`` raises
@@ -545,13 +457,7 @@ class LLMEngine:
         this after driving fault schedules: any leak in a recovery path
         shows up here as missing blocks."""
         assert not self.has_work(), "engine still has work"
-        assert self.mgr.free_blocks == self.mgr.num_blocks, (
-            f"block leak: {self.mgr.num_blocks - self.mgr.free_blocks} "
-            f"of {self.mgr.num_blocks} blocks unaccounted for")
-        assert self._reserved == 0, f"reservation leak: {self._reserved}"
-        assert not self._resv and not self._need, (
-            f"ledger leak: resv={self._resv} need={self._need}")
-        assert not self.mgr.tables, f"table leak: {list(self.mgr.tables)}"
+        self.kv.assert_quiescent()
 
     def _pr(self, req) -> np.ndarray:
         """Effective prompt: the resume form (original prompt + tokens
@@ -587,82 +493,13 @@ class LLMEngine:
 
     # ---------------------------------------------------------- admission
     def _admit(self):
-        """FCFS: move queued requests into free slots while the pool can
-        cover their worst case; returns (greedy (slot, req) pairs,
-        beam (slots, req) pairs). A beam request needs num_beams slots."""
-        free_slots = list(np.nonzero(self.slot_req < 0)[0])
-        admits, beam_admits = [], []
-        while self.queue and free_slots:
-            req = self.queue[0]
-            k = req.num_beams
-            p = self._pr(req)
-            # prefix-cache lookup BEFORE the capacity gate: shared blocks
-            # cost nothing, so a mostly-cached prompt admits under
-            # pressure an uncached one would wait out
-            cached = (self.mgr.match_prefix(p)
-                      if self.prefix_caching and k == 1 else [])
-            ct = len(cached) * self.block_size
-            if self.preemption and k == 1:
-                # optimistic: cover only the first prefill chunk (+1
-                # decode-headroom block); out-of-blocks later preempts
-                need = (self.mgr.blocks_needed(
-                    min(len(p), ct + self.max_prompt_len)) - len(cached) + 1)
-            else:
-                need = self._worst_case_blocks(req)
-            if (k > len(free_slots)
-                    or need > self.mgr.free_blocks - self._reserved):
-                break                      # FCFS: do not starve the head
-            self.queue.popleft()
-            _ADMITTED.inc()
-            if req._submit_t is not None:
-                _QUEUE_WAIT.observe(max(0.0, self._clock() - req._submit_t))
-            if self.preemption and k == 1:
-                need = 0                   # no standing reservation
-            self._need[req.req_id] = need
-            self._resv[req.req_id] = 0
-            if k == 1:
-                slot = int(free_slots.pop(0))
-                if cached:
-                    self.mgr.adopt_prefix(req.req_id, cached)
-                if cached or len(p) > self.max_prompt_len:
-                    # chunk-prefill path from offset ct: claims the slot
-                    # INACTIVE; blocks allocate chunk-by-chunk against
-                    # the reservation. (Cached short prompts ride it too —
-                    # the chunk program is the one that prefills from an
-                    # arbitrary offset over the slot's pool prefix.)
-                    self._reserved += need
-                    self._resv[req.req_id] = need
-                    self.slot_req[slot] = req.req_id
-                    # admission recency stamped at slot-claim: preemption
-                    # victim selection keys on THIS, not on req_id (user
-                    # ids need not be monotonic with admission)
-                    self._adm_counter += 1
-                    self.adm_order[slot] = self._adm_counter
-                    self.prefilling[req.req_id] = (slot, ct)
-                    continue
-                self.mgr.allocate(req.req_id, len(p))
-                if self.prefix_caching:
-                    self.mgr.commit_prefix(req.req_id, p)
-                self._update_resv(req.req_id)
-                admits.append((slot, req))
-            else:
-                slots = [int(free_slots.pop(0)) for _ in range(k)]
-                # full worst-case reservation up front; relaxed to
-                # (need - live) as the group's blocks materialise
-                self._reserved += need
-                self._resv[req.req_id] = need
-                beam_admits.append((slots, req))
-        return admits, beam_admits
+        return self.sched.select_admissions(self)
 
     def _live_blocks(self, rid: int) -> int:
-        return sum(b is not None for b in self.mgr.tables.get(rid, []))
+        return self.kv.live_blocks(rid)
 
     def _update_resv(self, rid: int):
-        """Outstanding reserve = worst case minus blocks currently held
-        (recycling under a sliding window RETURNS headroom)."""
-        new = max(0, self._need[rid] - self._live_blocks(rid))
-        self._reserved += new - self._resv[rid]
-        self._resv[rid] = new
+        self.kv.update(rid)
 
     def _recycle_window(self, slots):
         """Free blocks entirely below cur - window for the given slots —
@@ -727,19 +564,14 @@ class LLMEngine:
             slots[i] = bslots[0]
             rows[i] = grows[0]
             beams.append((g, grows, csrc, cdst))
-        logits, self.cache = _PREFILL_JIT(
-            self.model, jnp.asarray(ids), jnp.asarray(lens),
-            self.cache, jnp.asarray(slots), jnp.asarray(rows))
+        logits = self.exe.prefill(ids, lens, slots, rows)
         self._staged_admits = frozenset()   # scatter landed: evictable again
-        self.rng, sub = jax.random.split(self.rng)
         row_temps = np.zeros(a_cap, np.float32)
         row_tps = np.ones(a_cap, np.float32)
         for i, (slot, req) in enumerate(admits):
             row_temps[i] = self.temps[slot]
             row_tps[i] = self.top_ps[slot]
-        first = np.asarray(_SAMPLE_ROWS_JIT(
-            logits.astype(jnp.float32), sub, jnp.asarray(row_temps),
-            jnp.asarray(row_tps), self.top_k))
+        first = self.exe.sample(logits, row_temps, row_tps)
         if self.window is not None:
             # a long prompt's below-window blocks die the moment prefill
             # has scattered them — and from here on the sequence can never
@@ -751,7 +583,7 @@ class LLMEngine:
                 self.window + 2 * self.block_size)
             for slot, req in admits:
                 rid = req.req_id
-                self._need[rid] = min(self._need[rid], live_bound)
+                self.kv.need[rid] = min(self.kv.need[rid], live_bound)
                 self._update_resv(rid)
         emitted = []
         for i, (slot, req) in enumerate(admits):
@@ -768,10 +600,7 @@ class LLMEngine:
                     for b in self.mgr.tables.get(sid, []) if b is not None})
 
     def _update_resv_group(self, rid: int):
-        g = self.groups[rid]
-        new = max(0, self._need[rid] - self._group_live_blocks(g))
-        self._reserved += new - self._resv[rid]
-        self._resv[rid] = new
+        self.kv.update(rid, live=self._group_live_blocks(self.groups[rid]))
 
     def _new_sid(self, rid):
         self._sid_counter += 1
@@ -810,10 +639,7 @@ class LLMEngine:
         then run the group's FIRST select so its slots enter this tick's
         forward with real beam tokens."""
         req, s, rid, k = g.req, g.s, g.req.req_id, g.req.num_beams
-        self.cache = _BEAM_GROUP_UPDATE_JIT(
-            self.cache, jnp.asarray(g.slots, jnp.int32), jnp.asarray(rows),
-            jnp.asarray(s, jnp.int32), jnp.asarray(copy_src),
-            jnp.asarray(copy_dst))
+        self.exe.beam_group_update(g.slots, rows, s, copy_src, copy_dst)
         neg = jnp.float32(-1e9)
         vocab = self.model.cfg.vocab_size
         logp0 = jax.nn.log_softmax(logits_row.astype(jnp.float32))
@@ -871,10 +697,7 @@ class LLMEngine:
             t = self._mgr_retry(                      # room for the write
                 self.mgr.allocate, g.sid[j], cur + 1)
             rows[j, :len(t)] = t
-        self.cache = _BEAM_GROUP_UPDATE_JIT(
-            self.cache, jnp.asarray(g.slots, jnp.int32), jnp.asarray(rows),
-            jnp.asarray(cur, jnp.int32), jnp.asarray(copy_src),
-            jnp.asarray(copy_dst))
+        self.exe.beam_group_update(g.slots, rows, cur, copy_src, copy_dst)
         self._update_resv_group(rid)
         for j, slot in enumerate(g.slots):
             self.last_tok[slot] = toks[j]
@@ -899,8 +722,7 @@ class LLMEngine:
             self.active[slot] = False
             self.is_beam[slot] = False
             self.slot_req[slot] = -1
-        self._reserved -= self._resv.pop(rid, 0)
-        self._need.pop(rid, None)
+        self.kv.release(rid)
         del self.groups[rid]
         return [(rid, t) for t in req.tokens]
 
@@ -953,10 +775,7 @@ class LLMEngine:
             # keeps the engine alive): the batch is all-sentinel, so the
             # padded chunk forward would scatter nothing — skip it
             return []
-        logits, self.cache = _PREFILL_CHUNK_JIT(
-            self.model, jnp.asarray(ids), jnp.asarray(lens),
-            jnp.asarray(offs), self.cache, jnp.asarray(slots),
-            jnp.asarray(rows))
+        logits = self.exe.prefill_chunk(ids, lens, offs, slots, rows)
         emitted = []
         done_rows = []
         for i, (rid, (slot, consumed)) in enumerate(batch):
@@ -969,7 +788,6 @@ class LLMEngine:
                 continue
             done_rows.append((i, rid, slot))
         if done_rows:
-            self.rng, sub = jax.random.split(self.rng)
             row_t = np.zeros(a_cap, np.float32)
             row_p = np.ones(a_cap, np.float32)
             for i, rid, slot in done_rows:
@@ -978,9 +796,7 @@ class LLMEngine:
                             else req.temperature)
                 row_p[i] = (self.default_top_p if req.top_p is None
                             else req.top_p)
-            first = np.asarray(_SAMPLE_ROWS_JIT(
-                logits.astype(jnp.float32), sub, jnp.asarray(row_t),
-                jnp.asarray(row_p), self.top_k))
+            first = self.exe.sample(logits, row_t, row_p)
             for i, rid, slot in done_rows:
                 req = self.requests[rid]
                 del self.prefilling[rid]
@@ -1005,91 +821,15 @@ class LLMEngine:
 
     # --------------------------------------------------------- preemption
     def _preempt(self, protect_rid=None) -> bool:
-        """Evict the YOUNGEST active greedy request (LIFO — vLLM's policy:
-        the oldest in-flight work is closest to completion) to free its
-        blocks. The victim re-queues at the queue head with resume-prompt
-        = prompt + generated-so-far; on re-admission the resume prefill
-        recomputes its KV (prefix-cache hits cover whatever of its old
-        blocks survived). When no active slot qualifies, falls back to
-        evicting a CHUNK-PREFILLING request (slot inactive, blocks held):
-        without this, two long prompts mid-prefill on a dry pool would
-        spin forever — neither active nor evictable. Returns False when
-        nothing is preemptible."""
-        protect = self._protect(protect_rid)
-        cand = [int(s) for s in np.nonzero(self.active & ~self.is_beam)[0]
-                if int(self.slot_req[s]) not in protect]
-        if self._preempt_from(cand):
-            return True
-        return self._preempt_prefilling(protect_rid)
+        return self.sched.preempt(self, protect_rid)
 
-    @staticmethod
-    def _protect(protect_rid):
-        """Normalise the protect argument to a set of req_ids (a single
-        rid, an iterable of rids, or None)."""
-        if protect_rid is None:
-            return frozenset()
-        if isinstance(protect_rid, (set, frozenset, list, tuple)):
-            return frozenset(protect_rid)
-        return frozenset((protect_rid,))
+    _protect = staticmethod(Scheduler._protect)
 
     def _preempt_prefilling(self, protect_rid=None) -> bool:
-        """Evict the youngest in-flight chunked prefill — youngest by
-        ADMISSION order (``adm_order`` stamped at slot-claim), not by
-        req_id: ids may be user-supplied and non-monotonic, and evicting
-        an explicitly-numbered old request as if youngest would churn the
-        work closest to completion. Free its blocks and re-queue it at
-        the head; consumed chunks are recomputed on re-admission —
-        prefill is deterministic, so this only costs work, never
-        correctness. Rows already STAGED into this tick's chunk batch must
-        ride in ``protect_rid`` — the jitted scatter would otherwise write
-        their KV into blocks just handed to someone else."""
-        protect = self._protect(protect_rid)
-        cand = [rid for rid in self.prefilling if rid not in protect]
-        if not cand:
-            return False
-        rid = max(cand, key=lambda r: self.adm_order[self.prefilling[r][0]])
-        slot, _ = self.prefilling.pop(rid)
-        req = self.requests[rid]
-        self.mgr.free(rid)
-        self._reserved -= self._resv.pop(rid, 0)
-        self._need.pop(rid, None)
-        self.slot_req[slot] = -1
-        self.queue.appendleft(req)
-        self.stats["preemptions"] += 1
-        _PREEMPTED.inc()
-        FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
-                      phase="prefill")
-        return True
+        return self.sched.preempt_prefilling(self, protect_rid)
 
     def _preempt_from(self, cand) -> bool:
-        if self.window is not None or self._dyn_rope:
-            # the resume prefill rides the chunk path, which refuses
-            # window-recycling and dynamic-NTK for long prompts — only
-            # slots whose resume form fits one plain prefill qualify
-            cand = [s for s in cand
-                    if len(self.requests[int(self.slot_req[s])].prompt)
-                    + len(self.requests[int(self.slot_req[s])].tokens)
-                    <= self.max_prompt_len]
-        if not cand:
-            return False
-        slot = max(cand, key=lambda s: self.adm_order[s])
-        rid = int(self.slot_req[slot])
-        req = self.requests[rid]
-        req._resume = (np.concatenate(
-            [req.prompt, np.asarray(req.tokens, np.int32)])
-            if req.tokens else req.prompt)
-        self.mgr.free(rid)
-        self._reserved -= self._resv.pop(rid, 0)
-        self._need.pop(rid, None)
-        self.active[slot] = False
-        self.slot_req[slot] = -1
-        self.draft_cur[slot] = 0     # draft cache freed with the slot
-        self.queue.appendleft(req)
-        self.stats["preemptions"] += 1
-        _PREEMPTED.inc()
-        FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
-                      phase="decode")
-        return True
+        return self.sched.preempt_from(self, cand)
 
     def _allocate_or_preempt(self, rid: int, n_tokens: int, protect=None):
         """mgr.allocate with out-of-blocks recovery: preempt greedy slots
@@ -1184,7 +924,6 @@ class LLMEngine:
         is None for greedy rows, else the per-proposal draft
         distributions the accept rule needs."""
         ns = self.num_slots
-        draft = self.draft_model
         kmax = max(k for _, _, k in staged)
         all_greedy = all(float(self.temps[s]) == 0.0 for s, _, _ in staged)
         Cs = self.spec_k + 1
@@ -1208,10 +947,7 @@ class LLMEngine:
                 ids[s, :n] = seqs[s][dc: dc + n]
                 cl[s] = n
                 rp[s] = dc
-            _, self._draft_cache = _FWD_ROWS_JIT(
-                draft, jnp.asarray(ids), self._draft_cache,
-                jnp.asarray(rp, jnp.int32), None,
-                jnp.asarray(cl, jnp.int32))
+            self.exe.draft_rows(ids, rp, cl)
             for s, _, _ in staged:
                 self.draft_cur[s] += int(cl[s])
 
@@ -1226,9 +962,7 @@ class LLMEngine:
             ids[s, :len(pend)] = pend
             cl[s] = len(pend)
             rp[s] = dc
-        dl, self._draft_cache = _FWD_ROWS_JIT(
-            draft, jnp.asarray(ids), self._draft_cache,
-            jnp.asarray(rp, jnp.int32), None, jnp.asarray(cl, jnp.int32))
+        dl = self.exe.draft_rows(ids, rp, cl)
         for s, _, _ in staged:
             self.draft_cur[s] += int(cl[s])      # == cur + 1 now
         dlast = jnp.take_along_axis(
@@ -1273,10 +1007,7 @@ class LLMEngine:
                 ids1[s, 0] = props[s][-1]
                 cl1[s] = 1
                 rp1[s] = int(self.draft_cur[s])
-            dl1, self._draft_cache = _FWD_ROWS_JIT(
-                draft, jnp.asarray(ids1), self._draft_cache,
-                jnp.asarray(rp1, jnp.int32), None,
-                jnp.asarray(cl1, jnp.int32))
+            dl1 = self.exe.draft_rows(ids1, rp1, cl1)
             for s in feeding:
                 self.draft_cur[s] += 1           # == cur + r + 1
             pick_all(dl1[:, 0], feeding)
@@ -1359,14 +1090,18 @@ class LLMEngine:
             for slot, _, _ in staged:
                 self.draft_cur[slot] = min(int(self.draft_cur[slot]),
                                            int(self.cur[slot]) + 1)
+                # staging extended the HOST table, but only the verify jit
+                # would have installed those entries in the DEVICE row —
+                # roll table_len back to what the device actually covers
+                # so _grow_tables re-emits the missing entries; a later
+                # spec round is self-healing (verify gets the full row)
+                self.table_len[slot] = -(-int(self.cur[slot])
+                                         // self.block_size)
             return np.zeros(self.num_slots, bool), []
         t_dev = time.perf_counter()
         with _span("serving.verify", slots=len(staged)):
-            logits, self.cache = _VERIFY_CHUNK_JIT(
-                self.model, jnp.asarray(ids), jnp.asarray(clens),
-                jnp.asarray(offs), self.cache, jnp.asarray(slot_ids),
-                jnp.asarray(rows))
-            logits = np.asarray(logits.astype(jnp.float32))
+            logits = np.asarray(self.exe.verify_chunk(
+                ids, clens, offs, slot_ids, rows).astype(jnp.float32))
         self.stats["device_s"] += time.perf_counter() - t_dev
 
         # ---- accept/commit per slot; ONE batched length rewind after ----
@@ -1417,8 +1152,7 @@ class LLMEngine:
                            / self.stats["spec_proposed"])
         # one rewind for all staged rows: length pointers only — verify
         # wrote k_eff+1 positions, the commit kept n_acc+1 of them
-        self.cache = _REWIND_LENS_JIT(self.cache, jnp.asarray(rw_slots),
-                                      jnp.asarray(rw_lens))
+        self.exe.rewind_lens(rw_slots, rw_lens)
         self.stats["spec_ticks"] += 1
         return handled, emitted
 
@@ -1450,10 +1184,15 @@ class LLMEngine:
                         "exceeds max_prompt_len)")
                 continue
             self._update_resv(rid)
+            # install the next entry the DEVICE row is missing — normally
+            # the block just allocated (table_len == len(t)-1), but after
+            # a spec-verify fallback the host table can be ahead by more
+            # than one staged-but-never-installed block
+            idx = min(int(self.table_len[slot]), len(t) - 1)
             rows[slot] = slot
-            cols[slot] = len(t) - 1
-            vals[slot] = t[-1]
-            self.table_len[slot] = len(t)
+            cols[slot] = idx
+            vals[slot] = t[idx]
+            self.table_len[slot] = idx + 1
         if self.window is not None:
             self._recycle_window(np.nonzero(self.active & ~self.is_beam)[0])
         return rows, cols, vals
@@ -1485,11 +1224,121 @@ class LLMEngine:
             req.finish_reason = "eos" if eos else "length"
             _FINISHED.inc(reason=req.finish_reason)
             self.mgr.free(rid)
-            self._reserved -= self._resv.pop(rid, 0)
-            self._need.pop(rid, None)
+            self.kv.release(rid)
             self.active[slot] = False
             self.slot_req[slot] = -1
         return [(rid, token)]
+
+    # -------------------------------------------- KV handoff (ISSUE 7)
+    def extract_sequence(self, rid: int) -> KVPayload:
+        """Lift a prefilled/decoding greedy sequence OUT of this engine:
+        gather its KV blocks into a dense payload, then free the slot,
+        blocks, and ledger entry. The request leaves with its tokens; the
+        payload carries everything a decode replica needs to continue
+        bit-exactly (``install_sequence``). Raises for beam/chunk-mid
+        requests — only ACTIVE greedy slots are extractable (the router
+        extracts after the final prefill chunk activates the slot)."""
+        slots = np.nonzero(self.slot_req == rid)[0]
+        if not len(slots) or rid in self.prefilling or rid in self.groups:
+            raise ValueError(f"req {rid} holds no active greedy slot")
+        slot = int(slots[0])
+        if self.is_beam[slot] or not self.active[slot]:
+            raise ValueError(f"req {rid} holds no active greedy slot")
+        t = self.mgr.tables[rid]
+        if any(b is None for b in t):
+            raise NotImplementedError(
+                "cannot extract a window-recycled sequence (holes in the "
+                "block table)")
+        idx = np.zeros(self.max_blocks_per_seq, np.int32)
+        idx[:len(t)] = t
+        k, v = _GATHER_BLOCKS_JIT(self.cache.k_pools, self.cache.v_pools,
+                                  jnp.asarray(idx))
+        payload = KVPayload(
+            req=self.requests[rid], cur=int(self.cur[slot]),
+            gen=int(self.gen[slot]), last_tok=int(self.last_tok[slot]),
+            n_blocks=len(t), block_size=self.block_size, k=k, v=v)
+        # gather landed — now release host state (same order as cancel)
+        self.mgr.free(rid)
+        self.kv.release(rid)
+        self.active[slot] = False
+        self.slot_req[slot] = -1
+        self.draft_cur[slot] = 0
+        self.sched.release(rid)
+        return payload
+
+    def install_sequence(self, payload: KVPayload) -> bool:
+        """Adopt a sequence extracted from another replica: scatter its
+        blocks into this pool, install the block-table row + length, and
+        activate a slot mid-decode. Returns False (payload untouched, no
+        state changed) when no slot or not enough blocks are free —
+        the router retries later. Exception-atomic: host bookkeeping is
+        undone if allocation fails; the donating scatter runs last."""
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining — finishing in-flight requests, "
+                "admitting nothing new")
+        req = payload.req
+        if payload.block_size != self.block_size:
+            raise ValueError(f"block_size mismatch: payload "
+                             f"{payload.block_size} != {self.block_size}")
+        pool = self.cache.k_pools[0]
+        if (payload.k.shape[0] != len(self.cache.k_pools)
+                or payload.k.shape[2:] != pool.shape[1:]):
+            raise ValueError("KV payload geometry does not match this "
+                             "engine's pool (layers/heads/head_dim)")
+        if payload.cur + self._remaining(req) > self.max_seq_len:
+            raise ValueError("sequence + remaining tokens exceeds this "
+                             "engine's max_seq_len")
+        rid = req.req_id
+        if rid in self.requests:
+            raise ValueError(f"req_id {rid} already exists")
+        free = np.nonzero(self.slot_req < 0)[0]
+        wc = self.mgr.blocks_needed(payload.cur + self._remaining(req))
+        if not len(free) or wc > self.mgr.free_blocks - self._reserved:
+            return False
+        slot = int(free[0])
+        self.sched.adopt(req)
+        self.kv.begin(rid, wc)
+        try:
+            t = self.mgr.allocate(rid, payload.cur)
+        except MemoryError:
+            self.kv.release(rid)
+            self.sched.release(rid)
+            return False
+        self.kv.update(rid)
+        # NOTE: the installed blocks are NOT committed to the prefix
+        # cache — the normal admission path matches before allocating;
+        # committing here could duplicate content already parked. Only
+        # sharing is lost, never correctness.
+        idx = np.full(self.max_blocks_per_seq, self.mgr.num_blocks,
+                      np.int32)
+        idx[:len(t)] = t
+        row = np.full(self.max_blocks_per_seq, self.mgr.num_blocks,
+                      np.int32)
+        row[:len(t)] = t
+        self.cache = _INSTALL_BLOCKS_JIT(
+            self.cache, jnp.asarray(idx), payload.k, payload.v,
+            jnp.int32(slot), jnp.asarray(row), jnp.int32(payload.cur))
+        self.slot_req[slot] = rid
+        self.active[slot] = True
+        self.is_beam[slot] = False
+        self.cur[slot] = payload.cur
+        self.gen[slot] = payload.gen
+        self.max_gen[slot] = payload.gen + self._remaining(req)
+        self.table_len[slot] = len(t)
+        self.last_tok[slot] = payload.last_tok
+        self.temps[slot] = (self.default_temp if req.temperature is None
+                            else req.temperature)
+        self.top_ps[slot] = (self.default_top_p if req.top_p is None
+                             else req.top_p)
+        self._adm_counter += 1
+        self.adm_order[slot] = self._adm_counter
+        # empty draft frontier: the decode replica's spec path re-feeds
+        # the whole committed sequence through its own draft cache
+        self.draft_cur[slot] = 0
+        self.slot_k[slot] = self.spec_k
+        self._acc_ema[slot] = 1.0
+        return True
 
     def _refresh_gauges(self):
         """Point-in-time engine state → gauges (queue depth, active
@@ -1501,18 +1350,7 @@ class LLMEngine:
         _KV_IN_USE.set(used)
         _KV_UTIL.set(used / self.mgr.num_blocks if self.mgr.num_blocks
                      else 0.0)
-        stats = getattr(self.mgr, "cache_stats", None)
-        if stats is not None:
-            # counters are process-global and cumulative; the manager's
-            # stats are per-engine — push only what this engine added
-            # since the last refresh
-            _PREFIX_HITS.inc(stats["hit_blocks"]
-                             - self._prefix_pushed["hit_blocks"])
-            _PREFIX_EVICTIONS.inc(stats["evictions"]
-                                  - self._prefix_pushed["evictions"])
-            self._prefix_pushed = dict(stats)
-            _PREFIX_HIT_RATE.set(stats["hit_blocks"]
-                                 / max(stats["lookup_blocks"], 1))
+        self.kv.push_prefix_metrics()
 
     def step(self):
         """One engine tick — see :meth:`_step_impl`. Wrapped here so the
@@ -1547,6 +1385,10 @@ class LLMEngine:
         if admits or beam_admits:
             emitted += self._prefill(admits, beam_admits)
         emitted += self._prefill_chunks()
+        if self.prefill_only:
+            # prefill-role replica: newly activated slots carry their
+            # first token; the router extracts them — never decode here
+            return emitted
         if not self.active.any():
             return emitted
         # speculative draft-and-verify for eligible slots; the plain
@@ -1567,24 +1409,21 @@ class LLMEngine:
             # this tick paid ONE target forward for k+1 positions per slot
             return emitted
         t0 = perf_counter()
-        rows, cols, vals = self._grow_tables(run_mask & ~self.is_beam)
-        # growth may have preempted slots — recompute the mask after it
-        run_mask = self.active & ~spec_handled
-        self.rng, sub = jax.random.split(self.rng)
         if self._is_moe:
             # chaos: a dead expert shard fails the token all_to_all. Fires
-            # BEFORE the donating tick jit, so an injected exception aborts
-            # the tick with the cache intact and every grown block still
-            # owned by its request's table — cancel/free reclaims them and
+            # BEFORE table growth and the donating tick jit, so an injected
+            # exception aborts the tick with the cache, tables, and
+            # table_len untouched — cancel/free reclaims every block and
             # assert_quiescent stays clean (exception-atomic).
             fault_point("serving.moe_dispatch", engine=self,
                         slots=np.nonzero(run_mask)[0])
+        rows, cols, vals = self._grow_tables(run_mask & ~self.is_beam)
+        # growth may have preempted slots — recompute the mask after it
+        run_mask = self.active & ~spec_handled
         t1 = perf_counter()
-        nxt, logp, self.cache = _TICK_JIT(
-            self.model, jnp.asarray(self.last_tok), self.cache,
-            jnp.asarray(run_mask), jnp.asarray(rows), jnp.asarray(cols),
-            jnp.asarray(vals), sub, jnp.asarray(self.temps),
-            jnp.asarray(self.top_ps), self.top_k, bool(self.groups))
+        nxt, logp = self.exe.decode_tick(
+            self.last_tok, run_mask, rows, cols, vals, self.temps,
+            self.top_ps, bool(self.groups))
         was_active = run_mask.copy()
         nxt = np.asarray(nxt)                 # the one per-tick host fetch
         t2 = perf_counter()

@@ -1,0 +1,126 @@
+"""ModelExecutor: the device half of the decomposed engine (ISSUE 7).
+
+Owns the paged KV cache, the draft-model dense cache, the engine PRNG
+key, and every jitted program the tick runs — slot-aware prefill, the
+chunked-prefill/verify forwards, the fused decode tick, beam-group
+cache updates, and row sampling. Callers hand in fixed-shape numpy
+staging arrays and get logits/tokens back; all cache donation happens
+inside this class, so an exception raised BEFORE a call here leaves
+``self.cache`` intact (the exception-atomicity contract the chaos
+sites rely on).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.decoding import KVCache, _sample_rows
+from paddle_tpu.models.paged import (PagedKVCache, _BEAM_GROUP_UPDATE_JIT,
+                                     _PREFILL_CHUNK_JIT, _PREFILL_JIT,
+                                     _REWIND_LENS_JIT, _TICK_JIT,
+                                     _VERIFY_CHUNK_JIT)
+from paddle_tpu.models.speculative import _FWD_ROWS_JIT
+
+# module-level so its compile cache persists across admissions
+_SAMPLE_ROWS_JIT = jax.jit(_sample_rows, static_argnums=(4,))
+
+
+class ModelExecutor:
+    """Jitted prefill/decode/verify programs over one paged KV pool."""
+
+    def __init__(self, model, *, num_slots, num_blocks, block_size,
+                 max_blocks_per_seq, top_k=None, seed=0, draft_model=None,
+                 spec_k=4, max_seq_len=None):
+        cfg = model.cfg
+        self.model = model
+        self.top_k = top_k
+        self.rng = jax.random.PRNGKey(seed)
+        self.cache = PagedKVCache.init(
+            cfg.num_hidden_layers, num_blocks, block_size,
+            cfg.num_key_value_heads,
+            cfg.hidden_size // cfg.num_attention_heads,
+            num_slots, max_blocks_per_seq, cfg.dtype)
+        self.draft_model = draft_model
+        self._draft_cache = None
+        if draft_model is not None:
+            dcfg = draft_model.cfg
+            self._draft_cache = KVCache.init(
+                dcfg.num_hidden_layers, num_slots,
+                max_seq_len + spec_k + 2,
+                dcfg.num_key_value_heads,
+                dcfg.hidden_size // dcfg.num_attention_heads, dcfg.dtype)
+
+    def next_key(self):
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+    # ------------------------------------------------------------ prefill
+    def prefill(self, ids, lens, slots, rows):
+        """Slot-aware padded prefill: admitted prompts scattered into
+        their cache slots while other slots keep decoding state."""
+        logits, self.cache = _PREFILL_JIT(
+            self.model, jnp.asarray(ids), jnp.asarray(lens),
+            self.cache, jnp.asarray(slots), jnp.asarray(rows))
+        return logits
+
+    def prefill_chunk(self, ids, lens, offs, slots, rows):
+        """One chunk per row, written from an arbitrary offset over the
+        slot's pool prefix (chunked prefill / prefix-cache resume)."""
+        logits, self.cache = _PREFILL_CHUNK_JIT(
+            self.model, jnp.asarray(ids), jnp.asarray(lens),
+            jnp.asarray(offs), self.cache, jnp.asarray(slots),
+            jnp.asarray(rows))
+        return logits
+
+    def verify_chunk(self, ids, clens, offs, slot_ids, rows):
+        """Target forward over each slot's proposal window (spec decode);
+        shares the chunked-prefill program shape."""
+        logits, self.cache = _VERIFY_CHUNK_JIT(
+            self.model, jnp.asarray(ids), jnp.asarray(clens),
+            jnp.asarray(offs), self.cache, jnp.asarray(slot_ids),
+            jnp.asarray(rows))
+        return logits
+
+    def rewind_lens(self, slots, lens):
+        """Length-pointer-only rewind after a partial spec accept."""
+        self.cache = _REWIND_LENS_JIT(self.cache, jnp.asarray(slots),
+                                      jnp.asarray(lens))
+
+    # ------------------------------------------------------------- decode
+    def decode_tick(self, last_tok, run_mask, rows, cols, vals, temps,
+                    top_ps, need_logp):
+        """The fused one-token tick: incremental table update + paged
+        attention + on-device sampling. Returns (sampled [num_slots],
+        logp [num_slots, vocab] or None per ``need_logp``)."""
+        sub = self.next_key()
+        nxt, logp, self.cache = _TICK_JIT(
+            self.model, jnp.asarray(last_tok), self.cache,
+            jnp.asarray(run_mask), jnp.asarray(rows), jnp.asarray(cols),
+            jnp.asarray(vals), sub, jnp.asarray(temps),
+            jnp.asarray(top_ps), self.top_k, need_logp)
+        return nxt, logp
+
+    def beam_group_update(self, slots, rows, lens_val, copy_src, copy_dst):
+        """Install forked beam tables + partial-block copy-on-write."""
+        self.cache = _BEAM_GROUP_UPDATE_JIT(
+            self.cache, jnp.asarray(slots, jnp.int32), jnp.asarray(rows),
+            jnp.asarray(lens_val, jnp.int32), jnp.asarray(copy_src),
+            jnp.asarray(copy_dst))
+
+    # ------------------------------------------------------------- sample
+    def sample(self, logits, temps, top_ps, key=None):
+        """Per-row temperature/top-k/top-p sampling (host fetch)."""
+        sub = self.next_key() if key is None else key
+        return np.asarray(_SAMPLE_ROWS_JIT(
+            logits.astype(jnp.float32), sub, jnp.asarray(temps),
+            jnp.asarray(top_ps), self.top_k))
+
+    # -------------------------------------------------------------- draft
+    def draft_rows(self, ids, rp, cl):
+        """One draft-model forward over per-row chunks of the dense
+        draft cache (speculative proposal feeds)."""
+        logits, self._draft_cache = _FWD_ROWS_JIT(
+            self.draft_model, jnp.asarray(ids), self._draft_cache,
+            jnp.asarray(rp, jnp.int32), None, jnp.asarray(cl, jnp.int32))
+        return logits

@@ -1,0 +1,122 @@
+"""KVManager: block tables, prefix cache, and the reservation ledger.
+
+The middle layer of the decomposed engine (ISSUE 7). It owns the
+:class:`~paddle_tpu.models.paged.PrefixCachingBlockManager` (host-side
+free-list + content-hashed prefix pool) plus the RESERVATION LEDGER the
+admission discipline runs on: ``need[rid]`` is a request's worst-case
+block count, ``resv[rid]`` the part not yet materialised as live table
+entries, and ``reserved`` their sum — the blocks the free list must
+keep clear of other requests. The scheduler decides WHO gets blocks;
+this layer tracks what was promised.
+"""
+from __future__ import annotations
+
+from paddle_tpu.models.paged import PrefixCachingBlockManager
+from paddle_tpu.serving.telemetry import (_PREFIX_EVICTIONS,
+                                          _PREFIX_HIT_RATE, _PREFIX_HITS)
+
+
+class KVManager:
+    """Block allocation + worst-case reservation accounting."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        # refcounted + content-hashed: beam groups share prompt blocks
+        # copy-on-write; requests with equal prompt prefixes share the
+        # prefix blocks outright (prefill only runs on the uncached
+        # suffix); with no sharing it behaves exactly like BlockManager
+        self.mgr = PrefixCachingBlockManager(num_blocks, block_size)
+        self.reserved = 0            # blocks promised to in-flight requests
+        self.resv: dict[int, int] = {}    # req_id -> outstanding reserve
+        self.need: dict[int, int] = {}    # req_id -> worst-case blocks
+        self._prefix_pushed = dict(self.mgr.cache_stats)
+
+    # --------------------------------------------------- pool passthroughs
+    @property
+    def num_blocks(self):
+        return self.mgr.num_blocks
+
+    @property
+    def block_size(self):
+        return self.mgr.block_size
+
+    @property
+    def free_blocks(self):
+        return self.mgr.free_blocks
+
+    @property
+    def tables(self):
+        return self.mgr.tables
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return self.mgr.blocks_needed(n_tokens)
+
+    def allocate(self, rid: int, n_tokens: int):
+        return self.mgr.allocate(rid, n_tokens)
+
+    def free(self, rid: int):
+        self.mgr.free(rid)
+
+    # ------------------------------------------------------------- ledger
+    def live_blocks(self, rid: int) -> int:
+        """Blocks currently held (window recycling leaves None holes)."""
+        return sum(b is not None for b in self.mgr.tables.get(rid, []))
+
+    def begin(self, rid: int, need: int):
+        """Open a ledger entry: worst case recorded, nothing held yet."""
+        self.need[rid] = need
+        self.resv[rid] = 0
+
+    def hold(self, rid: int, n: int):
+        """Set the outstanding reserve to ``n`` blocks (chunk-prefill and
+        beam admissions hold their whole worst case up front)."""
+        self.reserved += n - self.resv.get(rid, 0)
+        self.resv[rid] = n
+
+    def update(self, rid: int, live: int = None):
+        """Outstanding reserve = worst case minus blocks currently held
+        (recycling under a sliding window RETURNS headroom). Beam groups
+        pass their deduplicated ``live`` count (shared prompt blocks
+        appear in several beams' tables)."""
+        if live is None:
+            live = self.live_blocks(rid)
+        new = max(0, self.need[rid] - live)
+        self.reserved += new - self.resv[rid]
+        self.resv[rid] = new
+
+    def release(self, rid: int):
+        """Close the ledger entry, returning its reserve to the pool."""
+        self.reserved -= self.resv.pop(rid, 0)
+        self.need.pop(rid, None)
+
+    def headroom(self, rid: int = None) -> int:
+        """Free blocks net of OTHER requests' standing reservations."""
+        others = self.reserved - (self.resv.get(rid, 0) if rid is not None
+                                  else 0)
+        return self.free_blocks - max(0, others)
+
+    # ----------------------------------------------------------- hygiene
+    def assert_quiescent(self):
+        """Every block back in the pool (prefix-cache parked blocks count
+        — they are reclaimable), no standing reservations, no tables."""
+        assert self.mgr.free_blocks == self.mgr.num_blocks, (
+            f"block leak: {self.mgr.num_blocks - self.mgr.free_blocks} "
+            f"of {self.mgr.num_blocks} blocks unaccounted for")
+        assert self.reserved == 0, f"reservation leak: {self.reserved}"
+        assert not self.resv and not self.need, (
+            f"ledger leak: resv={self.resv} need={self.need}")
+        assert not self.mgr.tables, f"table leak: {list(self.mgr.tables)}"
+
+    def push_prefix_metrics(self):
+        """Counters are process-global and cumulative; the manager's
+        stats are per-engine — push only what this engine added since
+        the last refresh."""
+        stats = getattr(self.mgr, "cache_stats", None)
+        if stats is None:
+            return
+        _PREFIX_HITS.inc(stats["hit_blocks"]
+                         - self._prefix_pushed["hit_blocks"])
+        _PREFIX_EVICTIONS.inc(stats["evictions"]
+                              - self._prefix_pushed["evictions"])
+        self._prefix_pushed = dict(stats)
+        _PREFIX_HIT_RATE.set(stats["hit_blocks"]
+                             / max(stats["lookup_blocks"], 1))

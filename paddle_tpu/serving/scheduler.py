@@ -1,0 +1,271 @@
+"""Scheduler: admission, deadlines, preemption policy, backpressure.
+
+The policy layer of the decomposed engine (ISSUE 7). It owns the FCFS
+queue, the request registry, intake backpressure (bounded queue +
+drain flag), wall-clock deadlines, and the preemption victim policy.
+It mutates slot/ledger state only through the orchestrating
+:class:`~paddle_tpu.serving.engine.LLMEngine` (``eng``) passed into the
+policy methods — the device cache never appears here.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+
+import numpy as np
+
+from paddle_tpu.observability.flight import FLIGHT
+from paddle_tpu.serving.telemetry import (_ADMITTED, _PREEMPTED,
+                                          _QUEUE_WAIT, _REJECTED)
+from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
+                                      Request)
+
+
+class Scheduler:
+    """FCFS admission queue + deadline/preemption/backpressure policy."""
+
+    def __init__(self, max_queue_len=None, clock=None):
+        self.queue: deque[Request] = deque()
+        self.requests: dict[int, Request] = {}
+        self._ids = itertools.count()
+        # robustness: bounded admission queue (None = unbounded), a
+        # swappable clock (tests drive deadlines deterministically), and
+        # the drain flag (graceful shutdown: finish in-flight, admit
+        # nothing new)
+        self.max_queue_len = max_queue_len
+        self.clock = clock if clock is not None else time.monotonic
+        self.draining = False
+        self.has_deadlines = False
+
+    # ------------------------------------------------------------- intake
+    def check_backpressure(self, stats: dict):
+        """Reject-on-full/reject-while-draining intake gates — push the
+        load signal to the caller instead of buffering unboundedly."""
+        if self.draining:
+            stats["rejected"] += 1
+            _REJECTED.inc(reason="draining")
+            raise EngineDrainingError(
+                "engine is draining — finishing in-flight requests, "
+                "admitting nothing new")
+        if (self.max_queue_len is not None
+                and len(self.queue) >= self.max_queue_len):
+            stats["rejected"] += 1
+            _REJECTED.inc(reason="queue_full")
+            raise QueueFullError(
+                f"admission queue full ({self.max_queue_len} waiting) — "
+                "shed load or retry later")
+
+    def enqueue(self, req: Request) -> int:
+        """Assign/validate the request id, stamp the submit time, and
+        append to the FCFS queue."""
+        if req.req_id is None:
+            req.req_id = next(self._ids)
+        else:
+            if req.req_id in self.requests:
+                # a duplicate id would alias the BlockManager table AND
+                # the reservation ledger of the in-flight request
+                raise ValueError(f"req_id {req.req_id} already exists")
+            # keep auto ids from ever colliding with explicit ones
+            self._ids = itertools.count(
+                max(req.req_id + 1, next(self._ids)))
+        req._submit_t = self.clock()
+        if req.deadline_s is not None or req.max_queue_s is not None:
+            self.has_deadlines = True
+        self.requests[req.req_id] = req
+        self.queue.append(req)
+        return req.req_id
+
+    def adopt(self, req: Request) -> int:
+        """Register an already-prefilled request WITHOUT queueing it —
+        the disaggregated install path (router KV handoff)."""
+        if req.req_id is None or req.req_id in self.requests:
+            raise ValueError(f"install needs a fresh explicit req_id, "
+                             f"got {req.req_id!r}")
+        if req.deadline_s is not None or req.max_queue_s is not None:
+            self.has_deadlines = True
+        self.requests[req.req_id] = req
+        return req.req_id
+
+    def pop_finished(self) -> dict:
+        done = {rid: r for rid, r in self.requests.items() if r.done}
+        for rid in done:
+            del self.requests[rid]
+        return done
+
+    def release(self, rid: int) -> Request:
+        """Forget a request without finishing it (router pull-back)."""
+        return self.requests.pop(rid, None)
+
+    # ---------------------------------------------------------- deadlines
+    def expire(self, cancel):
+        """Finish requests whose wall-clock budget ran out: absolute
+        ``deadline_s`` for everyone, ``max_queue_s`` additionally for
+        requests still waiting for admission. Runs at the top of every
+        tick — an expired request frees its slot/blocks THIS tick, so
+        deadlines double as livelock bounds."""
+        if not self.has_deadlines or not self.requests:
+            return
+        now = self.clock()
+        queued = {r.req_id for r in self.queue}
+        for rid, r in list(self.requests.items()):
+            if r.done or r._submit_t is None:
+                continue
+            age = now - r._submit_t
+            if ((r.deadline_s is not None and age >= r.deadline_s)
+                    or (rid in queued and r.max_queue_s is not None
+                        and age >= r.max_queue_s)):
+                cancel(rid, reason="timeout")
+
+    # ---------------------------------------------------------- admission
+    def select_admissions(self, eng):
+        """FCFS: move queued requests into free slots while the pool can
+        cover their worst case; returns (greedy (slot, req) pairs,
+        beam (slots, req) pairs). A beam request needs num_beams slots."""
+        kv = eng.kv
+        free_slots = list(np.nonzero(eng.slot_req < 0)[0])
+        admits, beam_admits = [], []
+        while self.queue and free_slots:
+            req = self.queue[0]
+            k = req.num_beams
+            p = eng._pr(req)
+            # prefix-cache lookup BEFORE the capacity gate: shared blocks
+            # cost nothing, so a mostly-cached prompt admits under
+            # pressure an uncached one would wait out
+            cached = (kv.mgr.match_prefix(p)
+                      if eng.prefix_caching and k == 1 else [])
+            ct = len(cached) * eng.block_size
+            if eng.preemption and k == 1:
+                # optimistic: cover only the first prefill chunk (+1
+                # decode-headroom block); out-of-blocks later preempts
+                need = (kv.blocks_needed(
+                    min(len(p), ct + eng.max_prompt_len)) - len(cached) + 1)
+            else:
+                need = eng._worst_case_blocks(req)
+            if (k > len(free_slots)
+                    or need > kv.free_blocks - kv.reserved):
+                break                      # FCFS: do not starve the head
+            self.queue.popleft()
+            _ADMITTED.inc()
+            if req._submit_t is not None:
+                _QUEUE_WAIT.observe(max(0.0, self.clock() - req._submit_t))
+            if eng.preemption and k == 1:
+                need = 0                   # no standing reservation
+            kv.begin(req.req_id, need)
+            if k == 1:
+                slot = int(free_slots.pop(0))
+                if cached:
+                    kv.mgr.adopt_prefix(req.req_id, cached)
+                if cached or len(p) > eng.max_prompt_len:
+                    # chunk-prefill path from offset ct: claims the slot
+                    # INACTIVE; blocks allocate chunk-by-chunk against
+                    # the reservation. (Cached short prompts ride it too —
+                    # the chunk program is the one that prefills from an
+                    # arbitrary offset over the slot's pool prefix.)
+                    kv.hold(req.req_id, need)
+                    eng.slot_req[slot] = req.req_id
+                    # admission recency stamped at slot-claim: preemption
+                    # victim selection keys on THIS, not on req_id (user
+                    # ids need not be monotonic with admission)
+                    eng._adm_counter += 1
+                    eng.adm_order[slot] = eng._adm_counter
+                    eng.prefilling[req.req_id] = (slot, ct)
+                    continue
+                kv.allocate(req.req_id, len(p))
+                if eng.prefix_caching:
+                    kv.mgr.commit_prefix(req.req_id, p)
+                kv.update(req.req_id)
+                admits.append((slot, req))
+            else:
+                slots = [int(free_slots.pop(0)) for _ in range(k)]
+                # full worst-case reservation up front; relaxed to
+                # (need - live) as the group's blocks materialise
+                kv.hold(req.req_id, need)
+                beam_admits.append((slots, req))
+        return admits, beam_admits
+
+    # --------------------------------------------------------- preemption
+    @staticmethod
+    def _protect(protect_rid):
+        """Normalise the protect argument to a set of req_ids (a single
+        rid, an iterable of rids, or None)."""
+        if protect_rid is None:
+            return frozenset()
+        if isinstance(protect_rid, (set, frozenset, list, tuple)):
+            return frozenset(protect_rid)
+        return frozenset((protect_rid,))
+
+    def preempt(self, eng, protect_rid=None) -> bool:
+        """Evict the YOUNGEST active greedy request (LIFO — vLLM's policy:
+        the oldest in-flight work is closest to completion) to free its
+        blocks. The victim re-queues at the queue head with resume-prompt
+        = prompt + generated-so-far; on re-admission the resume prefill
+        recomputes its KV (prefix-cache hits cover whatever of its old
+        blocks survived). When no active slot qualifies, falls back to
+        evicting a CHUNK-PREFILLING request (slot inactive, blocks held):
+        without this, two long prompts mid-prefill on a dry pool would
+        spin forever — neither active nor evictable. Returns False when
+        nothing is preemptible."""
+        protect = self._protect(protect_rid)
+        cand = [int(s) for s in np.nonzero(eng.active & ~eng.is_beam)[0]
+                if int(eng.slot_req[s]) not in protect]
+        if self.preempt_from(eng, cand):
+            return True
+        return self.preempt_prefilling(eng, protect_rid)
+
+    def preempt_prefilling(self, eng, protect_rid=None) -> bool:
+        """Evict the youngest in-flight chunked prefill — youngest by
+        ADMISSION order (``adm_order`` stamped at slot-claim), not by
+        req_id: ids may be user-supplied and non-monotonic, and evicting
+        an explicitly-numbered old request as if youngest would churn the
+        work closest to completion. Free its blocks and re-queue it at
+        the head; consumed chunks are recomputed on re-admission —
+        prefill is deterministic, so this only costs work, never
+        correctness. Rows already STAGED into this tick's chunk batch must
+        ride in ``protect_rid`` — the jitted scatter would otherwise write
+        their KV into blocks just handed to someone else."""
+        protect = self._protect(protect_rid)
+        cand = [rid for rid in eng.prefilling if rid not in protect]
+        if not cand:
+            return False
+        rid = max(cand, key=lambda r: eng.adm_order[eng.prefilling[r][0]])
+        slot, _ = eng.prefilling.pop(rid)
+        req = self.requests[rid]
+        eng.kv.free(rid)
+        eng.kv.release(rid)
+        eng.slot_req[slot] = -1
+        self.queue.appendleft(req)
+        eng.stats["preemptions"] += 1
+        _PREEMPTED.inc()
+        FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
+                      phase="prefill")
+        return True
+
+    def preempt_from(self, eng, cand) -> bool:
+        if eng.window is not None or eng._dyn_rope:
+            # the resume prefill rides the chunk path, which refuses
+            # window-recycling and dynamic-NTK for long prompts — only
+            # slots whose resume form fits one plain prefill qualify
+            cand = [s for s in cand
+                    if len(self.requests[int(eng.slot_req[s])].prompt)
+                    + len(self.requests[int(eng.slot_req[s])].tokens)
+                    <= eng.max_prompt_len]
+        if not cand:
+            return False
+        slot = max(cand, key=lambda s: eng.adm_order[s])
+        rid = int(eng.slot_req[slot])
+        req = self.requests[rid]
+        req._resume = (np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+            if req.tokens else req.prompt)
+        eng.kv.free(rid)
+        eng.kv.release(rid)
+        eng.active[slot] = False
+        eng.slot_req[slot] = -1
+        eng.draft_cur[slot] = 0     # draft cache freed with the slot
+        self.queue.appendleft(req)
+        eng.stats["preemptions"] += 1
+        _PREEMPTED.inc()
+        FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
+                      phase="decode")
+        return True

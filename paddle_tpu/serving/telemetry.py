@@ -1,0 +1,109 @@
+"""Serving-layer metric instruments (engine + router).
+
+One module so the scheduler, engine, and router share the same
+process-global instruments without import cycles. Request-relative
+timings (TTFT, inter-token latency, queue wait) use the ENGINE clock —
+the swappable ``clock`` ctor arg — so deadline tests driving a fake
+clock see deterministic histograms; host work timings (tick, drain) use
+the real monotonic clock. A serve loop exports everything with
+``paddle_tpu.observability.dump(prefix)``.
+"""
+from paddle_tpu.observability import METRICS
+
+# ------------------------------------------------------------- engine
+_ADMITTED = METRICS.counter(
+    "serving_admissions_total", "requests admitted into cache slots")
+_PREEMPTED = METRICS.counter(
+    "serving_preemptions_total", "requests evicted and re-queued")
+_TIMEOUTS = METRICS.counter(
+    "serving_timeouts_total", "requests expired (deadline_s/max_queue_s)")
+_CANCELLED = METRICS.counter(
+    "serving_cancellations_total", "requests cancelled by the caller")
+_REJECTED = METRICS.counter(
+    "serving_rejections_total", "admissions refused at intake",
+    labelnames=("reason",))
+_TOKENS = METRICS.counter(
+    "serving_tokens_total", "tokens sampled and emitted")
+_FINISHED = METRICS.counter(
+    "serving_finished_total", "requests finished, by finish_reason",
+    labelnames=("reason",))
+_QUEUE_DEPTH = METRICS.gauge(
+    "serving_queue_depth", "requests waiting for admission")
+_ACTIVE_SLOTS = METRICS.gauge(
+    "serving_active_slots", "cache slots actively decoding")
+_KV_IN_USE = METRICS.gauge(
+    "serving_kv_blocks_in_use", "paged KV blocks currently allocated")
+_KV_UTIL = METRICS.gauge(
+    "serving_kv_block_utilization", "allocated fraction of the KV pool")
+_TTFT = METRICS.histogram(
+    "serving_ttft_seconds", "submission → first token (engine clock)")
+_TOK_LAT = METRICS.histogram(
+    "serving_token_latency_seconds", "inter-token gap (engine clock)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
+_QUEUE_WAIT = METRICS.histogram(
+    "serving_queue_wait_seconds", "submission → admission (engine clock)")
+_TICK = METRICS.histogram(
+    "serving_tick_seconds", "wall time of one engine tick")
+_DRAIN = METRICS.histogram(
+    "serving_drain_seconds", "wall time of graceful drain",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
+# speculative decoding (ISSUE 5): proposal/acceptance accounting plus the
+# per-tick commit size — tokens_per_tick > 1 is the whole point
+_SPEC_PROPOSED = METRICS.counter(
+    "serving_spec_proposed_total", "draft tokens proposed for verification")
+_SPEC_ACCEPTED = METRICS.counter(
+    "serving_spec_accepted_total", "draft tokens accepted by the target")
+_SPEC_FALLBACKS = METRICS.counter(
+    "serving_spec_fallbacks_total",
+    "spec ticks abandoned before verify (fault injection) — the engine "
+    "fell back to the one-token tick")
+_SPEC_RATE = METRICS.gauge(
+    "serving_spec_acceptance_rate",
+    "cumulative accepted/proposed draft-token ratio")
+_SPEC_TOKENS = METRICS.histogram(
+    "serving_spec_tokens_per_tick",
+    "tokens committed per slot per speculative tick",
+    buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
+# prefix cache: cumulative adopt/evict counts exported from the block
+# manager's cache_stats (deltas pushed each gauge refresh), plus the
+# lifetime hit rate (blocks adopted / blocks prefill would have written)
+_PREFIX_HITS = METRICS.counter(
+    "serving_prefix_hit_blocks_total",
+    "prompt blocks adopted from the prefix cache instead of prefilled")
+_PREFIX_EVICTIONS = METRICS.counter(
+    "serving_prefix_evictions_total",
+    "parked prefix blocks evicted to satisfy new allocations")
+_PREFIX_HIT_RATE = METRICS.gauge(
+    "serving_prefix_hit_rate",
+    "prefix-cache hit blocks / prompt blocks requested (lifetime)")
+# MoE serving: routing choices dropped by expert-capacity overflow
+# (always 0 for dropless models — Mixtral/Qwen2-MoE serve with
+# capacity_factor=None)
+_MOE_DROPPED = METRICS.counter(
+    "moe_dropped_tokens_total",
+    "MoE routing assignments dropped at expert capacity")
+
+# ------------------------------------------------------------- router
+_R_DISPATCH = METRICS.counter(
+    "router_dispatch_total", "requests dispatched to a replica",
+    labelnames=("replica",))
+_R_REQUEUES = METRICS.counter(
+    "router_requeues_total",
+    "requests pulled back from a replica and re-dispatched (replica "
+    "death, KV-transfer failure, dispatch fault, drain rebalancing)")
+_R_OUTSTANDING = METRICS.gauge(
+    "router_replica_outstanding", "not-yet-finished requests per replica",
+    labelnames=("replica",))
+_R_HEALTH = METRICS.gauge(
+    "router_replica_health",
+    "per-replica health verdict (0 OK / 1 WARN / 2 CRIT)",
+    labelnames=("replica",))
+_R_TRANSFERS = METRICS.counter(
+    "router_kv_transfers_total",
+    "prefilled sequences shipped prefill→decode (disaggregated mode)")
+_R_TRANSFER_BLOCKS = METRICS.counter(
+    "router_kv_transfer_blocks_total",
+    "KV blocks shipped prefill→decode (disaggregated mode)")
+_R_DEATHS = METRICS.counter(
+    "router_replica_deaths_total", "replicas declared dead by the router")

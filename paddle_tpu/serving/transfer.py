@@ -1,0 +1,94 @@
+"""KV handoff between engine replicas (disaggregated prefill/decode).
+
+DistServe/Splitwise-style split: a prefill-role replica runs admission +
+chunked prefill, then its finished sequences move to a decode-role
+replica. The unit of transfer is a :class:`KVPayload` — the sequence's
+KV blocks gathered out of the source pool into a dense ``[L, max_blocks,
+block_size, H_kv, D]`` tensor pair plus the host bookkeeping needed to
+resume decoding bit-exactly (cur/gen/last_tok).
+
+:class:`KVTransfer` is the seam a real multi-host wire plugs into
+(ProcessGroupNCCL send/recv in the Paddle stack, a device collective
+over the mesh here). The in-process :class:`DeviceKVTransfer` is a
+``jax.device_put`` onto the target pool's device — a device-to-device
+copy when replicas live on different devices, a no-op view otherwise.
+
+Both jitted programs here are fixed-shape per (engine geometry), so
+repeated handoffs never recompile: gather pads the block-index vector
+to ``max_blocks_per_seq`` (extra rows are gathered then ignored),
+install pads with the ``num_blocks`` sentinel so the donating scatter
+drops them.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.serving.types import Request
+
+
+@dataclass
+class KVPayload:
+    """One prefilled sequence in flight between replicas."""
+    req: Request
+    cur: int                 # tokens stored in the source cache
+    gen: int                 # tokens generated so far (1 after prefill)
+    last_tok: int            # sampled but not yet written to cache
+    n_blocks: int            # leading rows of k/v that are real
+    block_size: int
+    k: object                # [L, max_blocks, block_size, H_kv, D]
+    v: object
+
+    @property
+    def tokens_bytes(self):
+        return self.k.nbytes + self.v.nbytes
+
+
+def _gather_blocks(k_pools, v_pools, idx):
+    k = jnp.stack([p[idx] for p in k_pools])
+    v = jnp.stack([p[idx] for p in v_pools])
+    return k, v
+
+
+_GATHER_BLOCKS_JIT = jax.jit(_gather_blocks)
+
+
+def _install_blocks(cache, idx, k, v, slot, row, cur):
+    k_pools = [p.at[idx].set(k[li], mode="drop")
+               for li, p in enumerate(cache.k_pools)]
+    v_pools = [p.at[idx].set(v[li], mode="drop")
+               for li, p in enumerate(cache.v_pools)]
+    tables = cache.block_tables.at[slot].set(row)
+    lens = cache.lens.at[slot].set(cur)
+    return type(cache)(k_pools, v_pools, tables, lens)
+
+
+_INSTALL_BLOCKS_JIT = jax.jit(_install_blocks, donate_argnums=(0,))
+
+
+class KVTransfer:
+    """Moves a payload's tensors onto the target replica's device. The
+    base class is the identity wire (same process, same device) — a
+    multi-host deployment subclasses ``ship`` with its RDMA/collective
+    transport; everything above this seam is transport-agnostic."""
+
+    def ship(self, payload: KVPayload, target_engine) -> KVPayload:
+        return payload
+
+
+class DeviceKVTransfer(KVTransfer):
+    """In-process device-to-device copy: place the gathered blocks on
+    whatever device holds the target engine's pool (jax makes this a
+    direct D2D copy when source and target differ, a no-op view when
+    they share a device — the single-host test/bench case)."""
+
+    def ship(self, payload: KVPayload, target_engine) -> KVPayload:
+        pool = target_engine.cache.k_pools[0]
+        devs = getattr(pool, "devices", None)
+        dev = next(iter(devs())) if callable(devs) else None
+        if dev is not None:
+            payload.k = jax.device_put(payload.k, dev)
+            payload.v = jax.device_put(payload.v, dev)
+        return payload

@@ -19,6 +19,16 @@ rule is installed). Tests install rules against site names:
                      expert all_to_all — a dead expert shard); an
                      exception aborts the tick exception-atomically:
                      no blocks leak and ``assert_quiescent`` stays clean
+    router.dispatch  before a request is handed to a replica engine —
+                     fires pre-add, so the request stays with the router
+                     (requeued, re-dispatched next step)
+    router.kv_transfer  before a prefilled sequence is extracted for the
+                     prefill→decode handoff; exception-atomic — the
+                     sequence is pulled back and requeued, no blocks leak
+                     on either replica
+    router.replica_death  before a replica's step — an exception marks
+                     the replica dead; its live requests requeue to a
+                     healthy replica exactly once
     train.step       top of each trainer step (exception / stall)
     train.loss       loss override — return value replaces the real loss
                      (NaN injection)
