@@ -562,6 +562,30 @@ def bench_host_overlap():
             "mfu_overlap": g.get("train_mfu_overlap", 0.0)}
 
 
+def _traced_leg_stats(g0, w0):
+    """TTFT-breakdown percentiles (p50/p95 per leg, ms) and the leg's
+    goodput ratio, read from the request tracker and the goodput ledger
+    after a run traced with REQUESTS enabled (ISSUE 9). ``g0``/``w0``
+    are the ledger totals snapshotted before the leg, so the ratio
+    covers only this leg's tokens."""
+    import numpy as np
+    from paddle_tpu.observability import GOODPUT, REQUESTS
+    breakdown = {}
+    sums = REQUESTS.summaries()
+    for leg in ("queue_s", "prefill_s", "handoff_s", "first_decode_s"):
+        vals = [s["breakdown"][leg] for s in sums]
+        if vals:
+            name = leg[:-2]
+            breakdown[f"{name}_p50_ms"] = round(
+                float(np.percentile(vals, 50)) * 1e3, 3)
+            breakdown[f"{name}_p95_ms"] = round(
+                float(np.percentile(vals, 95)) * 1e3, 3)
+    g = GOODPUT.good_total() - g0
+    w = GOODPUT.waste_total() - w0
+    ratio = round(g / (g + w), 4) if (g + w) else None
+    return breakdown, ratio
+
+
 def bench_serving_spec():
     """Speculative-decoding serving leg (ISSUE 5): engine decode
     tokens/sec with speculation off vs on. Calibrated — the draft is a
@@ -612,15 +636,22 @@ def bench_serving_spec():
     run(make(False), prompts[:2])          # warmup / compile both paths
     run(make(True), prompts[:2])
 
-    results = {}
+    from paddle_tpu.observability import GOODPUT, REQUESTS
+    results, traced = {}, {}
     for label, spec in (("off", False), ("on", True)):
+        REQUESTS.clear()
+        REQUESTS.enable()
+        g0, w0 = GOODPUT.good_total(), GOODPUT.waste_total()
         eng = make(spec)
         t0 = time.perf_counter()
         out = run(eng, prompts)
         dt = time.perf_counter() - t0
+        traced[label] = _traced_leg_stats(g0, w0)
+        REQUESTS.disable()
         ntok = sum(len(t) for t in out.values())
         results[label] = (ntok / dt, {r: list(map(int, t))
                                       for r, t in out.items()}, eng)
+    REQUESTS.clear()
     off_tps, off_out, _ = results["off"]
     on_tps, on_out, eng_on = results["on"]
     from paddle_tpu.observability import METRICS
@@ -635,6 +666,12 @@ def bench_serving_spec():
         "spec_proposed": eng_on.stats["spec_proposed"],
         "spec_accepted": eng_on.stats["spec_accepted"],
         "spec_k": 4,
+        # goodput ledger (ISSUE 9): rejected drafts + verify pad rows
+        # land in the spec-on ratio (1.0 here — the calibrated draft is
+        # exact, so nothing is rejected; a real draft pays this)
+        "goodput_ratio_off": traced["off"][1],
+        "goodput_ratio_on": traced["on"][1],
+        "ttft_breakdown_on": traced["on"][0],
     }
 
 
@@ -788,6 +825,10 @@ def bench_serving_router():
 
     def ttft_run(roles, ps):
         ttft = {}
+        from paddle_tpu.observability import GOODPUT, REQUESTS
+        REQUESTS.clear()
+        REQUESTS.enable()
+        g0, w0 = GOODPUT.good_total(), GOODPUT.waste_total()
         router = Router([mk(roles[0]), mk(roles[1])])
         t0 = time.perf_counter()
 
@@ -798,13 +839,18 @@ def bench_serving_router():
             router.add_request(Request(p, max_new_tokens=48,
                                        stream=first_tok))
         router.run()
-        return float(np.percentile(list(ttft.values()), 50))
+        stats = _traced_leg_stats(g0, w0)
+        REQUESTS.disable()
+        REQUESTS.clear()
+        return float(np.percentile(list(ttft.values()), 50)), stats
 
     # warmup: the handoff gather/scatter jits only trace on the disagg
     # path — keep that compile out of the timed runs
     ttft_run(["prefill", "decode"], long_prompts[:2])
-    ttft_colocated = ttft_run(["both", "both"], long_prompts)
-    ttft_disagg = ttft_run(["prefill", "decode"], long_prompts)
+    ttft_colocated, (bd_col, ratio_col) = ttft_run(["both", "both"],
+                                                   long_prompts)
+    ttft_disagg, (bd_dis, ratio_dis) = ttft_run(["prefill", "decode"],
+                                                long_prompts)
 
     norm = lambda o: {r: list(map(int, t)) for r, t in o.items()}  # noqa: E731
     return {
@@ -819,6 +865,13 @@ def bench_serving_router():
         "ttft_p50_disagg_s": round(ttft_disagg, 4),
         "ttft_disagg_speedup": round(ttft_colocated / max(ttft_disagg, 1e-9),
                                      3),
+        # request-tracker TTFT breakdown (ISSUE 9): where the first
+        # token's latency went — colocated has zero handoff legs, disagg
+        # trades a handoff for a much shorter queue leg
+        "ttft_breakdown_colocated": bd_col,
+        "ttft_breakdown_disagg": bd_dis,
+        "goodput_ratio_colocated": ratio_col,
+        "goodput_ratio_disagg": ratio_dis,
     }
 
 

@@ -31,6 +31,19 @@ telemetry pipeline:
   * :mod:`paddle_tpu.observability.health` — :data:`HEALTH`, declarative
     OK/WARN/CRIT rules served at ``/healthz`` (with ``/flight``) by the
     metrics HTTP server.
+
+The request layer (ISSUE 9) adds per-request views on top of the
+aggregates:
+
+  * :mod:`paddle_tpu.observability.requests` — :data:`REQUESTS`, a
+    bounded ring of per-request lifecycle timelines, stitched across
+    serving replicas via TRACER flow events and served at ``/requests``.
+  * :mod:`paddle_tpu.observability.goodput` — :data:`GOODPUT`, the
+    useful-vs-wasted device-token ledger behind
+    ``serving_goodput_tokens_total`` / ``serving_waste_total{why}``.
+
+``python -m paddle_tpu.observability`` prints a generated reference of
+every registered metric instrument.
 """
 from __future__ import annotations
 
@@ -52,6 +65,8 @@ from paddle_tpu.observability.shipper import (MetricsShipper,
 from paddle_tpu.observability.health import (HEALTH, HealthEvaluator,
                                              HealthRule,
                                              install_default_rules)
+from paddle_tpu.observability.requests import REQUESTS, RequestTracker
+from paddle_tpu.observability.goodput import GOODPUT, GoodputLedger
 
 __all__ = [
     "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -63,6 +78,7 @@ __all__ = [
     "InstrumentedJit", "instrumented_jit",
     "MetricsShipper", "start_metrics_shipper", "stop_metrics_shipper",
     "HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
+    "REQUESTS", "RequestTracker", "GOODPUT", "GoodputLedger",
     "enable", "disable", "metrics_snapshot", "dump",
 ]
 

@@ -132,6 +132,14 @@ class FlightRecorder:
             "dropped": max(0, total - len(events)),
             "events": events,
         }
+        # slowest/failed request timelines (ISSUE 9) — lazy import keeps
+        # this module stdlib-only for everyone who never enables tracking
+        try:
+            from paddle_tpu.observability.requests import REQUESTS
+            if len(REQUESTS):
+                doc["requests"] = REQUESTS.flight_excerpt()
+        except Exception:
+            pass                        # dump paths must never raise
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, separators=(",", ":"))

@@ -1,0 +1,99 @@
+"""Goodput ledger (ISSUE 9): attribute every device token to useful vs
+wasted work, MegaScale-style.
+
+``serving_tokens_total`` counts what came out; it says nothing about
+what the device burned to get there. The ledger splits device token
+work into
+
+  * **goodput** — sampled/committed tokens the caller keeps; the
+    ``serving_goodput_tokens_total`` counter increments at exactly the
+    same sites as ``serving_tokens_total``, so the two reconcile
+    tick-for-tick by construction, and
+  * **waste** — ``serving_waste_total{why}`` token-positions computed
+    and thrown away:
+
+      ``spec_rejected``     draft tokens the target model refused
+      ``replay_prefill``    re-prefilled positions after a preemption
+                            replay (minus prefix-cache hits)
+      ``pad_rows``          whole padding rows in chunked-prefill and
+                            spec-verify batches (row slots launched
+                            with no live sequence)
+      ``moe_capacity_drop`` MoE routing assignments dropped at expert
+                            capacity
+      ``chaos_abort``       drafted-but-never-verified tokens when a
+                            fault aborts a spec tick
+
+The lifetime ratio good/(good+waste) is exported as the
+``serving_goodput_ratio`` gauge (refreshed by the engine's gauge sweep
+and on demand via :meth:`GoodputLedger.refresh_gauge`), and a stock
+low-goodput health rule in :mod:`paddle_tpu.observability.health`
+flags a fleet whose waste fraction says the devices mostly heat air.
+
+All state lives in the metrics registry — the ledger owns no counters
+of its own, so the conftest registry reset is the only hygiene needed.
+"""
+from __future__ import annotations
+
+from paddle_tpu.observability.metrics import METRICS
+
+__all__ = ["GOODPUT", "GoodputLedger", "WASTE_WHYS"]
+
+WASTE_WHYS = ("spec_rejected", "replay_prefill", "pad_rows",
+              "moe_capacity_drop", "chaos_abort")
+
+_GOOD = METRICS.counter(
+    "serving_goodput_tokens_total",
+    "device tokens that produced output the caller keeps (same increment "
+    "sites as serving_tokens_total, so the two reconcile)")
+_WASTE = METRICS.counter(
+    "serving_waste_total",
+    "device token-positions computed then thrown away, by cause "
+    "(spec_rejected, replay_prefill, pad_rows, moe_capacity_drop, "
+    "chaos_abort)",
+    labelnames=("why",))
+_RATIO = METRICS.gauge(
+    "serving_goodput_ratio",
+    "lifetime goodput/(goodput+waste) token ratio")
+
+
+def _series_total(inst) -> float:
+    return float(sum(cell[0] for cell in inst._series.values()))
+
+
+class GoodputLedger:
+    """Thin façade over the three instruments. Methods never allocate
+    beyond the counter increment; ``waste(n<=0)`` is a no-op so call
+    sites can pass raw deltas without guarding."""
+
+    def good(self, n: int = 1):
+        _GOOD.inc(n)
+
+    def waste(self, why: str, n: int):
+        if n > 0:
+            _WASTE.inc(n, why=why)
+
+    def good_total(self) -> float:
+        return _series_total(_GOOD)
+
+    def waste_total(self) -> float:
+        return _series_total(_WASTE)
+
+    def waste_by_why(self) -> dict:
+        return {key[0] if key else "": float(cell[0])
+                for key, cell in _WASTE._series.items()}
+
+    def ratio(self) -> float:
+        """good/(good+waste); NaN while no tokens have been accounted
+        (no traffic is not 0% goodput)."""
+        g, w = self.good_total(), self.waste_total()
+        return g / (g + w) if (g + w) else float("nan")
+
+    def refresh_gauge(self):
+        """Push the current ratio into ``serving_goodput_ratio`` (skipped
+        while there is no data, so the gauge stays absent not zero)."""
+        g, w = self.good_total(), self.waste_total()
+        if g + w:
+            _RATIO.set(g / (g + w))
+
+
+GOODPUT = GoodputLedger()

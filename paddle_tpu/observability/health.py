@@ -16,18 +16,19 @@ CRIT with the error attached (a broken health probe IS unhealthy).
 
 The module-global :data:`HEALTH` ships with the default rule set
 (:func:`install_default_rules`): NaN-skip rate, serving queue-wait p95,
-prefetch stall ratio, checkpoint CRC failures, elastic restart count.
+prefetch stall ratio, checkpoint CRC failures, elastic restart count,
+and the goodput waste ratio (ISSUE 9).
 """
 from __future__ import annotations
 
 import math
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Sequence
 
 from paddle_tpu.observability.metrics import METRICS, Histogram
 
 __all__ = ["HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
-           "counter_value", "gauge_value", "counter_ratio", "gauge_imbalance",
-           "histogram_quantile", "histogram_sum_ratio"]
+           "counter_value", "gauge_value", "counter_ratio", "counter_share",
+           "gauge_imbalance", "histogram_quantile", "histogram_sum_ratio"]
 
 _ORDER = {"OK": 0, "WARN": 1, "CRIT": 2}
 
@@ -57,6 +58,18 @@ def counter_ratio(num: str, den: str, registry=None) -> Callable[[], float]:
         reg = registry if registry is not None else METRICS
         d = _series_total(reg.get(den))
         return _series_total(reg.get(num)) / d if d else 0.0
+    return get
+
+
+def counter_share(part: str, whole: Sequence[str],
+                  registry=None) -> Callable[[], float]:
+    """part / sum(whole counters) — e.g. wasted device tokens over all
+    accounted device tokens. NaN while the denominator is zero: no
+    traffic is not an incident."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        d = sum(_series_total(reg.get(n)) for n in whole)
+        return _series_total(reg.get(part)) / d if d else float("nan")
     return get
 
 
@@ -199,6 +212,14 @@ def install_default_rules(ev: HealthEvaluator,
             counter_value("elastic_restarts_total", registry),
             warn=1, crit=3,
             description="elastic restarts taken after failures")
+    ev.rule("serving_waste_ratio",
+            counter_share("serving_waste_total",
+                          ("serving_goodput_tokens_total",
+                           "serving_waste_total"), registry),
+            warn=0.6, crit=0.95,
+            description="wasted device tokens / all accounted device "
+                        "tokens (goodput ledger): spec rejects, replay "
+                        "re-prefill, padding rows, capacity drops")
     return ev
 
 

@@ -8,6 +8,8 @@ process-global registry the way a Prometheus scraper expects:
   * ``GET /healthz``       → HEALTH.evaluate() JSON; HTTP 503 on CRIT so
     a TCP/status-code health checker needs zero JSON parsing
   * ``GET /flight``        → the flight recorder's current ring as JSON
+  * ``GET /requests``      → the request tracker's recent per-request
+    timelines + summaries (ISSUE 9); empty lists while tracking is off
   * anything else          → 404
 
 Usage::
@@ -61,9 +63,15 @@ class _Handler(BaseHTTPRequestHandler):
                    "events": FLIGHT.events()}
             body = (json.dumps(doc, sort_keys=True) + "\n").encode()
             ctype = "application/json"
+        elif path == "/requests":
+            from paddle_tpu.observability.requests import REQUESTS
+            body = (json.dumps(REQUESTS.to_doc(), sort_keys=True)
+                    + "\n").encode()
+            ctype = "application/json"
         else:
             self.send_error(
-                404, "try /metrics, /metrics.json, /healthz or /flight")
+                404, "try /metrics, /metrics.json, /healthz, /flight "
+                     "or /requests")
             return
         self.send_response(status)
         self.send_header("Content-Type", ctype)

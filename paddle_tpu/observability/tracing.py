@@ -22,6 +22,16 @@ on; a span entered while tracing is off is one bool read and no buffer
 write. :func:`instant` emits zero-duration "i" events — fault
 injections use it so a chaos run's timeline shows exactly where each
 fault landed.
+
+Cross-thread/cross-replica stitching (ISSUE 9): :meth:`Tracer.flow`
+emits Chrome-trace flow events — ``ph`` "s" (start) / "t" (step) /
+"f" (end) sharing an ``id`` draw as one connected arrow across
+threads, which is how one request's hops over prefill and decode
+replicas become a single timeline in Perfetto. :meth:`Tracer.track_tid`
+assigns a stable synthetic tid to a named logical track (e.g. a
+replica name) and labels it with a "M" ``thread_name`` metadata event
+prepended at export, so events can be pinned to a lane that is not a
+real OS thread.
 """
 from __future__ import annotations
 
@@ -78,12 +88,15 @@ class Tracer:
     drops NEW events past the cap (and counts the drops) instead of
     growing without bound during a long traced run."""
 
+    _TRACK_TID_BASE = 1 << 22       # clear of real OS thread ids' low range
+
     def __init__(self, max_events: int = 200_000):
         self.max_events = max_events
         self._events: list[dict] = []
         self._lock = threading.Lock()
         self._enabled = False
         self._pid = os.getpid()
+        self._tracks: dict = {}      # label -> synthetic tid (survives clear)
         self.dropped = 0
 
     # ------------------------------------------------------------ admin
@@ -125,6 +138,38 @@ class Tracer:
             **({"args": args} if args else {}),
         })
 
+    def track_tid(self, label: str) -> int:
+        """Stable synthetic tid for a named logical track. Registration
+        survives :meth:`clear` — the label registry is metadata, not
+        events — and export prepends a ``thread_name`` "M" event per
+        track so Perfetto shows the label instead of a bare number."""
+        with self._lock:
+            tid = self._tracks.get(label)
+            if tid is None:
+                tid = self._TRACK_TID_BASE + len(self._tracks)
+                self._tracks[label] = tid
+            return tid
+
+    def flow(self, name: str, flow_id: int, phase: str,
+             track: str = None, **args):
+        """One flow event. ``phase`` is "s" (start), "t" (step) or "f"
+        (end); events sharing ``flow_id`` stitch into one arrow across
+        threads. ``track`` pins the event onto a named synthetic track
+        (see :meth:`track_tid`) instead of the calling thread's lane."""
+        if not self._enabled:
+            return
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        tid = self.track_tid(track) if track else threading.get_ident()
+        ev = {
+            "name": name, "ph": phase, "cat": "flow", "id": int(flow_id),
+            "ts": time.monotonic_ns() / 1e3, "pid": self._pid, "tid": tid,
+            **({"args": args} if args else {}),
+        }
+        if phase == "f":
+            ev["bp"] = "e"           # bind to enclosing slice
+        self._emit(ev)
+
     def _emit(self, ev: dict):
         with self._lock:
             if len(self._events) >= self.max_events:
@@ -137,7 +182,10 @@ class Tracer:
         """Chrome-trace JSON object (load at chrome://tracing or
         ui.perfetto.dev)."""
         with self._lock:
-            events = list(self._events)
+            meta = [{"name": "thread_name", "ph": "M", "pid": self._pid,
+                     "tid": tid, "args": {"name": label}}
+                    for label, tid in self._tracks.items()]
+            events = meta + list(self._events)
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"producer": "paddle_tpu.observability",
                               "dropped_events": self.dropped}}

@@ -48,6 +48,8 @@ from paddle_tpu.models.paged import (_beam_finalize, _BEAM_SELECT_JIT,
                                      stochastic_accept_row)
 from paddle_tpu.observability import span as _span
 from paddle_tpu.observability.flight import FLIGHT
+from paddle_tpu.observability.goodput import GOODPUT
+from paddle_tpu.observability.requests import REQUESTS
 from paddle_tpu.serving.executor import ModelExecutor, _SAMPLE_ROWS_JIT  # noqa: F401  (re-exported)
 from paddle_tpu.serving.kv import KVManager
 from paddle_tpu.serving.scheduler import Scheduler
@@ -121,6 +123,9 @@ class LLMEngine:
         # after chunked prefill — slots activate with their first token
         # but NEVER decode here; the router extracts and ships them
         self.prefill_only = bool(prefill_only)
+        # replica name for request-tracker events; the Router stamps the
+        # replica name here so cross-replica timelines stitch (ISSUE 9)
+        self.trace_name = None
 
         # ---- speculative decoding (ISSUE 5): draft-and-verify tick ----
         # ``draft_model`` enables it; each eligible slot drafts up to
@@ -333,6 +338,9 @@ class LLMEngine:
                 "request worst case exceeds the WHOLE block pool — it "
                 "could never be admitted (raise num_blocks)")
         rid = self.sched.enqueue(req)
+        REQUESTS.submit(req, source="engine")        # idempotent re-submit
+        REQUESTS.event(req, "queued", replica=self.trace_name,
+                       depth=len(self.queue))
         _QUEUE_DEPTH.set(len(self.queue))
         return rid
 
@@ -379,6 +387,7 @@ class LLMEngine:
         _FINISHED.inc(reason=reason)
         FLIGHT.record("serving.timeout" if reason == "timeout"
                       else "serving.cancel", rid=req_id)
+        REQUESTS.finish(req, reason, replica=self.trace_name)
         return True
 
     def _detach(self, req_id: int) -> bool:
@@ -438,8 +447,7 @@ class LLMEngine:
         {req_id: tokens} like ``run``. ``cancel_queued=True`` also
         cancels requests still waiting for admission instead of running
         them to completion."""
-        from time import monotonic
-        t0 = monotonic()
+        t0 = time.monotonic()
         with _span("serving.drain", cancel_queued=cancel_queued):
             self._draining = True
             if cancel_queued:
@@ -447,7 +455,7 @@ class LLMEngine:
                     self.cancel(r.req_id)
             while self.has_work():
                 self.step()
-        _DRAIN.observe(monotonic() - t0)
+        _DRAIN.observe(time.monotonic() - t0)
         return {rid: r.tokens for rid, r in self.requests.items()}
 
     def assert_quiescent(self):
@@ -553,6 +561,8 @@ class LLMEngine:
             self.draft_cur[slot] = 0
             self.slot_k[slot] = self.spec_k
             self._acc_ema[slot] = 1.0
+            REQUESTS.event(req, "prefill", replica=self.trace_name,
+                           slot=slot, tokens=int(lens[i]))
         n = len(admits)
         beams = []
         self._staged_admits = frozenset(r.req_id for _, r in admits)
@@ -716,6 +726,9 @@ class LLMEngine:
         req.finish_reason = "beam"
         _FINISHED.inc(reason="beam")
         _TOKENS.inc(len(req.tokens))
+        GOODPUT.good(len(req.tokens))
+        REQUESTS.tokens(req, len(req.tokens))
+        REQUESTS.finish(req, "beam", replica=self.trace_name)
         for sid in g.sid.values():
             self.mgr.free(sid)
         for slot in g.slots:
@@ -756,6 +769,8 @@ class LLMEngine:
             progressed = True
             staged.add(rid)
             self._update_resv(rid)
+            REQUESTS.event(req, "prefill_chunk", replica=self.trace_name,
+                           slot=slot, offset=consumed, tokens=len(chunk))
             ids[i, :len(chunk)] = chunk
             lens[i] = len(chunk)
             offs[i] = consumed
@@ -776,6 +791,8 @@ class LLMEngine:
             # padded chunk forward would scatter nothing — skip it
             return []
         logits = self.exe.prefill_chunk(ids, lens, offs, slots, rows)
+        # padded sentinel rows burned device FLOPs on no request's behalf
+        GOODPUT.waste("pad_rows", (a_cap - len(staged)) * cap)
         emitted = []
         done_rows = []
         for i, (rid, (slot, consumed)) in enumerate(batch):
@@ -1085,6 +1102,9 @@ class LLMEngine:
             _SPEC_FALLBACKS.inc()
             FLIGHT.record("serving.spec_fallback",
                           error=f"{type(e).__name__}: {e}")
+            # every drafted token of this round was burned unverified
+            GOODPUT.waste("chaos_abort",
+                          sum(k_eff for _, _, k_eff in staged))
             # draft frontiers ran ahead of the commit that never came;
             # roll them back so the next round re-feeds from the frontier
             for slot, _, _ in staged:
@@ -1103,6 +1123,8 @@ class LLMEngine:
             logits = np.asarray(self.exe.verify_chunk(
                 ids, clens, offs, slot_ids, rows).astype(jnp.float32))
         self.stats["device_s"] += time.perf_counter() - t_dev
+        # whole sentinel rows of the fixed-shape verify batch are waste
+        GOODPUT.waste("pad_rows", (ns - len(staged)) * C)
 
         # ---- accept/commit per slot; ONE batched length rewind after ----
         rw_slots = np.full(ns, ns, np.int32)
@@ -1141,6 +1163,8 @@ class LLMEngine:
             _SPEC_PROPOSED.inc(k_eff)
             _SPEC_ACCEPTED.inc(n_acc)
             _SPEC_TOKENS.observe(len(new))
+            GOODPUT.waste("spec_rejected", k_eff - n_acc)
+            REQUESTS.spec(self.requests.get(rid), k_eff, n_acc)
             handled[slot] = True
             for tok in new:
                 emitted += self._emit(slot, int(tok))
@@ -1206,11 +1230,14 @@ class LLMEngine:
         req = self.requests[rid]
         req.tokens.append(token)
         _TOKENS.inc()
+        GOODPUT.good(1)
         now = self._clock()
         if req._first_tok_t is None:
             req._first_tok_t = now
             if req._submit_t is not None:
                 _TTFT.observe(max(0.0, now - req._submit_t))
+            REQUESTS.event(req, "first_token", replica=self.trace_name,
+                           slot=slot)
         elif req._last_tok_t is not None:
             _TOK_LAT.observe(max(0.0, now - req._last_tok_t))
         req._last_tok_t = now
@@ -1218,6 +1245,7 @@ class LLMEngine:
             req.stream(req, token)
         self.last_tok[slot] = token
         self.gen[slot] += 1
+        REQUESTS.tokens(req)
         eos = self.eos_token_id is not None and token == self.eos_token_id
         if eos or self.gen[slot] >= self.max_gen[slot]:
             req.done = True
@@ -1227,6 +1255,8 @@ class LLMEngine:
             self.kv.release(rid)
             self.active[slot] = False
             self.slot_req[slot] = -1
+            REQUESTS.finish(req, req.finish_reason,
+                            replica=self.trace_name)
         return [(rid, token)]
 
     # -------------------------------------------- KV handoff (ISSUE 7)
@@ -1258,6 +1288,8 @@ class LLMEngine:
             gen=int(self.gen[slot]), last_tok=int(self.last_tok[slot]),
             n_blocks=len(t), block_size=self.block_size, k=k, v=v)
         # gather landed — now release host state (same order as cancel)
+        REQUESTS.event(payload.req, "kv_extract", replica=self.trace_name,
+                       blocks=len(t), cur=int(self.cur[slot]))
         self.mgr.free(rid)
         self.kv.release(rid)
         self.active[slot] = False
@@ -1338,6 +1370,8 @@ class LLMEngine:
         self.draft_cur[slot] = 0
         self.slot_k[slot] = self.spec_k
         self._acc_ema[slot] = 1.0
+        REQUESTS.event(req, "kv_install", replica=self.trace_name,
+                       blocks=payload.n_blocks, cur=payload.cur)
         return True
 
     def _refresh_gauges(self):
@@ -1351,18 +1385,18 @@ class LLMEngine:
         _KV_UTIL.set(used / self.mgr.num_blocks if self.mgr.num_blocks
                      else 0.0)
         self.kv.push_prefix_metrics()
+        GOODPUT.refresh_gauge()
 
     def step(self):
         """One engine tick — see :meth:`_step_impl`. Wrapped here so the
         tick lands in the trace timeline and the tick-duration histogram
         even when a chaos rule or a dry pool raises out of the middle."""
-        from time import monotonic
-        t0 = monotonic()
+        t0 = time.monotonic()
         try:
             with _span("serving.step"):
                 return self._step_impl()
         finally:
-            _TICK.observe(monotonic() - t0)
+            _TICK.observe(time.monotonic() - t0)
             self._refresh_gauges()
 
     def _step_impl(self):
@@ -1371,7 +1405,6 @@ class LLMEngine:
         (their prefill runs now, interleaved with decode), then one decode
         tick for every active slot. Returns [(req_id, new_token), ...]
         (a finishing beam request emits its whole best hypothesis)."""
-        from time import perf_counter
         # chaos hooks: serving.tick may raise/stall; serving.preempt rules
         # receive the engine and typically call engine._preempt() to
         # induce a preemption the pool never asked for
@@ -1408,7 +1441,7 @@ class LLMEngine:
             # every active slot advanced speculatively: the whole point —
             # this tick paid ONE target forward for k+1 positions per slot
             return emitted
-        t0 = perf_counter()
+        t0 = time.perf_counter()
         if self._is_moe:
             # chaos: a dead expert shard fails the token all_to_all. Fires
             # BEFORE table growth and the donating tick jit, so an injected
@@ -1420,19 +1453,19 @@ class LLMEngine:
         rows, cols, vals = self._grow_tables(run_mask & ~self.is_beam)
         # growth may have preempted slots — recompute the mask after it
         run_mask = self.active & ~spec_handled
-        t1 = perf_counter()
+        t1 = time.perf_counter()
         nxt, logp = self.exe.decode_tick(
             self.last_tok, run_mask, rows, cols, vals, self.temps,
             self.top_ps, bool(self.groups))
         was_active = run_mask.copy()
         nxt = np.asarray(nxt)                 # the one per-tick host fetch
-        t2 = perf_counter()
+        t2 = time.perf_counter()
         for g in self.groups.values():        # device-resident, lazy gather
             g.logp = logp[np.asarray(g.slots)]
         self.cur += was_active                # vectorised mirrors
         for slot in np.nonzero(was_active & ~self.is_beam)[0]:
             emitted += self._emit(slot, int(nxt[slot]))
-        t3 = perf_counter()
+        t3 = time.perf_counter()
         self.stats["host_s"] += (t1 - t0) + (t3 - t2)
         self.stats["device_s"] += t2 - t1
         self.stats["ticks"] += 1

@@ -44,6 +44,7 @@ from paddle_tpu.observability import span as _span
 from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.observability.health import (HEALTH, HealthEvaluator,
                                              gauge_imbalance)
+from paddle_tpu.observability.requests import REQUESTS
 from paddle_tpu.serving.engine import LLMEngine
 from paddle_tpu.serving.telemetry import (_R_DEATHS, _R_DISPATCH,
                                           _R_HEALTH, _R_OUTSTANDING,
@@ -101,6 +102,10 @@ class Router:
         names = [r.name for r in self.replicas]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate replica names: {names}")
+        for r in self.replicas:
+            # request-tracker events carry the replica name; the tracker
+            # stitches cross-replica timelines on it (ISSUE 9)
+            r.engine.trace_name = r.name
         # kill switch: PT_ROUTER_DISAGG=0 collapses roles to "both" — one
         # env flip turns a misbehaving disaggregated deployment into
         # plain replicated serving without touching the topology
@@ -167,6 +172,7 @@ class Router:
             self._ids = itertools.count(
                 max(req.req_id + 1, next(self._ids)))
         self.requests[req.req_id] = req
+        REQUESTS.submit(req, source="router")
         self._queue.append(req)
         self._flush_queue()
         return req.req_id
@@ -197,12 +203,14 @@ class Router:
                 del self._queue[i]
                 req.done = True
                 req.finish_reason = reason
+                REQUESTS.finish(req, reason)
                 return True
         for j, p in enumerate(self._pending):
             if p.req.req_id == rid:
                 del self._pending[j]
                 req.done = True
                 req.finish_reason = reason
+                REQUESTS.finish(req, reason)
                 return True
         i = self._where.get(rid)
         if i is not None:
@@ -256,16 +264,19 @@ class Router:
                 continue                 # try the next-least-loaded one
             except Exception as e:
                 self.stats["requeues"] += 1
-                _R_REQUEUES.inc()
+                _R_REQUEUES.inc(replica=rep.name, why="dispatch_fault")
                 FLIGHT.record("router.requeue", rid=req.req_id,
                               replica=rep.name, why="dispatch_fault",
                               error=f"{type(e).__name__}: {e}")
+                REQUESTS.event(req, "requeued", replica=rep.name,
+                               why="dispatch_fault")
                 return False
             self._where[req.req_id] = i
             if self.affinity and req.session_id is not None:
                 self._sessions[("admit", req.session_id)] = i
             self.stats["dispatched"] += 1
             _R_DISPATCH.inc(replica=rep.name)
+            REQUESTS.event(req, "dispatched", replica=rep.name)
             return True
         return False
 
@@ -319,10 +330,12 @@ class Router:
                         self._queue.appendleft(pulled)
                         self._where.pop(rid, None)
                         self.stats["requeues"] += 1
-                        _R_REQUEUES.inc()
+                        _R_REQUEUES.inc(replica=rep.name, why="kv_transfer")
                         FLIGHT.record("router.requeue", rid=rid,
                                       replica=rep.name, why="kv_transfer",
                                       error=f"{type(e).__name__}: {e}")
+                        REQUESTS.event(pulled, "requeued", replica=rep.name,
+                                       why="kv_transfer")
                     continue
                 self._pending.append(payload)
                 self._where.pop(rid, None)
@@ -364,6 +377,8 @@ class Router:
             self.stats["transfers"] += 1
             _R_TRANSFERS.inc()
             _R_TRANSFER_BLOCKS.inc(payload.n_blocks)
+            REQUESTS.event(req, "kv_ship", replica=rep.name,
+                           blocks=payload.n_blocks)
         self._pending = still
 
     # ------------------------------------------------------ death/drain
@@ -391,6 +406,7 @@ class Router:
                 req.done = True
                 req.finish_reason = "replica_death"
                 FLIGHT.record("router.requeue_exhausted", rid=rid)
+                REQUESTS.finish(req, "replica_death", replica=rep.name)
                 continue
             self._requeued.add(rid)
             if req.tokens:
@@ -400,9 +416,11 @@ class Router:
                     [req.prompt, np.asarray(req.tokens, np.int32)])
             self._queue.appendleft(req)
             self.stats["requeues"] += 1
-            _R_REQUEUES.inc()
+            _R_REQUEUES.inc(replica=rep.name, why="replica_death")
             FLIGHT.record("router.requeue", rid=rid, replica=rep.name,
                           why="replica_death")
+            REQUESTS.event(req, "requeued", replica=rep.name,
+                           why="replica_death")
         # affinity pins to a dead replica are meaningless — unpin so the
         # session's future requests pick a live one
         self._sessions = {k: v for k, v in self._sessions.items()
@@ -432,9 +450,11 @@ class Router:
                 self._where.pop(req.req_id, None)
                 self._queue.append(req)
                 self.stats["requeues"] += 1
-                _R_REQUEUES.inc()
+                _R_REQUEUES.inc(replica=rep.name, why="drain")
                 FLIGHT.record("router.requeue", rid=req.req_id,
                               replica=rep.name, why="drain")
+                REQUESTS.event(req, "requeued", replica=rep.name,
+                               why="drain")
         if rep.role == "prefill":
             # a prefill-only engine never finishes active slots by
             # itself — drive the extract/install loop until it empties

@@ -16,6 +16,8 @@ from collections import deque
 import numpy as np
 
 from paddle_tpu.observability.flight import FLIGHT
+from paddle_tpu.observability.goodput import GOODPUT
+from paddle_tpu.observability.requests import REQUESTS
 from paddle_tpu.serving.telemetry import (_ADMITTED, _PREEMPTED,
                                           _QUEUE_WAIT, _REJECTED)
 from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
@@ -149,6 +151,16 @@ class Scheduler:
             _ADMITTED.inc()
             if req._submit_t is not None:
                 _QUEUE_WAIT.observe(max(0.0, self.clock() - req._submit_t))
+            if req._resume is not None:
+                # replayed after preemption: every resume token past the
+                # prefix-cache hit is device work already paid for once
+                GOODPUT.waste("replay_prefill", max(0, len(p) - ct))
+                REQUESTS.event(req, "replayed",
+                               replica=getattr(eng, "trace_name", None),
+                               resume_tokens=len(p), cached_tokens=ct)
+            REQUESTS.event(req, "admitted",
+                           replica=getattr(eng, "trace_name", None),
+                           cached_tokens=ct)
             if eng.preemption and k == 1:
                 need = 0                   # no standing reservation
             kv.begin(req.req_id, need)
@@ -239,6 +251,9 @@ class Scheduler:
         _PREEMPTED.inc()
         FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
                       phase="prefill")
+        REQUESTS.event(req, "preempted",
+                       replica=getattr(eng, "trace_name", None),
+                       phase="prefill")
         return True
 
     def preempt_from(self, eng, cand) -> bool:
@@ -268,4 +283,7 @@ class Scheduler:
         _PREEMPTED.inc()
         FLIGHT.record("serving.preempt", rid=rid, slot=int(slot),
                       phase="decode")
+        REQUESTS.event(req, "preempted",
+                       replica=getattr(eng, "trace_name", None),
+                       phase="decode")
         return True

@@ -90,8 +90,9 @@ _R_DISPATCH = METRICS.counter(
     labelnames=("replica",))
 _R_REQUEUES = METRICS.counter(
     "router_requeues_total",
-    "requests pulled back from a replica and re-dispatched (replica "
-    "death, KV-transfer failure, dispatch fault, drain rebalancing)")
+    "requests pulled back from a replica and re-dispatched, by replica "
+    "and cause (replica_death, kv_transfer, dispatch_fault, drain)",
+    labelnames=("replica", "why"))
 _R_OUTSTANDING = METRICS.gauge(
     "router_replica_outstanding", "not-yet-finished requests per replica",
     labelnames=("replica",))

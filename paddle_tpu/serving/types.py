@@ -62,6 +62,12 @@ class Request:
     # prefill recomputes the whole sequence (prefix-cache hits make the
     # recompute cheap when its old blocks are still parked)
     _resume: object = None
+    # request tracker (ISSUE 9): trace_id is minted at first submit while
+    # tracking is enabled (None = untracked, every tracker call no-ops);
+    # trace_summary is the finished timeline summary, same dict /requests
+    # serves
+    trace_id: object = None
+    trace_summary: object = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

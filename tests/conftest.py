@@ -71,14 +71,19 @@ def _clear_observability():
     empty flight-recorder ring with NO dump directory — a chaos test
     that crashes a trainer must not scatter flight_*.json into the
     repo. Tests that want dumps set FLIGHT.dir (or pass directory=)
-    themselves; capacity/dir are restored afterwards either way."""
-    from paddle_tpu.observability import FLIGHT, METRICS, TRACER
+    themselves; capacity/dir are restored afterwards either way. The
+    request tracker (ISSUE 9) gets the same treatment: cleared and
+    disabled (its default) on both sides, capacity restored."""
+    from paddle_tpu.observability import FLIGHT, METRICS, REQUESTS, TRACER
     METRICS.reset()
     METRICS.enable()
     TRACER.disable()
     TRACER.clear()
     FLIGHT.clear()
+    REQUESTS.disable()
+    REQUESTS.clear()
     saved_dir, saved_cap = FLIGHT.dir, FLIGHT.capacity
+    saved_rcap = REQUESTS.capacity
     FLIGHT.dir = None
     yield
     METRICS.reset()
@@ -86,6 +91,10 @@ def _clear_observability():
     TRACER.disable()
     TRACER.clear()
     FLIGHT.clear()
+    REQUESTS.disable()
+    REQUESTS.clear()
     FLIGHT.dir = saved_dir
     if FLIGHT.capacity != saved_cap:
         FLIGHT.set_capacity(saved_cap)
+    if REQUESTS.capacity != saved_rcap:
+        REQUESTS.set_capacity(saved_rcap)

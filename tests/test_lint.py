@@ -173,6 +173,53 @@ def test_threads_carry_pt_name_prefix():
         "is set elsewhere):\n" + "\n".join(offenders))
 
 
+# ------------------------------------------------------ instrument hygiene
+# Every metric instrument registered under paddle_tpu/ must carry a
+# non-empty help string (the generated metrics reference renders it) and
+# a name under one of the approved subsystem prefixes, so the exported
+# namespace stays groupable in a Prometheus/Grafana deployment.
+_INSTRUMENT_PREFIXES = (
+    "serving_", "router_", "train_", "io_", "ckpt_", "moe_", "compile_",
+    "collective_", "elastic_", "faults_", "steptimer_", "device_",
+)
+_INSTRUMENT_ALLOWLIST = {
+    # e.g. "paddle_tpu/some/module.py": "registers dynamic names",
+}
+
+
+def test_metric_instruments_have_help_and_approved_prefix():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    pkg = root / "paddle_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        if rel in _INSTRUMENT_ALLOWLIST:
+            continue
+        text = path.read_text()
+        for m in re.finditer(r"\bMETRICS\.(counter|gauge|histogram)\s*\(",
+                             text):
+            # registrations span lines — scan a window past the open
+            # paren for the first two string literals (name, help)
+            window = text[m.end():m.end() + 500]
+            lits = re.findall(r'"((?:[^"\\]|\\.)*)"', window)
+            lineno = text.count("\n", 0, m.start()) + 1
+            if not lits:
+                offenders.append(f"{rel}:{lineno}: no literal name")
+                continue
+            name = lits[0]
+            if not name.startswith(_INSTRUMENT_PREFIXES):
+                offenders.append(
+                    f"{rel}:{lineno}: {name!r} lacks an approved prefix "
+                    f"{_INSTRUMENT_PREFIXES}")
+            if len(lits) < 2 or not lits[1].strip():
+                offenders.append(f"{rel}:{lineno}: {name!r} has no help "
+                                 "string")
+    assert not offenders, (
+        "metric instruments without help text or an approved name prefix "
+        "(fix the registration or allowlist the file with a reason):\n"
+        + "\n".join(offenders))
+
+
 def test_pipeline_divergent_handoff_flagged():
     """A stage that only hands off inside one cond branch deadlocks —
     the lint catches it before it reaches hardware."""

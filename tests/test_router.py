@@ -76,6 +76,15 @@ def _route(router, prompts, max_new=10, **rkw):
     return {rid: list(map(int, t)) for rid, t in out.items()}
 
 
+def _requeues_by_label():
+    """router_requeues_total broken down as {(replica, why): count}."""
+    from paddle_tpu.observability import METRICS
+    inst = METRICS.get("router_requeues_total")
+    if inst is None:
+        return {}
+    return {key: cell[0] for key, cell in inst._series.items()}
+
+
 # --------------------------------------------------- greedy identity
 
 def test_routed_two_replicas_matches_single_engine(model):
@@ -250,6 +259,10 @@ def test_drain_replica_rebalances_without_deadlock(model):
     # nothing new landed on r0 after the drain call finished it
     assert all(i != 0 for i in r._where.values())
     assert r.stats["requeues"] >= 1    # engine-queued work was rebalanced
+    # every requeue carries the drained replica + the drain cause
+    by = _requeues_by_label()
+    assert by and all(k == ("r0", "drain") for k in by)
+    assert sum(by.values()) == r.stats["requeues"]
 
 
 def test_drain_prefill_replica_flushes_handoffs(model):
@@ -287,6 +300,8 @@ def test_chaos_dispatch_requeues_and_recovers(model):
     r.assert_quiescent()
     assert r.stats["requeues"] == 2
     assert r.stats["dispatched"] == 6
+    assert sum(n for (rep, why), n in _requeues_by_label().items()
+               if why == "dispatch_fault") == 2
 
 
 def test_chaos_kv_transfer_requeues_no_leak(model):
@@ -304,6 +319,8 @@ def test_chaos_kv_transfer_requeues_no_leak(model):
     assert out == ref
     r.assert_quiescent()
     assert r.stats["requeues"] == 2
+    # the faults fired on the prefill replica's extraction path
+    assert _requeues_by_label() == {("r0", "kv_transfer"): 2}
 
 
 def test_chaos_replica_death_requeues_exactly_once(model):
@@ -329,6 +346,9 @@ def test_chaos_replica_death_requeues_exactly_once(model):
     assert r.stats["deaths"] == 1
     assert not r.replicas[0].alive
     assert r.stats["requeues"] == len(r._requeued) >= 1
+    by = _requeues_by_label()
+    assert by and all(k == ("r0", "replica_death") for k in by)
+    assert sum(by.values()) == r.stats["requeues"]
 
 
 def test_replica_death_twice_marks_request_failed(model):
