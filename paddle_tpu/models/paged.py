@@ -249,6 +249,11 @@ class PrefixCachingBlockManager(RefBlockManager):
         # denominator
         self.cache_stats = {"hit_blocks": 0, "evictions": 0,
                             "lookup_blocks": 0}
+        # bumped whenever the set of matchable blocks changes (eviction
+        # or a new commit) — the scheduler's per-request match memo keys
+        # on it, so a queued prompt is re-hashed only when a probe could
+        # actually return something different
+        self.cache_epoch = 0
 
     # ---- capacity: parked blocks are reclaimable, so they count as free
     @property
@@ -264,6 +269,7 @@ class PrefixCachingBlockManager(RefBlockManager):
             if h is not None and self._hash_to_block.get(h) == blk:
                 del self._hash_to_block[h]
             self.cache_stats["evictions"] += 1
+            self.cache_epoch += 1
             return blk
         raise MemoryError("paged cache out of blocks")
 
@@ -335,6 +341,405 @@ class PrefixCachingBlockManager(RefBlockManager):
             if d not in self._hash_to_block and blk not in self._block_hash:
                 self._hash_to_block[d] = blk
                 self._block_hash[blk] = d
+                self.cache_epoch += 1
+
+
+class PrefixMatch:
+    """Longest shared TOKEN span found by
+    :meth:`RadixPrefixBlockManager.match_prefix`.
+
+    ``blocks`` are fully-shared blocks (adopted rc+1, zero copies);
+    ``cow`` is the optional partial boundary share — ``(src_block,
+    hit_tokens)`` with ``0 < hit_tokens < block_size`` — the adopter gets
+    a private copy of ``src_block`` and prefills from token ``hit``
+    inside it. ``len()`` is the number of fully-shared blocks so the
+    scheduler's block-denominated reservation math stays
+    manager-agnostic; truthiness is any token hit at all."""
+
+    __slots__ = ("blocks", "token_count", "cow")
+
+    def __init__(self, blocks, token_count, cow=None):
+        self.blocks = blocks
+        self.token_count = token_count
+        self.cow = cow
+
+    def __len__(self):
+        return len(self.blocks)
+
+    def __bool__(self):
+        return self.token_count > 0
+
+    def __iter__(self):
+        return iter(self.blocks)
+
+    def __repr__(self):
+        return (f"PrefixMatch(blocks={self.blocks}, "
+                f"token_count={self.token_count}, cow={self.cow})")
+
+
+class _RadixNode:
+    """One radix-trie edge: a token span owning the physical blocks that
+    hold its KV. Spans start block-aligned; only a childless tail may be
+    partial (len(tokens) % block_size != 0)."""
+
+    __slots__ = ("tokens", "blocks", "children", "parent", "touch")
+
+    def __init__(self, tokens, blocks, parent):
+        self.tokens = tokens          # np.int32 span
+        self.blocks = blocks          # list[int], ceil(len(tokens)/bs)
+        self.children = []            # children start block-aligned
+        self.parent = parent
+        self.touch = 0
+
+
+class _PendingCopy:
+    """One host-side COW order: copy pool block ``src`` into ``dst``
+    before the adopter's prefill chunk. ``dead`` marks orders whose dst
+    was freed (adopter cancelled/preempted) before the engine drained
+    the plan — the copy must not run into a reallocated block."""
+
+    __slots__ = ("src", "dst", "dead")
+
+    def __init__(self, src, dst):
+        self.src = src
+        self.dst = dst
+        self.dead = False
+
+
+def _common_len(a, b):
+    """Length of the common prefix of two int32 token arrays."""
+    n = min(len(a), len(b))
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if len(neq) else n
+
+
+class RadixPrefixBlockManager(RefBlockManager):
+    """RefBlockManager + a token-level radix trie over the block pool
+    (SGLang RadixAttention on vLLM-style paging).
+
+    Where :class:`PrefixCachingBlockManager` matches whole aligned
+    blocks by chain hash, this trie matches the longest shared TOKEN
+    span: edges own ref-counted physical blocks, a partially-filled
+    boundary block is shared read-only and copied-on-write at first
+    divergence (one fresh block; the engine applies the device copy via
+    ``take_copy_plan`` before the adopter's prefill chunk), and
+    ``commit_prefix`` inserts partial tails too — so divergence inside a
+    block forfeits only the divergent suffix, not the whole tail.
+
+    Blocks whose refcount drops to zero but that live in the trie are
+    PARKED (still resident, counted as free); when the free list runs
+    dry, eviction walks unreferenced trie leaves LRU-by-touch, one tail
+    block at a time — so caching never reduces usable capacity.
+    ``cache_epoch`` bumps on every eviction and commit; the scheduler's
+    per-request match memo keys on it."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        super().__init__(num_blocks, block_size)
+        self._root = _RadixNode(np.empty(0, np.int32), [], None)
+        self._in_trie: dict[int, _RadixNode] = {}   # blk -> owning node
+        self._parked: set[int] = set()              # trie blocks, rc == 0
+        self._touch = 0
+        self.cache_epoch = 0
+        self._pending: list[_PendingCopy] = []
+        self._copy_dst: dict[int, _PendingCopy] = {}
+        self.cache_stats = {"hit_blocks": 0, "evictions": 0,
+                            "lookup_blocks": 0, "token_hits": 0,
+                            "partial_hits": 0, "lookup_tokens": 0}
+
+    # ---- capacity: parked trie blocks are reclaimable, so count as free
+    @property
+    def free_blocks(self):
+        return len(self._free) + len(self._parked)
+
+    def _pop_free(self):
+        if self._free:
+            return self._free.pop()
+        if self._parked:
+            return self._evict_one()
+        raise MemoryError("paged cache out of blocks")
+
+    def _evict_one(self) -> int:
+        """Reclaim ONE parked block: the tail block of the least-recently
+        touched childless leaf whose tail is unreferenced. Because
+        adoption always takes the full matched path and release frees a
+        table all at once, a parked block's whole suffix (deeper blocks
+        of its node + every descendant) is parked too — so such a leaf
+        always exists while ``_parked`` is non-empty."""
+        victim = None
+        stack = list(self._root.children)
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children)
+            elif node.blocks and node.blocks[-1] in self._parked:
+                if victim is None or node.touch < victim.touch:
+                    victim = node
+        if victim is None:       # unreachable by the suffix invariant
+            raise MemoryError("paged cache out of blocks")
+        from paddle_tpu.utils.faults import fault_point
+        # chaos site: fires BEFORE any mutation, so an injected exception
+        # leaves the trie, refcounts, and free list exactly as they were
+        fault_point("serving.prefix_evict", manager=self,
+                    blk=victim.blocks[-1], touch=victim.touch)
+        blk = victim.blocks.pop()
+        self._parked.discard(blk)
+        del self._in_trie[blk]
+        victim.tokens = victim.tokens[:len(victim.blocks)
+                                      * self.block_size]
+        if not victim.blocks and victim.parent is not None:
+            victim.parent.children.remove(victim)
+        self.cache_stats["evictions"] += 1
+        self.cache_epoch += 1
+        return blk
+
+    def _release(self, blk):
+        self._rc[blk] -= 1
+        if self._rc[blk] == 0:
+            del self._rc[blk]
+            pend = self._copy_dst.pop(blk, None)
+            if pend is not None:
+                # the adopter died before its COW executed: cancel the
+                # order and drop the pin on the source block
+                pend.dead = True
+                self._release(pend.src)
+            if blk in self._in_trie:
+                self._parked.add(blk)
+            else:
+                self._free.append(blk)
+
+    def _retain(self, blk):
+        self._parked.discard(blk)
+        super()._retain(blk)
+
+    # --------------------------------------------------------- matching
+    def _best_child(self, node, rem):
+        """Child with the longest common token prefix with ``rem``.
+        Siblings may overlap (first-writer-wins keeps physically distinct
+        blocks for the same tokens), so this is argmax, not a dict hop."""
+        best, bl = None, 0
+        for ch in node.children:
+            n = _common_len(ch.tokens, rem)
+            if n > bl:
+                best, bl = ch, n
+        return best, bl
+
+    def match_prefix(self, tokens) -> PrefixMatch:
+        """Longest shared token span for this prompt, capped at len-1 so
+        the last prompt token always prefills (its logits seed the first
+        sample). Fully-matched aligned blocks are shared outright; the
+        boundary block (divergence or span end mid-block) is offered as a
+        copy-on-write partial hit."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        cap = len(toks) - 1
+        bs = self.block_size
+        self.cache_stats["lookup_blocks"] += max(cap, 0) // bs
+        self.cache_stats["lookup_tokens"] += max(cap, 0)
+        self._touch += 1
+        node, depth = self._root, 0
+        blocks, cow = [], None
+        while depth < cap:
+            best, bl = self._best_child(node, toks[depth:cap])
+            if best is None or bl == 0:
+                break
+            best.touch = self._touch
+            if bl == len(best.tokens) and bl % bs == 0:
+                blocks.extend(best.blocks)
+                depth += bl
+                node = best
+                continue
+            # boundary inside ``best``: share its full sub-blocks, offer
+            # the partial one copy-on-write
+            n_full = bl // bs
+            blocks.extend(best.blocks[:n_full])
+            hit = bl % bs
+            if hit:
+                cow = (best.blocks[n_full], hit)
+            depth += bl
+            break
+        return PrefixMatch(blocks, depth, cow)
+
+    # --------------------------------------------------------- adoption
+    def adopt_prefix(self, seq_id, match) -> list:
+        """Install a match as seq_id's table prefix: retain the shared
+        blocks, and for a partial hit allocate one private block and
+        queue the (src, dst) device copy. Exception-atomic: a failed
+        allocation rolls every retain back."""
+        assert seq_id not in self.tables
+        blocks = list(match.blocks) if isinstance(match, PrefixMatch) \
+            else list(match)
+        cow = getattr(match, "cow", None)
+        retained = []
+        try:
+            for blk in blocks:
+                self._retain(blk)
+                retained.append(blk)
+            table = list(blocks)
+            if cow is not None:
+                src, hit = cow
+                # pin src until the plan drains: a parked source must not
+                # be evicted/reallocated before the copy program is issued
+                self._retain(src)
+                retained.append(src)
+                dst = self._pop_free()
+                self._rc[dst] = 1
+                entry = _PendingCopy(src, dst)
+                self._pending.append(entry)
+                self._copy_dst[dst] = entry
+                table.append(dst)
+        except BaseException:
+            for blk in reversed(retained):
+                self._release(blk)
+            raise
+        self.tables[seq_id] = table
+        self.cache_stats["hit_blocks"] += len(blocks)
+        self.cache_stats["token_hits"] += getattr(
+            match, "token_count", len(blocks) * self.block_size)
+        if cow is not None:
+            self.cache_stats["partial_hits"] += 1
+        return table
+
+    def take_copy_plan(self) -> list:
+        """Drain the pending COW orders as (src, dst) pairs and drop the
+        source pins. The engine applies them in ONE device copy before
+        any other program of the tick writes the pool — jax data
+        dependencies then order the copy before the adopters' prefill
+        chunks and before any reallocation of a source block."""
+        pairs = []
+        pending, self._pending = self._pending, []
+        for e in pending:
+            if e.dead:
+                continue
+            pairs.append((e.src, e.dst))
+            self._copy_dst.pop(e.dst, None)
+            self._release(e.src)
+        return pairs
+
+    # ------------------------------------------------------- insertion
+    def commit_prefix(self, seq_id, tokens):
+        """Insert seq_id's token span — INCLUDING the partial tail block
+        — so later requests can share it. Safe before the writes have
+        executed on device (data dependencies order consumers after).
+        Callers must pass only tokens whose KV is resident (the engine
+        passes the cache frontier, not the just-sampled token)."""
+        table = self.tables.get(seq_id, [])
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        n_tok = min(len(toks), len(table) * bs)
+        for i, b in enumerate(table):     # window-recycled holes: stop
+            if b is None:
+                n_tok = min(n_tok, i * bs)
+                break
+        if n_tok <= 0:
+            return
+        self._insert(toks[:n_tok], table)
+        self.cache_epoch += 1
+
+    def _insert(self, toks, table):
+        bs = self.block_size
+        node, depth = self._root, 0
+        while depth < len(toks):
+            rem = toks[depth:]
+            best, bl = self._best_child(node, rem)
+            if best is None or bl == 0:
+                self._attach(node, toks, depth, table)
+                return
+            if bl == len(best.tokens):
+                if bl % bs == 0:
+                    node = best
+                    depth += bl
+                    continue
+                # fully matched a partial-tail leaf
+                if len(rem) <= bl:
+                    return                     # nothing new to insert
+                own = table[(depth + bl) // bs]
+                if (best.blocks[-1] == own
+                        and best.blocks == table[depth // bs:
+                                                 depth // bs
+                                                 + len(best.blocks)]):
+                    # same physical tail block: the original writer
+                    # appended — extend the span in place
+                    self._extend(best, toks, depth, table)
+                else:
+                    # same tokens, different block (a COW fork that grew
+                    # past the shared span): overlapping sibling; match
+                    # picks whichever overlaps a query longest
+                    self._attach(node, toks, depth, table)
+                return
+            # divergence inside ``best``: split at the enclosing block
+            # boundary, then attach the new branch (a committer that is
+            # merely a PREFIX of ``best`` adds nothing — skip)
+            sp = (bl // bs) * bs
+            if 0 < sp < len(best.tokens):
+                node = self._split(best, sp)
+            if len(rem) > bl:
+                self._attach(node, toks, depth + sp, table)
+            return
+
+    def _attach(self, parent, toks, depth, table):
+        """New child of ``parent`` owning the committer's blocks from
+        token ``depth`` on (block-aligned by construction)."""
+        bs = self.block_size
+        span = toks[depth:]
+        start = depth // bs
+        blocks = []
+        for j in range(start, min(len(table),
+                                  start + -(-len(span) // bs))):
+            b = table[j]
+            if b is None or b in self._in_trie:
+                break                      # one trie home per block
+            blocks.append(b)
+        if not blocks:
+            return
+        span = span[:min(len(span), len(blocks) * bs)]
+        self._touch += 1
+        node = _RadixNode(span, blocks, parent)
+        node.touch = self._touch
+        parent.children.append(node)
+        for b in blocks:
+            self._in_trie[b] = node
+
+    def _extend(self, node, toks, depth, table):
+        """Grow a partial-tail node in place: same physical tail block,
+        the committer wrote more tokens into it (and possibly beyond)."""
+        bs = self.block_size
+        span = toks[depth:]
+        start = depth // bs
+        blocks = list(node.blocks)
+        for j in range(start + len(blocks),
+                       min(len(table), start + -(-len(span) // bs))):
+            b = table[j]
+            if b is None or b in self._in_trie:
+                break
+            blocks.append(b)
+        span = span[:min(len(span), len(blocks) * bs)]
+        if len(span) <= len(node.tokens):
+            return
+        for b in blocks[len(node.blocks):]:
+            self._in_trie[b] = node
+        node.tokens = span
+        node.blocks = blocks
+        self._touch += 1
+        node.touch = self._touch
+
+    def _split(self, node, sp):
+        """Split a node at block-aligned token offset ``sp``: the upper
+        half keeps the shared prefix, the original node becomes its child
+        with the remainder."""
+        bs = self.block_size
+        upper = _RadixNode(node.tokens[:sp], node.blocks[:sp // bs],
+                           node.parent)
+        upper.touch = node.touch
+        parent = node.parent
+        parent.children[parent.children.index(node)] = upper
+        node.tokens = node.tokens[sp:]
+        node.blocks = node.blocks[sp // bs:]
+        node.parent = upper
+        upper.children.append(node)
+        for b in upper.blocks:
+            self._in_trie[b] = upper
+        return upper
 
 
 def _rope_rows(positions, head_dim, base, scaling=None, max_pos=None):
@@ -588,7 +993,7 @@ def clear_jit_caches():
     ``PT_GROUPED_GEMM`` or entering/leaving a mesh re-routes MoE layers,
     but the jit caches key on shapes only."""
     for f in (_PREFILL_JIT, _DECODE_JIT, _TICK_JIT, _PREFILL_CHUNK_JIT,
-              _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT):
+              _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT, _PREFIX_COW_JIT):
         f.clear_cache()
 
 
@@ -607,6 +1012,21 @@ def _beam_cache_update(cache: PagedKVCache, new_tables, copy_src, copy_dst):
                         _copy_partial_blocks(cache.v_pools, copy_src,
                                              copy_dst),
                         new_tables, cache.lens)
+
+
+def _prefix_cow_update(cache: PagedKVCache, copy_src, copy_dst):
+    """Radix prefix cache: copy adopted partial boundary blocks into the
+    adopters' private blocks (copy-on-write at first divergence). Tables
+    and lens are untouched — the adopters' tables already point at the
+    dst blocks. copy_src/copy_dst: [K] block ids, sentinel num_blocks =
+    no copy."""
+    return PagedKVCache(
+        _copy_partial_blocks(cache.k_pools, copy_src, copy_dst),
+        _copy_partial_blocks(cache.v_pools, copy_src, copy_dst),
+        cache.block_tables, cache.lens)
+
+
+_PREFIX_COW_JIT = jax.jit(_prefix_cow_update, donate_argnums=(0,))
 
 
 def _beam_select(running_lp, seqs, fin_seqs, fin_scores, logp, i,

@@ -23,6 +23,14 @@ work into
       ``chaos_abort``       drafted-but-never-verified tokens when a
                             fault aborts a spec tick
 
+A third, token-level column closes the books: **saved** —
+``serving_goodput_saved_tokens_total`` — prefill token-positions the
+device never had to compute because admission adopted them from the
+prefix cache (full-block shares plus the radix trie's partial
+copy-on-write hits). Saved tokens are neither good nor waste: they are
+work that did not happen, the direct counterpart of the
+``replay_prefill`` waste column.
+
 The lifetime ratio good/(good+waste) is exported as the
 ``serving_goodput_ratio`` gauge (refreshed by the engine's gauge sweep
 and on demand via :meth:`GoodputLedger.refresh_gauge`), and a stock
@@ -54,6 +62,10 @@ _WASTE = METRICS.counter(
 _RATIO = METRICS.gauge(
     "serving_goodput_ratio",
     "lifetime goodput/(goodput+waste) token ratio")
+_SAVED = METRICS.counter(
+    "serving_goodput_saved_tokens_total",
+    "prefill token-positions skipped outright at admission — adopted "
+    "from the prefix cache instead of recomputed")
 
 
 def _series_total(inst) -> float:
@@ -71,6 +83,15 @@ class GoodputLedger:
     def waste(self, why: str, n: int):
         if n > 0:
             _WASTE.inc(n, why=why)
+
+    def saved(self, n: int):
+        """Token-positions admission adopted from the prefix cache —
+        device work avoided entirely (no-op for n <= 0)."""
+        if n > 0:
+            _SAVED.inc(n)
+
+    def saved_total(self) -> float:
+        return _series_total(_SAVED)
 
     def good_total(self) -> float:
         return _series_total(_GOOD)

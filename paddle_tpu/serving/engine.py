@@ -744,6 +744,7 @@ class LLMEngine:
         chunked prefill — vLLM-style: long prompts stream in across
         ticks while other slots keep decoding. The final chunk samples
         the request's first token and activates its slot."""
+        self._apply_prefix_copies()
         if not self.prefilling:
             return []
         a_cap = self.num_slots
@@ -835,6 +836,19 @@ class LLMEngine:
                 self._acc_ema[slot] = 1.0
                 emitted += self._emit(slot, int(first[i]))
         return emitted
+
+    def _apply_prefix_copies(self):
+        """Drain the radix manager's host-side COW plan (partial boundary
+        blocks adopted at admission) into ONE device copy. Runs before
+        any other program of the tick writes the pool, so jax data
+        dependencies order the copy ahead of the adopters' prefill
+        chunks and ahead of any reallocation of a source block."""
+        take = getattr(self.mgr, "take_copy_plan", None)
+        if take is None:
+            return
+        pairs = take()
+        if pairs:
+            self.exe.apply_block_copies(pairs)
 
     # --------------------------------------------------------- preemption
     def _preempt(self, protect_rid=None) -> bool:
@@ -1251,6 +1265,16 @@ class LLMEngine:
             req.done = True
             req.finish_reason = "eos" if eos else "length"
             _FINISHED.inc(reason=req.finish_reason)
+            if self.prefix_caching:
+                # commit the GENERATED span too before the blocks park —
+                # decode output becomes matchable (multi-turn chat
+                # re-submits prompt+answer as the next prompt). Commit
+                # only up to the cache frontier ``cur``: the token just
+                # sampled has no KV scattered yet
+                seq = np.concatenate([req.prompt,
+                                      np.asarray(req.tokens, np.int32)])
+                self.mgr.commit_prefix(
+                    rid, seq[:min(len(seq), int(self.cur[slot]))])
             self.mgr.free(rid)
             self.kv.release(rid)
             self.active[slot] = False

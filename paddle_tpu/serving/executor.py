@@ -18,8 +18,8 @@ import numpy as np
 from paddle_tpu.models.decoding import KVCache, _sample_rows
 from paddle_tpu.models.paged import (PagedKVCache, _BEAM_GROUP_UPDATE_JIT,
                                      _PREFILL_CHUNK_JIT, _PREFILL_JIT,
-                                     _REWIND_LENS_JIT, _TICK_JIT,
-                                     _VERIFY_CHUNK_JIT)
+                                     _PREFIX_COW_JIT, _REWIND_LENS_JIT,
+                                     _TICK_JIT, _VERIFY_CHUNK_JIT)
 from paddle_tpu.models.speculative import _FWD_ROWS_JIT
 
 # module-level so its compile cache persists across admissions
@@ -100,6 +100,21 @@ class ModelExecutor:
             jnp.asarray(vals), sub, jnp.asarray(temps),
             jnp.asarray(top_ps), self.top_k, need_logp)
         return nxt, logp
+
+    def apply_block_copies(self, pairs):
+        """Radix prefix cache COW plan: copy each (src, dst) pool block
+        before this tick's programs write the pool. Padded to a fixed
+        width so the jit compiles once; longer plans run in batches."""
+        nb = self.cache.num_blocks
+        width = 8
+        for i in range(0, len(pairs), width):
+            chunk = pairs[i:i + width]
+            src = np.full(width, nb, np.int32)      # sentinel = no copy
+            dst = np.full(width, nb, np.int32)
+            for j, (s, d) in enumerate(chunk):
+                src[j], dst[j] = s, d
+            self.cache = _PREFIX_COW_JIT(self.cache, jnp.asarray(src),
+                                         jnp.asarray(dst))
 
     def beam_group_update(self, slots, rows, lens_val, copy_src, copy_dst):
         """Install forked beam tables + partial-block copy-on-write."""

@@ -1,8 +1,10 @@
 """KVManager: block tables, prefix cache, and the reservation ledger.
 
-The middle layer of the decomposed engine (ISSUE 7). It owns the
-:class:`~paddle_tpu.models.paged.PrefixCachingBlockManager` (host-side
-free-list + content-hashed prefix pool) plus the RESERVATION LEDGER the
+The middle layer of the decomposed engine (ISSUE 7). It owns the block
+manager — :class:`~paddle_tpu.models.paged.RadixPrefixBlockManager`
+(token-span radix trie, copy-on-write partial-block reuse) by default,
+or the flat :class:`~paddle_tpu.models.paged.PrefixCachingBlockManager`
+under the ``PT_RADIX_CACHE=0`` kill switch — plus the RESERVATION LEDGER the
 admission discipline runs on: ``need[rid]`` is a request's worst-case
 block count, ``resv[rid]`` the part not yet materialised as live table
 entries, and ``reserved`` their sum — the blocks the free list must
@@ -11,20 +13,32 @@ this layer tracks what was promised.
 """
 from __future__ import annotations
 
-from paddle_tpu.models.paged import PrefixCachingBlockManager
+import os
+
+from paddle_tpu.models.paged import (PrefixCachingBlockManager,
+                                     RadixPrefixBlockManager)
 from paddle_tpu.serving.telemetry import (_PREFIX_EVICTIONS,
-                                          _PREFIX_HIT_RATE, _PREFIX_HITS)
+                                          _PREFIX_HIT_RATE, _PREFIX_HITS,
+                                          _PREFIX_PARTIAL_HITS,
+                                          _PREFIX_TOKEN_HIT_RATE,
+                                          _PREFIX_TOKEN_HITS)
 
 
 class KVManager:
     """Block allocation + worst-case reservation accounting."""
 
     def __init__(self, num_blocks: int, block_size: int):
-        # refcounted + content-hashed: beam groups share prompt blocks
+        # refcounted + prefix-cached: beam groups share prompt blocks
         # copy-on-write; requests with equal prompt prefixes share the
         # prefix blocks outright (prefill only runs on the uncached
-        # suffix); with no sharing it behaves exactly like BlockManager
-        self.mgr = PrefixCachingBlockManager(num_blocks, block_size)
+        # suffix); with no sharing it behaves exactly like BlockManager.
+        # Default is the radix trie (token-span matching + partial-block
+        # COW); PT_RADIX_CACHE=0 coerces back to the flat full-block
+        # hash map (checked at construction — per engine)
+        cls = (PrefixCachingBlockManager
+               if os.environ.get("PT_RADIX_CACHE", "1") == "0"
+               else RadixPrefixBlockManager)
+        self.mgr = cls(num_blocks, block_size)
         self.reserved = 0            # blocks promised to in-flight requests
         self.resv: dict[int, int] = {}    # req_id -> outstanding reserve
         self.need: dict[int, int] = {}    # req_id -> worst-case blocks
@@ -113,10 +127,20 @@ class KVManager:
         stats = getattr(self.mgr, "cache_stats", None)
         if stats is None:
             return
-        _PREFIX_HITS.inc(stats["hit_blocks"]
-                         - self._prefix_pushed["hit_blocks"])
-        _PREFIX_EVICTIONS.inc(stats["evictions"]
-                              - self._prefix_pushed["evictions"])
+        # stat keys added after construction (the radix trie grows the
+        # dict) must delta against 0, not KeyError against the snapshot
+        pushed = self._prefix_pushed
+
+        def delta(key):
+            return stats.get(key, 0) - pushed.get(key, 0)
+
+        _PREFIX_HITS.inc(delta("hit_blocks"))
+        _PREFIX_EVICTIONS.inc(delta("evictions"))
+        _PREFIX_TOKEN_HITS.inc(delta("token_hits"))
+        _PREFIX_PARTIAL_HITS.inc(delta("partial_hits"))
         self._prefix_pushed = dict(stats)
-        _PREFIX_HIT_RATE.set(stats["hit_blocks"]
-                             / max(stats["lookup_blocks"], 1))
+        _PREFIX_HIT_RATE.set(stats.get("hit_blocks", 0)
+                             / max(stats.get("lookup_blocks", 0), 1))
+        if stats.get("lookup_tokens", 0):
+            _PREFIX_TOKEN_HIT_RATE.set(stats["token_hits"]
+                                       / stats["lookup_tokens"])

@@ -120,6 +120,25 @@ class Scheduler:
                 cancel(rid, reason="timeout")
 
     # ---------------------------------------------------------- admission
+    def _prefix_lookup(self, eng, req):
+        """Memoized prefix-cache probe: ``match_prefix`` hashes/walks the
+        whole prompt, and a request stuck at the queue head is re-probed
+        every admission attempt — quadratic host work under a deep queue.
+        The memo keys on the manager's ``cache_epoch`` (bumped on every
+        eviction and commit) plus the effective prompt length (a resume
+        changes it), so a stale match is impossible."""
+        kv = eng.kv
+        p = eng._pr(req)
+        epoch = getattr(kv.mgr, "cache_epoch", None)
+        memo = req._match_memo
+        if (memo is not None and epoch is not None
+                and memo[0] == epoch and memo[1] == len(p)):
+            return memo[2]
+        m = kv.mgr.match_prefix(p)
+        if epoch is not None:
+            req._match_memo = (epoch, len(p), m)
+        return m
+
     def select_admissions(self, eng):
         """FCFS: move queued requests into free slots while the pool can
         cover their worst case; returns (greedy (slot, req) pairs,
@@ -134,23 +153,33 @@ class Scheduler:
             # prefix-cache lookup BEFORE the capacity gate: shared blocks
             # cost nothing, so a mostly-cached prompt admits under
             # pressure an uncached one would wait out
-            cached = (kv.mgr.match_prefix(p)
-                      if eng.prefix_caching and k == 1 else [])
-            ct = len(cached) * eng.block_size
+            cached = (self._prefix_lookup(eng, req)
+                      if eng.prefix_caching and k == 1 else None)
+            n_shared = len(cached) if cached else 0
+            # the TOKEN frontier: the radix trie reports partial-block
+            # hits (match.token_count), the flat manager whole blocks
+            ct = (getattr(cached, "token_count",
+                          n_shared * eng.block_size) if cached else 0)
             if eng.preemption and k == 1:
                 # optimistic: cover only the first prefill chunk (+1
-                # decode-headroom block); out-of-blocks later preempts
+                # decode-headroom block); out-of-blocks later preempts.
+                # Only the FULLY shared blocks are free — a partial COW
+                # hit allocates its private boundary block out of `need`
                 need = (kv.blocks_needed(
-                    min(len(p), ct + eng.max_prompt_len)) - len(cached) + 1)
+                    min(len(p), ct + eng.max_prompt_len)) - n_shared + 1)
             else:
                 need = eng._worst_case_blocks(req)
             if (k > len(free_slots)
                     or need > kv.free_blocks - kv.reserved):
                 break                      # FCFS: do not starve the head
             self.queue.popleft()
+            req._match_memo = None
             _ADMITTED.inc()
             if req._submit_t is not None:
                 _QUEUE_WAIT.observe(max(0.0, self.clock() - req._submit_t))
+            # token-level hit accounting: every cached token is prefill
+            # device work the pool did NOT have to repeat
+            GOODPUT.saved(ct)
             if req._resume is not None:
                 # replayed after preemption: every resume token past the
                 # prefix-cache hit is device work already paid for once
@@ -241,8 +270,13 @@ class Scheduler:
         if not cand:
             return False
         rid = max(cand, key=lambda r: eng.adm_order[eng.prefilling[r][0]])
-        slot, _ = eng.prefilling.pop(rid)
+        slot, consumed = eng.prefilling.pop(rid)
         req = self.requests[rid]
+        if eng.prefix_caching and consumed:
+            # the chunks already scattered are finished device work —
+            # commit them so the replay prefill re-matches instead of
+            # recomputing (replay_prefill waste shrinks to the tail)
+            eng.kv.mgr.commit_prefix(rid, eng._pr(req)[:consumed])
         eng.kv.free(rid)
         eng.kv.release(rid)
         eng.slot_req[slot] = -1
@@ -273,6 +307,15 @@ class Scheduler:
         req._resume = (np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int32)])
             if req.tokens else req.prompt)
+        if eng.prefix_caching:
+            # park everything the victim computed — full blocks AND (in
+            # the radix trie) the partial frontier block — so the resume
+            # prefill starts at the token frontier, not from scratch.
+            # ``cur`` is the cache frontier: the newest sampled token's
+            # KV is not scattered yet, so it must not be committed
+            eng.kv.mgr.commit_prefix(
+                rid, req._resume[:min(len(req._resume),
+                                      int(eng.cur[slot]))])
         eng.kv.free(rid)
         eng.kv.release(rid)
         eng.active[slot] = False

@@ -77,6 +77,20 @@ _PREFIX_EVICTIONS = METRICS.counter(
 _PREFIX_HIT_RATE = METRICS.gauge(
     "serving_prefix_hit_rate",
     "prefix-cache hit blocks / prompt blocks requested (lifetime)")
+# radix trie (ISSUE 10): token-level accounting — the trie matches the
+# longest shared token span, so hits are no longer block-quantised; a
+# partial hit is a boundary block adopted copy-on-write
+_PREFIX_TOKEN_HITS = METRICS.counter(
+    "serving_prefix_token_hits_total",
+    "prompt tokens served from the prefix cache (full-block shares plus "
+    "partial copy-on-write boundary hits) instead of prefilled")
+_PREFIX_PARTIAL_HITS = METRICS.counter(
+    "serving_prefix_partial_hits_total",
+    "partially-filled boundary blocks adopted copy-on-write from the "
+    "radix trie")
+_PREFIX_TOKEN_HIT_RATE = METRICS.gauge(
+    "serving_prefix_token_hit_rate",
+    "prefix-cache hit tokens / prompt tokens probed (lifetime)")
 # MoE serving: routing choices dropped by expert-capacity overflow
 # (always 0 for dropless models — Mixtral/Qwen2-MoE serve with
 # capacity_factor=None)

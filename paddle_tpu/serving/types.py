@@ -62,6 +62,10 @@ class Request:
     # prefill recomputes the whole sequence (prefix-cache hits make the
     # recompute cheap when its old blocks are still parked)
     _resume: object = None
+    # scheduler-side prefix-match memo: (cache_epoch, prompt_len, match).
+    # A queued request is re-probed only when the manager's epoch moved
+    # (eviction/commit) or its effective prompt changed (resume)
+    _match_memo: tuple = None
     # request tracker (ISSUE 9): trace_id is minted at first submit while
     # tracking is enabled (None = untracked, every tracker call no-ops);
     # trace_summary is the finished timeline summary, same dict /requests

@@ -29,6 +29,10 @@ rule is installed). Tests install rules against site names:
     router.replica_death  before a replica's step — an exception marks
                      the replica dead; its live requests requeue to a
                      healthy replica exactly once
+    serving.prefix_evict  before a radix prefix-cache leaf eviction
+                     frees its parked block — fires pre-mutation, so an
+                     exception leaves the trie and free list untouched
+                     (the allocation that triggered it fails cleanly)
     train.step       top of each trainer step (exception / stall)
     train.loss       loss override — return value replaces the real loss
                      (NaN injection)
