@@ -115,6 +115,7 @@ def cpu_legs_main():
     out = {}
     for key, fn in (("host_overlap", bench_host_overlap),
                     ("serving_spec", bench_serving_spec),
+                    ("serving_chunk_attn", bench_serving_chunk_attn),
                     ("serving_moe", bench_serving_moe),
                     ("serving_router", bench_serving_router),
                     ("serving_prefix", bench_serving_prefix)):
@@ -126,8 +127,8 @@ def cpu_legs_main():
     from paddle_tpu.observability import METRICS
     out["counters"] = {
         k: v for k, v in METRICS.snapshot()["counters"].items()
-        if k.startswith(("serving_spec_", "serving_prefix_", "moe_",
-                         "router_"))}
+        if k.startswith(("serving_spec_", "serving_prefix_",
+                         "serving_pallas_", "moe_", "router_"))}
     print(json.dumps(out))
 
 
@@ -637,6 +638,25 @@ def bench_serving_spec():
     run(make(False), prompts[:2])          # warmup / compile both paths
     run(make(True), prompts[:2])
 
+    # draft reuse from the radix frontier (ISSUE 11): sequential
+    # prefix-overlap sessions land on the same slot, whose resident
+    # draft cache still holds the shared prefix — the catch-up feed
+    # skips the adopted span, visible as reuse tokens saved and as
+    # replay_prefill waste that never accrues
+    from paddle_tpu.observability import GOODPUT
+    from paddle_tpu.serving.telemetry import _SPEC_DRAFT_REUSE
+    shared = rs.randint(0, 512, (24,))
+    reuse_prompts = [np.concatenate([shared, rs.randint(0, 512, (6,))])
+                     for _ in range(4)]
+    r0 = _SPEC_DRAFT_REUSE.value()
+    w0 = GOODPUT.waste_by_why().get("replay_prefill", 0)
+    eng_reuse = make(True)
+    for p in reuse_prompts:                # one at a time: same slot
+        run(eng_reuse, [p])
+    draft_reuse = int(_SPEC_DRAFT_REUSE.value() - r0)
+    reuse_replay = int(GOODPUT.waste_by_why().get("replay_prefill", 0)
+                       - w0)
+
     from paddle_tpu.observability import GOODPUT, REQUESTS
     results, traced = {}, {}
     for label, spec in (("off", False), ("on", True)):
@@ -673,6 +693,90 @@ def bench_serving_spec():
         "goodput_ratio_off": traced["off"][1],
         "goodput_ratio_on": traced["on"][1],
         "ttft_breakdown_on": traced["on"][0],
+        # draft catch-up tokens the radix-frontier reuse eliminated
+        # (ISSUE 11): adopted-span positions the draft did NOT re-embed,
+        # and the replay_prefill waste the overlap run still accrued
+        # (0 when every adopted span was fully resident)
+        "draft_reuse_tokens": draft_reuse,
+        "draft_reuse_replay_waste": reuse_replay,
+    }
+
+
+def bench_serving_chunk_attn():
+    """Fused chunk-attention leg (ISSUE 11): steps/sec of the
+    verify-shaped ``(slots, k+1)`` chunk program, forced-XLA
+    (PT_PAGED_CHUNK=0) vs the dispatch path, with a greedy (argmax)
+    match bar over the full [A, C, V] verify logits. On CPU the dispatch
+    resolves to the same XLA gather program, so the ratio is ~1.0 and
+    the bar is an identity check; on TPU the dispatch runs the Pallas
+    kernel and the ratio is the fusion speedup."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import paged as P
+
+    import paddle_tpu as pt
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, vocab_size=512,
+                           hidden_size=128, intermediate_size=256,
+                           num_attention_heads=8, num_key_value_heads=4,
+                           max_position_embeddings=256)
+    model = LlamaForCausalLM(cfg)
+    slots, bs, C, L0, steps = 8, 8, 5, 24, 30
+    mbps = -(-(L0 + C) // bs) + 1
+    nb = slots * mbps
+    rows = np.asarray([[i * mbps + j for j in range(mbps)]
+                       for i in range(slots)], np.int32)
+    slot_ids = np.arange(slots, dtype=np.int32)
+    rs = np.random.RandomState(0)
+    prompt_ids = rs.randint(0, 512, (slots, L0)).astype(np.int32)
+    verify_ids = rs.randint(0, 512, (slots, C)).astype(np.int32)
+
+    def fresh_cache():
+        cache = P.PagedKVCache.init(
+            cfg.num_hidden_layers, nb, bs, cfg.num_key_value_heads,
+            cfg.hidden_size // cfg.num_attention_heads, slots, mbps,
+            jnp.float32)
+        _, cache = P.llama_prefill_chunk_paged(
+            model, prompt_ids, np.full(slots, L0, np.int32),
+            np.zeros(slots, np.int32), cache, slot_ids, rows)
+        return cache
+
+    offs = np.full(slots, L0, np.int32)
+    cls = np.full(slots, C, np.int32)
+
+    def phase(mode):
+        old = os.environ.pop("PT_PAGED_CHUNK", None)
+        if mode is not None:
+            os.environ["PT_PAGED_CHUNK"] = mode
+        try:
+            P.clear_jit_caches()
+            cache = fresh_cache()
+            logits, cache = P._VERIFY_CHUNK_JIT(     # compile warmup
+                model, verify_ids, cls, offs, cache, slot_ids, rows)
+            am = np.asarray(jnp.argmax(logits, axis=-1))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                logits, cache = P._VERIFY_CHUNK_JIT(
+                    model, verify_ids, cls, offs, cache, slot_ids, rows)
+            jax.block_until_ready(logits)
+            return steps / (time.perf_counter() - t0), am
+        finally:
+            os.environ.pop("PT_PAGED_CHUNK", None)
+            if old is not None:
+                os.environ["PT_PAGED_CHUNK"] = old
+            P.clear_jit_caches()
+
+    xla_sps, xla_am = phase("0")
+    disp_sps, disp_am = phase(None)
+    return {
+        "slots": slots, "k_plus_1": C, "offset": L0,
+        "xla_steps_per_sec": round(xla_sps, 2),
+        "dispatch_steps_per_sec": round(disp_sps, 2),
+        "speedup": round(disp_sps / xla_sps, 3),
+        # greedy bar: every verify position's argmax must agree
+        "greedy_match": bool((xla_am == disp_am).all()),
     }
 
 
@@ -1088,6 +1192,15 @@ def main():
         print(f"bench config serving_spec failed: {e!r}", file=sys.stderr)
         serving_spec = {"error": f"{type(e).__name__}: {e}"}
 
+    # fused chunk attention: verify-shaped steps/sec, forced-XLA vs the
+    # dispatch path (Pallas on TPU), with a greedy match bar
+    try:
+        serving_chunk_attn = bench_serving_chunk_attn()
+    except Exception as e:  # noqa: BLE001 — per-config isolation
+        print(f"bench config serving_chunk_attn failed: {e!r}",
+              file=sys.stderr)
+        serving_chunk_attn = {"error": f"{type(e).__name__}: {e}"}
+
     # MoE serving: decode tokens/sec grouped GEMM vs the dense capacity
     # fallback on a Mixtral-shaped engine — backend-independent
     try:
@@ -1142,9 +1255,11 @@ def main():
         "counters": {k: v for k, v in snap["counters"].items()
                      if k.startswith(("collective_", "faults_",
                                       "serving_spec_", "serving_prefix_",
+                                      "serving_pallas_",
                                       "moe_", "router_"))},
         "host_overlap": host_overlap,
         "serving_spec": serving_spec,
+        "serving_chunk_attn": serving_chunk_attn,
         "serving_moe": serving_moe,
         "serving_router": serving_router,
         "serving_prefix": serving_prefix,

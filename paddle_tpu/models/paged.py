@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.ops import attention as A
-from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+from paddle_tpu.ops.pallas.paged_attention import (paged_chunk_attention,
+                                                   paged_decode_attention)
 from paddle_tpu.quantization import wo_matmul as _wo
 
 
@@ -1328,18 +1329,6 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         return jnp.concatenate([t1 * cos - t2 * sin, t2 * cos + t1 * sin],
                                axis=-1).astype(t.dtype)
 
-    max_blocks = tables.shape[1]
-    pool_pos = jnp.arange(max_blocks * bs)[None, None, :]   # [1, 1, MBbs]
-    q_pos = positions[:, :, None]                           # [A, C, 1]
-    # per-ROW valid length (new_lens is per-SLOT — indexing it by batch
-    # row would borrow another sequence's length whenever row != slot)
-    row_lens = offsets + chunk_lens                         # [A]
-    keep = (pool_pos <= q_pos) & (pool_pos < row_lens[:, None, None])
-    if window is not None:
-        keep &= (q_pos - pool_pos) < window
-    mask = keep[:, None]                                    # [A,1,C,MBbs]
-    tbl = jnp.minimum(tables, nb - 1)
-
     k_pools, v_pools = [], []
     for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
@@ -1359,11 +1348,11 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
                                        offsets, chunk_lens, nb, bs)
         k_pools.append(k_pool)
         v_pools.append(v_pool)
-        kg = jnp.take(k_pool, tbl, axis=0).reshape(a, max_blocks * bs,
-                                                   nkv, hd)
-        vg = jnp.take(v_pool, tbl, axis=0).reshape(a, max_blocks * bs,
-                                                   nkv, hd)
-        out = A.xla_attention(q, kg, vg, attn_mask=mask)
+        # ragged pool-direct attention: the kernel reads only each row's
+        # live blocks (the XLA fallback reconstructs the old full
+        # gather + dense-mask view, bit-compatible)
+        out = paged_chunk_attention(q, k_pool, v_pool, tables, offsets,
+                                    chunk_lens, window=window)
         x = x + _wo(out.reshape(a, c, nh * hd), att.o_proj)
         x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
     x = _backbone(model).norm(x)

@@ -21,6 +21,7 @@ _INSTRUMENT_MODULES = (
     "paddle_tpu.observability.compile",
     "paddle_tpu.observability.goodput",
     "paddle_tpu.serving.telemetry",
+    "paddle_tpu.ops.pallas.paged_attention",
     "paddle_tpu.train.trainer",
     "paddle_tpu.train.checkpoint",
     "paddle_tpu.train.elastic",

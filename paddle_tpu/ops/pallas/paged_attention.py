@@ -1,35 +1,90 @@
-"""Paged-attention decode kernel (ref capability: PaddleNLP ``llm``
+"""Paged-attention kernels (ref capability: PaddleNLP ``llm``
 block-attention / ``paddle/phi/kernels/fusion/gpu/
 fused_multi_transformer_op.cu`` block KV cache).
 
 TPU-first design: the KV cache is a POOL of fixed-size blocks
 ([num_blocks, block_size, H_kv, D]) shared by all sequences; each sequence
-owns a row of ``block_tables`` (pool indices). Decode attention reads a
+owns a row of ``block_tables`` (pool indices). Attention reads a
 sequence's blocks pool-directly via a scalar-prefetched block table
 (``pltpu.PrefetchScalarGridSpec``) — the kernel's index_map picks the
 physical block for each grid step, so the gathered K/V is NEVER
 materialised: HBM holds pool ≈ Σ actual lengths (not B × max_len) and VMEM
 holds one block at a time.
 
-Layout: q [B, H, D] (one decode token per sequence), pool
-[N_blocks, block_size, H_kv, D], block_tables [B, max_blocks], lens [B].
-Unused table slots must hold a VALID pool index (0 is fine): the index map
-still reads them, the compute is masked off by ``lens``.
+Two kernels share that scheme:
+
+* **decode** — q [B, H, D] (one token per sequence), grid
+  (B*H, kv-block), lens [B] masking the ragged tail.
+* **chunk** (ISSUE 11) — the ragged MULTI-query forward behind chunked
+  prefill and the spec-decode ``(slots, k+1)`` verify batch: q
+  [A, C, H, D] chunk queries at positions ``offsets[a] ..
+  offsets[a]+chunk_lens[a]-1``, attending causally over the slot's whole
+  pool prefix. Grid (A*H_kv, q-tile, kv-block); the H/H_kv query heads of
+  a KV head fold into the q tile, so GQA needs no repeated K/V.
+
+Unused table slots hold the OOB sentinel (= num_blocks): index maps clamp
+it, the compute is masked off by the length scalars.
+
+Dispatch functions (``paged_decode_attention`` /
+``paged_chunk_attention``) pick Pallas on TPU and the XLA gather
+reference elsewhere. A Pallas trace/lower failure is cached per process
+(one ``warnings.warn`` + a ``serving_pallas_fallback_total{kernel}``
+increment — NOT retried every call), and ``PT_PAGED_CHUNK=0`` force-kills
+the chunk kernel (``=interpret`` forces the interpreted kernel off-TPU,
+the engine-level parity mode).
 """
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.observability.metrics import METRICS
+
 # CompilerParams was TPUCompilerParams before the pallas API rename
 _CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or pltpu.TPUCompilerParams
 
 _NEG_INF = -1e30
+
+_PALLAS_FALLBACK = METRICS.counter(
+    "serving_pallas_fallback_total",
+    "paged-attention Pallas kernels that failed to trace/lower and were "
+    "replaced by the XLA gather path for the rest of the process, by "
+    "kernel (decode/chunk)",
+    labelnames=("kernel",))
+
+# kernel -> first failure, recorded by the dispatch functions: once a
+# kernel fails to trace/lower on this process it is NOT retried on every
+# call (the old bare ``except: pass`` re-paid the trace failure per
+# dispatch and hid the downgrade entirely)
+_pallas_disabled: dict[str, str] = {}
+
+# trace-time breadcrumbs ("chunk:xla-forced", "chunk:pallas", ...): one
+# entry per DISPATCH TRACE, so tests can assert which implementation a
+# jitted program actually baked in (flipping PT_PAGED_CHUNK without
+# clearing jit caches appends nothing — the stale trace is reused)
+_trace_events: list[str] = []
+
+
+def _note_trace(event: str):
+    if len(_trace_events) >= 512:
+        del _trace_events[:256]
+    _trace_events.append(event)
+
+
+def _disable_pallas(kernel: str, err: Exception):
+    _pallas_disabled[kernel] = f"{type(err).__name__}: {err}"
+    _PALLAS_FALLBACK.inc(kernel=kernel)
+    warnings.warn(
+        f"paged {kernel} attention: Pallas kernel failed to trace/lower "
+        f"({type(err).__name__}: {err}); using the XLA gather path for "
+        "the rest of the process", RuntimeWarning, stacklevel=3)
 
 
 def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
@@ -183,13 +238,258 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lens, *,
                            interpret: bool | None = None):
     """Dispatch: Pallas on TPU (pool-direct block reads), XLA elsewhere.
     ``window``: sliding-window bound — only the last `window` positions
-    are visible (Mistral decode semantics)."""
-    if jax.default_backend() == "tpu":
+    are visible (Mistral decode semantics). A Pallas failure downgrades
+    this process to the XLA path permanently (cached, warned, counted —
+    see ``_disable_pallas``)."""
+    if jax.default_backend() == "tpu" and "decode" not in _pallas_disabled:
         try:
             return paged_decode_attention_pallas(
                 q, k_pool, v_pool, block_tables, lens, scale=scale,
                 window=window, interpret=interpret)
-        except Exception:
-            pass
+        except Exception as e:
+            _disable_pallas("decode", e)
     return paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens,
                                       scale=scale, window=window)
+
+
+# --------------------------------------------------------- chunk kernel
+# The ragged multi-query forward (ISSUE 11): chunked prefill writes C
+# tokens per row at offsets[a]..offsets[a]+chunk_lens[a]-1 and each of
+# them attends causally over the row's WHOLE pool prefix. The spec-decode
+# verify batch is the same program at C = k+1. The q tile folds the
+# H/H_kv query heads of one KV head (GQA without repeating K/V), and the
+# kv-block axis walks the row's block table with dead tiles skipped:
+# blocks past the causal frontier of a q tile (and past the row's live
+# length) clamp their index map to the last live block, so Mosaic never
+# issues a fresh DMA for them, and their compute is @pl.when-masked.
+
+def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
+                        o_ref, acc, m_scr, l_scr, *, block_size, scale,
+                        max_blocks, q_tile, group, n_kv, window):
+    """Grid (A*H_kv, q-tiles, kv-blocks). Row r serves sequence
+    a = r // n_kv, KV head r % n_kv; its q tile holds ``q_tile`` folded
+    rows (folded row t = query position t // group, grouped head
+    t % group). Online-softmax accumulation across the kv-block axis."""
+    r = pl.program_id(0)
+    qt = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    a_idx = r // n_kv
+    off = offs_ref[a_idx, 0]
+    cl = cls_ref[a_idx, 0]
+    row_len = off + cl                     # this row's live pool length
+    n_live = pl.cdiv(row_len, block_size)
+    q0 = qt * q_tile                       # first folded row of the tile
+    last_q = off + (q0 + q_tile - 1) // group   # tile's last query position
+    live = (j < n_live) & (q0 < cl * group)
+    # causal dead-tile skip: a block whose FIRST key position is past the
+    # tile's LAST query position contributes nothing
+    live &= j * block_size <= last_q
+    if window is not None:
+        # sliding window: a block entirely below the tile's first query's
+        # window is invisible to every query in the tile
+        first_q = off + q0 // group
+        live &= (j + 1) * block_size - 1 > first_q - window
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                       # [q_tile, D] folded queries
+        k = k_ref[0, 0]                    # [block_size, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q.astype(jnp.float32), k.astype(jnp.float32),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        row_t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qpos = off + (q0 + row_t) // group
+        kpos = j * block_size + col
+        # causal + ragged: key visible iff it is at/before the query AND
+        # inside the row's live length; folded rows past chunk_lens*group
+        # are padding (their tile output is discarded by the caller)
+        keep = (kpos <= qpos) & (kpos < row_len)
+        keep &= (q0 + row_t) < cl * group
+        if window is not None:
+            keep &= (qpos - kpos) < window
+        s = jnp.where(keep, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        pv = jax.lax.dot_general(p, v.astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * corr + pv
+
+    @pl.when(j == max_blocks - 1)
+    def _finalize():
+        # fully-masked rows (dead/padding) have l == 0: emit 0, not NaN
+        o_ref[0] = (acc[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
+                                 chunk_lens, *, scale=None, window=None,
+                                 q_tile=None, interpret: bool | None = None):
+    """Ragged chunk attention over block tables. q: [A, C, H, D] (chunk
+    queries, already rotated); k_pool/v_pool: [N, bs, H_kv, D] with the
+    chunk K/V ALREADY scattered pool-side; block_tables: [A, max_blocks]
+    int32 (OOB sentinel = N on unused slots); offsets/chunk_lens: [A]
+    int32 — row a's queries sit at positions offsets[a] ..
+    offsets[a]+chunk_lens[a]-1 and attend over pool positions
+    [0, offsets[a]+chunk_lens[a]) causally. Rows with chunk_lens == 0 are
+    dead (output 0). Returns [A, C, H, D]."""
+    a, c, h, d = q.shape
+    n, bs, h_kv, _ = k_pool.shape
+    group = h // h_kv
+    max_blocks = block_tables.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    cg = c * group
+    if q_tile is None:
+        # sublane-aligned tile; one tile unless the folded chunk is large
+        q_tile = min(256, -(-cg // 8) * 8)
+    n_qt = -(-cg // q_tile)
+    pad = n_qt * q_tile - cg
+
+    # fold the grouped query heads into the row axis: row t of (a, kv) is
+    # query position t // group, grouped head t % group — matches the
+    # (head // kv_rep) GQA convention of the decode kernel
+    qf = q.reshape(a, c, h_kv, group, d).transpose(0, 2, 1, 3, 4)
+    qf = qf.reshape(a * h_kv, cg, d)
+    if pad:
+        qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
+
+    tables = jnp.asarray(block_tables, jnp.int32)
+    offs = jnp.asarray(offsets, jnp.int32)[:, None]
+    cls = jnp.asarray(chunk_lens, jnp.int32)[:, None]
+
+    kp = jnp.moveaxis(k_pool, 2, 0)        # [H_kv, N, bs, D]
+    vp = jnp.moveaxis(v_pool, 2, 0)
+
+    def q_index(r, qt, j, tables, offs, cls):
+        return (r, qt, 0)
+
+    def kv_index(r, qt, j, tables, offs, cls):
+        a_i = r // n_kv_s
+        row_len = offs[a_i, 0] + cls[a_i, 0]
+        n_live = (row_len + bs - 1) // bs
+        last_q = offs[a_i, 0] + (qt * q_tile + q_tile - 1) // group
+        # dead trailing steps (past the causal frontier or the live
+        # length) revisit the last live block: same index -> no new DMA
+        hi = jnp.minimum(n_live - 1, last_q // bs)
+        jl = jnp.minimum(j, jnp.maximum(hi, 0))
+        return (r % n_kv_s, jnp.minimum(tables[a_i, jl], n - 1), 0, 0)
+
+    n_kv_s = h_kv
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(a * h_kv, n_qt, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, q_tile, d), q_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, q_tile, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((q_tile, d), jnp.float32),
+            # per-folded-row running max / denom, lane-replicated (scalar
+            # (x, 1) VMEM stores hit Mosaic layout restrictions)
+            pltpu.VMEM((q_tile, 128), jnp.float32),
+            pltpu.VMEM((q_tile, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_chunk_kernel, block_size=bs,
+                               scale=scale, max_blocks=max_blocks,
+                               q_tile=q_tile, group=group, n_kv=h_kv,
+                               window=window)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((a * h_kv, n_qt * q_tile, d),
+                                       q.dtype),
+        # rows and q tiles are independent; only the kv-block axis carries
+        # the online-softmax state
+        compiler_params=_CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
+                                 pltpu.ARBITRARY)),
+        interpret=interpret,
+    )(tables, offs, cls, qf, kp, vp)
+    out = out[:, :cg].reshape(a, h_kv, c, group, d)
+    return out.transpose(0, 2, 1, 3, 4).reshape(a, c, h, d)
+
+
+def paged_chunk_attention_xla(q, k_pool, v_pool, block_tables, offsets,
+                              chunk_lens, *, scale=None, window=None):
+    """Gather-based reference path (CPU / fallback): materialise each
+    row's whole ``max_blocks*bs`` pool view and run dense masked
+    attention — exactly the pre-kernel ``llama_prefill_chunk_paged``
+    inner loop, kept bit-compatible for the PT_PAGED_CHUNK=0 kill
+    switch."""
+    from paddle_tpu.ops import attention as A
+    a, c, h, d = q.shape
+    n, bs, h_kv, _ = k_pool.shape
+    max_blocks = block_tables.shape[1]
+    offsets = jnp.asarray(offsets, jnp.int32)
+    chunk_lens = jnp.asarray(chunk_lens, jnp.int32)
+    tbl = jnp.minimum(block_tables, n - 1)
+    kg = jnp.take(k_pool, tbl, axis=0).reshape(a, max_blocks * bs, h_kv, d)
+    vg = jnp.take(v_pool, tbl, axis=0).reshape(a, max_blocks * bs, h_kv, d)
+    pool_pos = jnp.arange(max_blocks * bs)[None, None, :]
+    q_pos = (offsets[:, None]
+             + jnp.arange(c, dtype=jnp.int32))[:, :, None]
+    row_lens = offsets + chunk_lens
+    keep = (pool_pos <= q_pos) & (pool_pos < row_lens[:, None, None])
+    if window is not None:
+        keep &= (q_pos - pool_pos) < window
+    return A.xla_attention(q, kg, vg, attn_mask=keep[:, None], scale=scale)
+
+
+def paged_chunk_attention(q, k_pool, v_pool, block_tables, offsets,
+                          chunk_lens, *, scale=None, window=None,
+                          interpret: bool | None = None):
+    """One dispatch for the ragged chunk path. ``PT_PAGED_CHUNK``
+    (read at TRACE time — flip it between engine constructions together
+    with ``models.paged.clear_jit_caches``):
+
+      unset/1     Pallas kernel on TPU, XLA gather elsewhere (default)
+      0/off/xla   force the XLA gather path (kill switch)
+      interpret   force the interpreted Pallas kernel (off-TPU parity)
+
+    Like the decode dispatch, a Pallas failure downgrades the process
+    permanently (cached + warned + counted, never silently retried)."""
+    mode = os.environ.get("PT_PAGED_CHUNK", "1").strip().lower()
+    if mode in ("0", "off", "xla"):
+        _note_trace("chunk:xla-forced")
+        return paged_chunk_attention_xla(
+            q, k_pool, v_pool, block_tables, offsets, chunk_lens,
+            scale=scale, window=window)
+    if mode == "interpret":
+        _note_trace("chunk:pallas-interpret")
+        return paged_chunk_attention_pallas(
+            q, k_pool, v_pool, block_tables, offsets, chunk_lens,
+            scale=scale, window=window, interpret=True)
+    if jax.default_backend() == "tpu" and "chunk" not in _pallas_disabled:
+        try:
+            out = paged_chunk_attention_pallas(
+                q, k_pool, v_pool, block_tables, offsets, chunk_lens,
+                scale=scale, window=window, interpret=interpret)
+            _note_trace("chunk:pallas")
+            return out
+        except Exception as e:
+            _disable_pallas("chunk", e)
+    _note_trace("chunk:xla")
+    return paged_chunk_attention_xla(
+        q, k_pool, v_pool, block_tables, offsets, chunk_lens,
+        scale=scale, window=window)

@@ -56,7 +56,9 @@ from paddle_tpu.serving.scheduler import Scheduler
 from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
                                           _DRAIN, _FINISHED, _KV_IN_USE,
                                           _KV_UTIL, _QUEUE_DEPTH,
-                                          _SPEC_ACCEPTED, _SPEC_FALLBACKS,
+                                          _SPEC_ACCEPTED,
+                                          _SPEC_DRAFT_REUSE,
+                                          _SPEC_FALLBACKS,
                                           _SPEC_PROPOSED, _SPEC_RATE,
                                           _SPEC_TOKENS, _TICK, _TIMEOUTS,
                                           _TOK_LAT, _TOKENS, _TTFT)
@@ -185,6 +187,16 @@ class LLMEngine:
         self.draft_cur = np.zeros(num_slots, np.int64)
         self.slot_k = np.full(num_slots, self.spec_k, np.int64)
         self._acc_ema = np.ones(num_slots, np.float64)
+        # draft-cache reuse across sessions of a slot (ISSUE 11): the
+        # token ids whose K/V currently sit in the draft cache rows
+        # 0..draft_cur-1, snapshotted host-side at each commit. A new
+        # request whose radix-adopted prefix matches the resident ids
+        # seeds draft_cur past the match instead of re-feeding from 0.
+        self._draft_resident: dict[int, np.ndarray] = {}
+        # per-slot adopted span of the CURRENT request: the draft
+        # catch-up feed bills only re-embeds inside this span as
+        # replay_prefill waste (first-time prompt embedding is not waste)
+        self._adopted_span = np.zeros(num_slots, np.int64)
 
         self.is_beam = np.zeros(num_slots, bool)
         self.groups: dict[int, _BeamGroup] = {}
@@ -556,9 +568,10 @@ class LLMEngine:
                                 else req.temperature)
             self.top_ps[slot] = (self.default_top_p if req.top_p is None
                                  else req.top_p)
-            # fresh draft state: an evicted slot's draft cache was "freed"
-            # by zeroing this frontier — replay rebuilds it from scratch
-            self.draft_cur[slot] = 0
+            # fresh draft state unless the resident draft cache covers a
+            # radix-adopted prefix (an evicted slot's draft cache was
+            # "freed" by zeroing this frontier — replay rebuilds it)
+            self._seed_draft(slot, req)
             self.slot_k[slot] = self.spec_k
             self._acc_ema[slot] = 1.0
             REQUESTS.event(req, "prefill", replica=self.trace_name,
@@ -831,7 +844,9 @@ class LLMEngine:
                 self.table_len[slot] = len(t)
                 self.temps[slot] = row_t[i]
                 self.top_ps[slot] = row_p[i]
-                self.draft_cur[slot] = 0
+                # cached/long prompts land here — the site where a radix
+                # adoption can seed the draft frontier from resident K/V
+                self._seed_draft(slot, req)
                 self.slot_k[slot] = self.spec_k
                 self._acc_ema[slot] = 1.0
                 emitted += self._emit(slot, int(first[i]))
@@ -947,6 +962,39 @@ class LLMEngine:
         toks = np.asarray(req.tokens[len(req.tokens) - g:], np.int32)
         return np.concatenate([self._pr(req), toks])
 
+    def _seed_draft(self, slot: int, req):
+        """Seed a freshly activated slot's draft frontier from the
+        resident draft cache (ISSUE 11, closing PR 9's REMAINING). The
+        dense draft cache is per-slot and nothing writes it while the
+        slot is parked, so rows 0..len(resident)-1 still hold the draft
+        K/V of the previous session's committed prefix. When the new
+        request radix-adopted a prefix that matches those resident ids,
+        the adopted span's draft-side re-prefill is pure replay — skip
+        it by advancing ``draft_cur`` past the match. The reuse window
+        is capped at the adopted span: only radix-adopted tokens were
+        ever drafted before, and the accept rule preserves the target
+        law for ANY draft state, so a conservative cap costs nothing in
+        correctness. ``PT_DRAFT_REUSE=0`` kills the seeding (fresh
+        re-feed, exactly the old behaviour)."""
+        p = self._pr(req)
+        adopted = int(getattr(req, "_adopted", 0))
+        self._adopted_span[slot] = min(adopted, len(p))
+        reuse = 0
+        if (adopted > 0 and self.exe.draft_model is not None
+                and os.environ.get("PT_DRAFT_REUSE", "1") != "0"):
+            res = self._draft_resident.get(slot)
+            if res is not None and len(res):
+                # cap below len(p): the steady feed needs >= 1 pending
+                # token so its last logit can seed the first proposal
+                m = min(len(res), adopted, len(p) - 1)
+                if m > 0:
+                    eq = np.asarray(res[:m]) == np.asarray(p[:m])
+                    reuse = int(m if eq.all() else np.argmin(eq))
+        self.draft_cur[slot] = reuse
+        if reuse:
+            GOODPUT.saved(reuse)
+            _SPEC_DRAFT_REUSE.inc(reuse)
+
     def _spec_draft(self, staged, seqs):
         """Draft phase: catch each staged slot's draft cache up to its
         committed frontier (chunked, for freshly admitted/replayed slots
@@ -978,6 +1026,10 @@ class LLMEngine:
                 ids[s, :n] = seqs[s][dc: dc + n]
                 cl[s] = n
                 rp[s] = dc
+                # re-embedding inside the radix-adopted span is pure
+                # replay (first-time prompt embedding is not waste)
+                GOODPUT.waste("replay_prefill",
+                              min(dc + n, int(self._adopted_span[s])) - dc)
             self.exe.draft_rows(ids, rp, cl)
             for s, _, _ in staged:
                 self.draft_cur[s] += int(cl[s])
@@ -993,6 +1045,9 @@ class LLMEngine:
             ids[s, :len(pend)] = pend
             cl[s] = len(pend)
             rp[s] = dc
+            GOODPUT.waste("replay_prefill",
+                          min(dc + len(pend),
+                              int(self._adopted_span[s])) - dc)
         dl = self.exe.draft_rows(ids, rp, cl)
         for s, _, _ in staged:
             self.draft_cur[s] += int(cl[s])      # == cur + 1 now
@@ -1124,6 +1179,10 @@ class LLMEngine:
             for slot, _, _ in staged:
                 self.draft_cur[slot] = min(int(self.draft_cur[slot]),
                                            int(self.cur[slot]) + 1)
+                # the rolled-back frontier still covers the committed
+                # prefix: keep the resident snapshot coherent for reuse
+                self._draft_resident[slot] = np.asarray(
+                    seqs[slot][:int(self.draft_cur[slot])], np.int32)
                 # staging extended the HOST table, but only the verify jit
                 # would have installed those entries in the DEVICE row —
                 # roll table_len back to what the device actually covers
@@ -1166,6 +1225,13 @@ class LLMEngine:
             # draft frontier rolls back past rejected positions (stale
             # entries are overwritten by the next round's feed)
             self.draft_cur[slot] = min(int(self.draft_cur[slot]), cur1)
+            # snapshot the token ids the draft cache now holds at
+            # 0..draft_cur-1 — the reuse seed for this slot's NEXT
+            # session (rows 0..draft_cur-1 always hold the committed
+            # prefix after the rollback above)
+            self._draft_resident[slot] = np.asarray(
+                np.concatenate([seqs[slot], np.asarray(new, np.int32)])
+                [:int(self.draft_cur[slot])], np.int32)
             if self.spec_adaptive:
                 self._acc_ema[slot] = (0.5 * self._acc_ema[slot]
                                        + 0.5 * (n_acc / k_eff))

@@ -174,6 +174,7 @@ class Scheduler:
                 break                      # FCFS: do not starve the head
             self.queue.popleft()
             req._match_memo = None
+            req._adopted = ct if k == 1 else 0
             _ADMITTED.inc()
             if req._submit_t is not None:
                 _QUEUE_WAIT.observe(max(0.0, self.clock() - req._submit_t))

@@ -65,6 +65,11 @@ _SPEC_TOKENS = METRICS.histogram(
     "serving_spec_tokens_per_tick",
     "tokens committed per slot per speculative tick",
     buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
+_SPEC_DRAFT_REUSE = METRICS.counter(
+    "serving_spec_draft_reuse_tokens_total",
+    "draft-cache positions adopted from a slot's resident draft K/V at "
+    "activation (radix prefix hits whose draft-side re-prefill was "
+    "skipped entirely)")
 # prefix cache: cumulative adopt/evict counts exported from the block
 # manager's cache_stats (deltas pushed each gauge refresh), plus the
 # lifetime hit rate (blocks adopted / blocks prefill would have written)

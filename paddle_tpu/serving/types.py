@@ -66,6 +66,10 @@ class Request:
     # A queued request is re-probed only when the manager's epoch moved
     # (eviction/commit) or its effective prompt changed (resume)
     _match_memo: tuple = None
+    # token span adopted from the radix prefix cache at admission (ISSUE
+    # 11): the spec-decode draft seed uses it to skip re-embedding the
+    # adopted prefix when the draft cache still holds those tokens
+    _adopted: int = 0
     # request tracker (ISSUE 9): trace_id is minted at first submit while
     # tracking is enabled (None = untracked, every tracker call no-ops);
     # trace_summary is the finished timeline summary, same dict /requests

@@ -1,0 +1,275 @@
+"""Fused ragged chunk attention (ISSUE 11): interpret-Pallas vs XLA
+gather parity over GQA/MHA, mid-block offsets, degenerate chunk_lens,
+sliding windows, and OOB-sentinel table slots; the cached per-process
+Pallas fallback (counter + single warning, no silent per-call retry);
+the PT_PAGED_CHUNK kill switch actually changing the traced path only
+through ``clear_jit_caches``; and engine-level greedy identity with the
+kernel on, off, and interpreted — incl. spec decode, chunked prefill,
+and preempt-replay."""
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.paged import clear_jit_caches
+from paddle_tpu.ops.pallas import paged_attention as pa
+from paddle_tpu.serving import LLMEngine, Request
+from paddle_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _fresh_jits():
+    # PT_PAGED_CHUNK is read at trace time: tests that flip it must not
+    # inherit (or leak) traced programs keyed on another test's mode
+    clear_jit_caches()
+    yield
+    clear_jit_caches()
+
+
+# ------------------------------------------------------------ parity
+
+def _ragged_case(rng, a, c, h, h_kv, d, bs, mb, n, offs, cls):
+    """Pool with garbage everywhere, distinct permuted live blocks per
+    row, sentinel (= n) padding on unused table slots."""
+    q = jnp.asarray(rng.normal(size=(a, c, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n, bs, h_kv, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n, bs, h_kv, d)), jnp.float32)
+    tables = np.full((a, mb), n, np.int32)
+    offs = np.asarray(offs, np.int32)
+    cls = np.asarray(cls, np.int32)
+    for i in range(a):
+        need = -(-int(offs[i] + cls[i]) // bs)
+        tables[i, :need] = rng.choice(n, size=need, replace=False)
+    return q, kp, vp, jnp.asarray(tables), offs, cls
+
+
+def _assert_live_parity(out_p, out_x, cls, tol=2e-5):
+    # dead rows diverge by design (kernel emits 0, the dense path a
+    # uniform average over fully-masked logits) — compare live rows only
+    for i, cl in enumerate(np.asarray(cls)):
+        cl = int(cl)
+        if cl == 0:
+            assert np.allclose(np.asarray(out_p)[i], 0.0)
+            continue
+        err = np.abs(np.asarray(out_p)[i, :cl]
+                     - np.asarray(out_x)[i, :cl]).max()
+        assert err < tol, f"row {i}: {err}"
+
+
+@pytest.mark.parametrize("h,h_kv", [(8, 2), (4, 4)])
+def test_chunk_parity_ragged(h, h_kv):
+    """GQA and MHA over mid-block offsets with chunk_lens 0 and 1."""
+    rng = np.random.default_rng(0)
+    case = _ragged_case(rng, 4, 6, h, h_kv, 16, 8, 6, 32,
+                        offs=[0, 5, 13, 3], cls=[6, 1, 0, 4])
+    q, kp, vp, tables, offs, cls = case
+    out_p = pa.paged_chunk_attention_pallas(q, kp, vp, tables, offs, cls,
+                                            interpret=True)
+    out_x = pa.paged_chunk_attention_xla(q, kp, vp, tables, offs, cls)
+    _assert_live_parity(out_p, out_x, cls)
+
+
+def test_chunk_parity_sliding_window():
+    rng = np.random.default_rng(1)
+    q, kp, vp, tables, offs, cls = _ragged_case(
+        rng, 3, 7, 8, 4, 16, 8, 8, 40, offs=[20, 0, 37], cls=[7, 7, 5])
+    out_p = pa.paged_chunk_attention_pallas(q, kp, vp, tables, offs, cls,
+                                            window=10, interpret=True)
+    out_x = pa.paged_chunk_attention_xla(q, kp, vp, tables, offs, cls,
+                                         window=10)
+    _assert_live_parity(out_p, out_x, cls)
+
+
+def test_chunk_parity_multi_tile_with_padding():
+    """cg = 13*3 = 39 folded rows at q_tile=16 → a 3-tile grid with 9
+    padding rows in the last tile."""
+    rng = np.random.default_rng(2)
+    q, kp, vp, tables, offs, cls = _ragged_case(
+        rng, 2, 13, 6, 2, 16, 8, 9, 40, offs=[7, 22], cls=[13, 9])
+    out_p = pa.paged_chunk_attention_pallas(q, kp, vp, tables, offs, cls,
+                                            q_tile=16, interpret=True)
+    out_x = pa.paged_chunk_attention_xla(q, kp, vp, tables, offs, cls)
+    _assert_live_parity(out_p, out_x, cls)
+
+
+def test_chunk_parity_verify_shape():
+    """The spec-verify batch shape: C = k+1 queries appended at a deep
+    offset, every row a different live length."""
+    rng = np.random.default_rng(3)
+    q, kp, vp, tables, offs, cls = _ragged_case(
+        rng, 4, 5, 8, 2, 32, 8, 10, 48, offs=[17, 40, 0, 63],
+        cls=[5, 5, 5, 5])
+    out_p = pa.paged_chunk_attention_pallas(q, kp, vp, tables, offs, cls,
+                                            interpret=True)
+    out_x = pa.paged_chunk_attention_xla(q, kp, vp, tables, offs, cls)
+    _assert_live_parity(out_p, out_x, cls)
+
+
+# ----------------------------------------------- dispatch + fallback
+
+def test_dispatch_kill_switch_forces_xla(monkeypatch):
+    """PT_PAGED_CHUNK=0 must route to the gather path and leave a
+    breadcrumb, never touching the Pallas wrapper."""
+    monkeypatch.setenv("PT_PAGED_CHUNK", "0")
+    monkeypatch.setattr(pa, "paged_chunk_attention_pallas",
+                        lambda *a, **k: pytest.fail("pallas path taken"))
+    rng = np.random.default_rng(4)
+    q, kp, vp, tables, offs, cls = _ragged_case(
+        rng, 2, 4, 4, 2, 16, 8, 4, 16, offs=[0, 9], cls=[4, 3])
+    pa._trace_events.clear()
+    out = pa.paged_chunk_attention(q, kp, vp, tables, offs, cls)
+    ref = pa.paged_chunk_attention_xla(q, kp, vp, tables, offs, cls)
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+    assert "chunk:xla-forced" in pa._trace_events
+
+
+def test_dispatch_interpret_mode(monkeypatch):
+    monkeypatch.setenv("PT_PAGED_CHUNK", "interpret")
+    rng = np.random.default_rng(5)
+    q, kp, vp, tables, offs, cls = _ragged_case(
+        rng, 2, 4, 4, 2, 16, 8, 4, 16, offs=[0, 9], cls=[4, 3])
+    pa._trace_events.clear()
+    out = pa.paged_chunk_attention(q, kp, vp, tables, offs, cls)
+    ref = pa.paged_chunk_attention_xla(q, kp, vp, tables, offs, cls)
+    _assert_live_parity(out, ref, cls)
+    assert "chunk:pallas-interpret" in pa._trace_events
+
+
+@pytest.mark.parametrize("kernel", ["decode", "chunk"])
+def test_pallas_failure_cached_per_process(monkeypatch, kernel):
+    """A Pallas trace failure must warn ONCE, bump the fallback counter,
+    and pin the process to the XLA path — no silent per-call retry."""
+    monkeypatch.setattr(pa, "_pallas_disabled", {})
+    monkeypatch.setattr(pa.jax, "default_backend", lambda: "tpu")
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("mosaic says no")
+
+    rng = np.random.default_rng(6)
+    if kernel == "chunk":
+        monkeypatch.setattr(pa, "paged_chunk_attention_pallas", boom)
+        q, kp, vp, tables, offs, cls = _ragged_case(
+            rng, 2, 4, 4, 2, 16, 8, 4, 16, offs=[0, 9], cls=[4, 3])
+        call = lambda: pa.paged_chunk_attention(q, kp, vp, tables, offs,
+                                                cls)
+        ref = pa.paged_chunk_attention_xla(q, kp, vp, tables, offs, cls)
+    else:
+        monkeypatch.setattr(pa, "paged_decode_attention_pallas", boom)
+        q = jnp.asarray(rng.normal(size=(2, 4, 16)), jnp.float32)
+        kp = jnp.asarray(rng.normal(size=(16, 8, 2, 16)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(16, 8, 2, 16)), jnp.float32)
+        tables = jnp.asarray([[0, 1, 16, 16], [2, 3, 16, 16]], jnp.int32)
+        lens = jnp.asarray([10, 13], jnp.int32)
+        call = lambda: pa.paged_decode_attention(q, kp, vp, tables, lens)
+        ref = pa.paged_decode_attention_xla(q, kp, vp, tables, lens)
+
+    c0 = pa._PALLAS_FALLBACK.value(kernel=kernel)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out1 = call()
+        out2 = call()
+    assert len(calls) == 1, "fallback decision not cached"
+    assert kernel in pa._pallas_disabled
+    assert pa._PALLAS_FALLBACK.value(kernel=kernel) == c0 + 1
+    warned = [x for x in w if "Pallas kernel failed" in str(x.message)]
+    assert len(warned) == 1
+    for out in (out1, out2):
+        assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+# --------------------------------------- traced-path flip via jit cache
+
+def _eng_kw(**kw):
+    base = dict(num_slots=4, block_size=8, max_prompt_len=8,
+                max_seq_len=64)
+    base.update(kw)
+    return base
+
+
+def _run(eng, prompts, max_new=8, **kw):
+    for p in prompts:
+        eng.add_request(Request(p, max_new_tokens=max_new, **kw))
+    return {r: list(map(int, t)) for r, t in eng.run().items()}
+
+
+def _prompts(n, rs, lo=12, hi=24):
+    # longer than max_prompt_len=8: every prompt takes the chunk program
+    return [rs.randint(0, 64, (int(l),))
+            for l in rs.randint(lo, hi, size=n)]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+def test_env_flip_needs_clear_jit_caches(model, monkeypatch):
+    """PT_PAGED_CHUNK is read when the chunk program TRACES: flipping it
+    mid-process changes nothing until ``clear_jit_caches`` drops the
+    traced programs, after which the new mode's path is taken."""
+    rs = np.random.RandomState(7)
+    prompts = _prompts(2, rs)
+    pa._trace_events.clear()
+    _run(LLMEngine(model, **_eng_kw()), prompts)
+    assert "chunk:xla" in pa._trace_events          # CPU default path
+
+    monkeypatch.setenv("PT_PAGED_CHUNK", "interpret")
+    pa._trace_events.clear()
+    _run(LLMEngine(model, **_eng_kw()), prompts)
+    # same shapes -> jit cache hit -> the dispatch never re-ran
+    assert "chunk:pallas-interpret" not in pa._trace_events
+
+    clear_jit_caches()
+    pa._trace_events.clear()
+    _run(LLMEngine(model, **_eng_kw()), prompts)
+    assert "chunk:pallas-interpret" in pa._trace_events
+
+
+# --------------------------------------------- engine greedy identity
+
+@pytest.mark.parametrize("mode", ["0", "interpret"])
+def test_engine_identity_chunked_prefill(model, monkeypatch, mode):
+    rs = np.random.RandomState(8)
+    prompts = _prompts(5, rs)
+    base = _run(LLMEngine(model, **_eng_kw()), prompts)
+    monkeypatch.setenv("PT_PAGED_CHUNK", mode)
+    clear_jit_caches()
+    assert _run(LLMEngine(model, **_eng_kw()), prompts) == base
+
+
+@pytest.mark.parametrize("mode", ["0", "interpret"])
+def test_engine_identity_spec_decode(model, monkeypatch, mode):
+    """Spec verify rides the same chunk program — identity must hold
+    with a draft in the loop (draft == target: the all-accept extreme)."""
+    rs = np.random.RandomState(9)
+    prompts = _prompts(4, rs)
+    kw = _eng_kw(draft_model=model)
+    base = _run(LLMEngine(model, **kw), prompts)
+    monkeypatch.setenv("PT_PAGED_CHUNK", mode)
+    clear_jit_caches()
+    assert _run(LLMEngine(model, **kw), prompts) == base
+
+
+def test_engine_identity_preempt_replay_interpret(model, monkeypatch):
+    """Interpreted kernel under preemption chaos: replay re-prefills
+    through the chunk program and must still match the baseline."""
+    rs = np.random.RandomState(10)
+    prompts = _prompts(4, rs, lo=10, hi=18)
+    kw = _eng_kw(num_blocks=24, preemption=True)
+    base = _run(LLMEngine(model, **kw), prompts)
+    monkeypatch.setenv("PT_PAGED_CHUNK", "interpret")
+    clear_jit_caches()
+    FAULTS.install("serving.preempt", every=3, times=4,
+                   action=lambda ctx: ctx["engine"]._preempt())
+    try:
+        out = _run(LLMEngine(model, **kw), prompts)
+    finally:
+        FAULTS.clear()
+    assert out == base
