@@ -17,6 +17,7 @@ so a dead tunnel degrades to a CPU smoke number instead of rc=1.
 """
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 import subprocess
@@ -26,6 +27,40 @@ import time
 # The peak-FLOPs table lives in paddle_tpu.observability.flops (one copy
 # shared with the Trainer and StepTimer); the worker imports it inside
 # main() — the orchestrator process must stay jax-and-paddle_tpu-free.
+
+
+def _load_perfledger():
+    """Load observability/perfledger.py BY FILE PATH — never through the
+    package (the orchestrator must not import paddle_tpu/jax; the
+    ledger module is pure stdlib by contract)."""
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    path = os.path.join(here, "paddle_tpu", "observability", "perfledger.py")
+    spec = importlib.util.spec_from_file_location("_pt_perfledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ledger_append(result):
+    """Append this run's result line to BENCH_HISTORY.jsonl (ISSUE 12) —
+    best-effort, the bench contract (one JSON line, rc 0) wins over the
+    ledger on any error."""
+    try:
+        here = os.path.dirname(os.path.abspath(__file__)) or "."
+        _load_perfledger().append_history(result, here)
+    except Exception as e:  # noqa: BLE001 — the ledger must never fail a run
+        print(f"bench: ledger append failed: {e!r}", file=sys.stderr)
+
+
+def ledger_check_main() -> int:
+    """``python bench.py --ledger-check``: the CI regression gate — parse
+    the BENCH_r*.json history next to this file and exit nonzero when
+    the newest round regresses a leg past the threshold (pass-through
+    flags: ``--threshold``, ``--json``, ``--dir``)."""
+    argv = [a for a in sys.argv[1:] if a != "--ledger-check"]
+    if not any(a.startswith("--dir") for a in argv):
+        argv += ["--dir", os.path.dirname(os.path.abspath(__file__)) or "."]
+    return _load_perfledger().main(argv + ["--check"])
 
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
 WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT", "1800"))
@@ -173,6 +208,7 @@ def orchestrate():
         result = _run_worker(inherited)
         if result is not None:
             print(json.dumps(result))
+            _ledger_append(result)
             return
         reason = "worker failed/timed out under live tpu backend; clean-env cpu smoke"
         print("bench: worker failed under live backend; falling back to "
@@ -198,6 +234,7 @@ def orchestrate():
             m.setdefault("counters", {}).update(cpu_legs.pop("counters", {}))
             m.update(cpu_legs)
         print(json.dumps(harvested))
+        _ledger_append(harvested)
         return
     result = _run_worker(dict(CLEAN_ENV), timeout=WORKER_TIMEOUT_S)
     if result is not None:
@@ -206,16 +243,19 @@ def orchestrate():
         if isinstance(extra, dict):
             extra["degraded_reason"] = reason
         print(json.dumps(result))
+        _ledger_append(result)
         return
     # absolute last resort: still one JSON line, rc 0
-    print(json.dumps({
+    last_resort = {
         "metric": "llama train step tokens/sec/chip",
         "value": 0.0,
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "degraded": True,
         "extra": {"degraded_reason": reason + "; and clean-env cpu worker failed"},
-    }))
+    }
+    print(json.dumps(last_resort))
+    _ledger_append(last_resort)
 
 
 def _timeit(step_fn, sync, iters):
@@ -1288,6 +1328,8 @@ if __name__ == "__main__":
         main()
     elif "--cpu-legs" in sys.argv:
         cpu_legs_main()
+    elif "--ledger-check" in sys.argv:
+        sys.exit(ledger_check_main())
     else:
         try:
             orchestrate()

@@ -54,6 +54,10 @@ from paddle_tpu.observability.tracing import (TRACER, Tracer, span, instant,
                                               export_chrome_trace)
 from paddle_tpu.observability.flops import (PEAK_BF16, chip_peak_flops, mfu,
                                             record_throughput)
+from paddle_tpu.observability.roofline import (PEAK_HBM_BPS, ModelGeometry,
+                                               chip_peak_hbm_bw,
+                                               record_serving_throughput,
+                                               serving_roofline_report)
 from paddle_tpu.observability.httpd import (MetricsServer,
                                             start_metrics_server,
                                             stop_metrics_server)
@@ -73,6 +77,8 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "TRACER", "Tracer", "span", "instant", "export_chrome_trace",
     "PEAK_BF16", "chip_peak_flops", "mfu", "record_throughput",
+    "PEAK_HBM_BPS", "ModelGeometry", "chip_peak_hbm_bw",
+    "record_serving_throughput", "serving_roofline_report",
     "MetricsServer", "start_metrics_server", "stop_metrics_server",
     "FLIGHT", "FlightRecorder",
     "InstrumentedJit", "instrumented_jit",

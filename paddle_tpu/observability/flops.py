@@ -31,16 +31,24 @@ def chip_peak_flops(dev=None, kind: str = None) -> float:
     """Peak bf16 FLOP/s for a jax device (or an explicit ``device_kind``
     string). Unknown TPU kinds assume v5e-class; non-TPU backends (cpu
     debugging runs) return 0.0 — callers treat 0 peak as "MFU undefined"
-    rather than dividing by a made-up number."""
+    rather than dividing by a made-up number. An EMPTY kind earns the
+    v5e assumption only when the platform says ``tpu``: a mock/unknown
+    device with neither attribute must read 0.0, not a fabricated peak
+    (ISSUE 12 satellite)."""
+    platform = None
     if kind is None:
         kind = getattr(dev, "device_kind", "") or ""
-        platform = getattr(dev, "platform", "")
+        platform = getattr(dev, "platform", "") or ""
         if platform and platform != "tpu":
             return 0.0
     for k, v in PEAK_BF16.items():
         if kind.startswith(k) or k in kind:
             return v
-    return 197e12 if "TPU" in kind.upper() or kind == "" else 0.0
+    if "TPU" in kind.upper():
+        return 197e12          # some TPU, kind string unrecognised
+    if kind == "" and platform == "tpu":
+        return 197e12          # TPU platform, no kind string exposed
+    return 0.0
 
 
 def mfu(tokens_per_sec: float, flops_per_token: float,

@@ -28,7 +28,8 @@ from paddle_tpu.observability.metrics import METRICS, Histogram
 
 __all__ = ["HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
            "counter_value", "gauge_value", "counter_ratio", "counter_share",
-           "gauge_imbalance", "histogram_quantile", "histogram_sum_ratio"]
+           "gauge_imbalance", "gauge_deficit", "histogram_quantile",
+           "histogram_sum_ratio"]
 
 _ORDER = {"OK": 0, "WARN": 1, "CRIT": 2}
 
@@ -89,15 +90,35 @@ def gauge_imbalance(name: str, registry=None) -> Callable[[], float]:
     return get
 
 
-def histogram_quantile(name: str, q: float,
-                       registry=None) -> Callable[[], float]:
-    """q-quantile of an unlabeled histogram; NaN while empty/absent."""
+def histogram_quantile(name: str, q: float, registry=None,
+                       **labels) -> Callable[[], float]:
+    """q-quantile of a histogram series (label kwargs select the series
+    of a labeled histogram, e.g. ``phase="host"``); NaN while
+    empty/absent."""
     def get():
         reg = registry if registry is not None else METRICS
         h = reg.get(name)
         if not isinstance(h, Histogram):
             return float("nan")
-        return h.quantile(q)
+        return h.quantile(q, **labels)
+    return get
+
+
+def gauge_deficit(name: str, registry=None, **labels) -> Callable[[], float]:
+    """1 - gauge value — a greater-is-worse view of a utilisation gauge
+    (MBU, goodput ratio). NaN while the series is absent OR reads <= 0:
+    by this repo's convention a utilisation of 0.0 means "undefined"
+    (unknown peak, e.g. CPU), and undefined is not an incident."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        inst = reg.get(name)
+        if inst is None:
+            return float("nan")
+        try:
+            v = float(inst.value(**labels))
+        except Exception:
+            return float("nan")
+        return 1.0 - v if v > 0.0 else float("nan")
     return get
 
 
@@ -220,6 +241,21 @@ def install_default_rules(ev: HealthEvaluator,
             description="wasted device tokens / all accounted device "
                         "tokens (goodput ledger): spec rejects, replay "
                         "re-prefill, padding rows, capacity drops")
+    ev.rule("serving_decode_mbu_collapse",
+            gauge_deficit("serving_mbu", registry, phase="decode"),
+            warn=0.95, crit=0.99,
+            description="1 - serving_mbu{decode}: decode is bandwidth-"
+                        "bound at continuous-batching sizes, so MBU "
+                        "below ~5% on real hardware means the tick is "
+                        "nowhere near the HBM roof (skipped while MBU "
+                        "reads 0.0 = undefined, e.g. off-TPU)")
+    ev.rule("serving_tick_host_p95_s",
+            histogram_quantile("serving_tick_breakdown_seconds", 0.95,
+                               registry, phase="host"),
+            warn=0.25, crit=2.5,
+            description="p95 host-bookkeeping share of an engine tick "
+                        "(the tick-anatomy remainder after prefill/"
+                        "draft/verify/sample device phases)")
     return ev
 
 

@@ -10,6 +10,12 @@ process-global registry the way a Prometheus scraper expects:
   * ``GET /flight``        → the flight recorder's current ring as JSON
   * ``GET /requests``      → the request tracker's recent per-request
     timelines + summaries (ISSUE 9); empty lists while tracking is off
+  * ``GET /roofline``      → the serving roofline ledger's per-phase
+    MFU/MBU/intensity reports + the machine roofs (ISSUE 12)
+  * ``GET /profile?seconds=N`` → run ONE ``jax.profiler`` trace capture
+    of N seconds (0 < N <= 600) into ``PT_PROFILE_DIR`` (default
+    ``pt_profile``); 400 on a missing/bad ``seconds``, 409 while a
+    capture is already running — at most one capture at a time
   * anything else          → 404
 
 Usage::
@@ -27,15 +33,37 @@ two lines and not hold a handle. The serving thread is named
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 from paddle_tpu.observability.metrics import METRICS
 
 __all__ = ["MetricsServer", "start_metrics_server", "stop_metrics_server"]
 
 _PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# one device-profiler capture at a time, process-wide: concurrent
+# start_trace calls would corrupt each other's TraceMe nesting
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_MAX_SECONDS = 600.0
+
+
+def _run_profile_capture(seconds: float) -> dict:
+    """One guarded ``jax.profiler`` capture into ``PT_PROFILE_DIR``.
+    jax imports lazily — the metrics server itself must stay usable in
+    processes that never touch a device."""
+    out_dir = os.environ.get("PT_PROFILE_DIR", "pt_profile")
+    import jax
+    jax.profiler.start_trace(out_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+    return {"dir": out_dir, "seconds": seconds}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -68,10 +96,45 @@ class _Handler(BaseHTTPRequestHandler):
             body = (json.dumps(REQUESTS.to_doc(), sort_keys=True)
                     + "\n").encode()
             ctype = "application/json"
+        elif path == "/roofline":
+            from paddle_tpu.observability.roofline import (
+                serving_roofline_report)
+            body = (json.dumps(serving_roofline_report(), sort_keys=True)
+                    + "\n").encode()
+            ctype = "application/json"
+        elif path == "/profile":
+            qs = parse_qs(self.path.partition("?")[2])
+            raw = qs.get("seconds", [None])[0]
+            try:
+                seconds = float(raw)
+            except (TypeError, ValueError):
+                self.send_error(
+                    400, "need /profile?seconds=N with numeric N")
+                return
+            if not 0.0 < seconds <= _PROFILE_MAX_SECONDS:
+                self.send_error(
+                    400, f"seconds must be in (0, "
+                         f"{_PROFILE_MAX_SECONDS:.0f}], got {raw}")
+                return
+            if not _PROFILE_LOCK.acquire(blocking=False):
+                self.send_error(
+                    409, "a profiler capture is already running")
+                return
+            try:
+                doc = _run_profile_capture(seconds)
+            except Exception as e:     # noqa: BLE001 — report, don't die
+                self.send_error(
+                    500, f"profiler capture failed: "
+                         f"{type(e).__name__}: {e}")
+                return
+            finally:
+                _PROFILE_LOCK.release()
+            body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+            ctype = "application/json"
         else:
             self.send_error(
-                404, "try /metrics, /metrics.json, /healthz, /flight "
-                     "or /requests")
+                404, "try /metrics, /metrics.json, /healthz, /flight, "
+                     "/requests, /roofline or /profile?seconds=N")
             return
         self.send_response(status)
         self.send_header("Content-Type", ctype)

@@ -1,0 +1,297 @@
+"""Serving roofline ledger (ISSUE 12): per-phase FLOPs *and* bytes.
+
+``flops.py`` answers "how close to peak compute" — the right question
+for training, where every matmul is large. Serving is different: decode
+at continuous-batching sizes streams the whole weight set plus every
+cached KV position per emitted token, so it pins HBM long before the
+MXU, and MFU alone cannot say whether the decode tick is at hardware
+speed (Williams et al., "Roofline: An Insightful Visual Performance
+Model", CACM 2009). This module pairs the peak-FLOPs table with a peak
+HBM-bandwidth table and carries analytic per-phase FLOPs and bytes
+models, so every serving phase gets THREE numbers:
+
+  * ``serving_mfu{phase}``              — FLOPs/s vs the chip's bf16 peak
+  * ``serving_mbu{phase}``              — bytes/s vs the chip's HBM peak
+  * ``serving_arith_intensity{phase}``  — FLOPs/byte, placing the phase
+    left (bandwidth-bound) or right (compute-bound) of the machine
+    balance point
+
+Phases are the engine tick's anatomy: ``prefill`` (admission + chunked
+prefill forwards), ``decode`` (the fused one-token tick), ``spec_draft``
+(draft-model feeds), ``spec_verify`` (the batched (slots, k+1) target
+chunk). The engine accumulates per-phase seconds / tokens / weight
+passes / KV-read positions and folds them through
+:func:`record_serving_throughput` — the single choke point, mirroring
+``flops.record_throughput`` — at every gauge sweep.
+
+Conventions shared with ``flops.py``: import-light (nothing here may
+import jax or the ``paddle_tpu`` root — bench.py's orchestrator and the
+perfledger must be able to reason about rooflines off-device), and an
+unknown chip yields peak 0.0 → every utilisation gauge reads 0.0 =
+"undefined", never a fabricated number. ``PT_ROOFLINE_KIND`` overrides
+the detected device kind (e.g. ``PT_ROOFLINE_KIND="TPU v5e"``) for
+what-if analysis and for testing the TPU arithmetic on CPU.
+
+Bytes model scope: weights (every resident weight streamed once per
+jitted forward — all experts for MoE, the batch routes across them),
+KV reads (2 × kv_heads × head_dim per layer per attended position —
+GQA grouping shrinks this by heads/kv_heads; the engine counts decode
+positions block-rounded because the paged kernel reads whole blocks),
+KV writes (one position per token), and f32 logits. Activations are
+deliberately excluded — they are layer-local and VMEM-resident at
+serving batch sizes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, asdict
+
+from paddle_tpu.observability.metrics import METRICS
+from paddle_tpu.observability.flops import PEAK_BF16, chip_peak_flops
+
+__all__ = ["PEAK_HBM_BPS", "chip_peak_hbm_bw", "resolve_serving_peaks",
+           "ModelGeometry", "weight_bytes", "kv_bytes_per_position",
+           "phase_flops", "phase_bytes", "arith_intensity",
+           "roofline_verdict", "record_serving_throughput",
+           "serving_roofline_report", "reset_serving_roofline"]
+
+# Peak HBM bandwidth per chip, bytes/sec — the denominator of MBU, keyed
+# exactly like PEAK_BF16 so the two tables can never disagree about what
+# a "chip" is. (v5e 819 GB/s, v5p 2765 GB/s, v4 1228 GB/s, v6e 1640 GB/s.)
+PEAK_HBM_BPS = {
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v6": 1640e9,
+}
+
+assert set(PEAK_HBM_BPS) == set(PEAK_BF16), \
+    "PEAK_HBM_BPS and PEAK_BF16 must cover the same chips"
+
+
+def chip_peak_hbm_bw(dev=None, kind: str = None) -> float:
+    """Peak HBM bytes/sec for a jax device (or an explicit
+    ``device_kind`` string). Same convention as ``chip_peak_flops``:
+    unknown TPU kinds assume v5e-class, anything that is not known to be
+    a TPU returns 0.0 — callers treat 0 peak as "MBU undefined"."""
+    platform = None
+    if kind is None:
+        kind = getattr(dev, "device_kind", "") or ""
+        platform = getattr(dev, "platform", "") or ""
+        if platform and platform != "tpu":
+            return 0.0
+    for k, v in PEAK_HBM_BPS.items():
+        if kind.startswith(k) or k in kind:
+            return v
+    if "TPU" in kind.upper():
+        return PEAK_HBM_BPS["TPU v5e"]
+    if kind == "" and platform == "tpu":
+        return PEAK_HBM_BPS["TPU v5e"]
+    return 0.0
+
+
+def resolve_serving_peaks(dev=None) -> tuple:
+    """(peak_flops, peak_hbm_bps) for the serving roofline.
+    ``PT_ROOFLINE_KIND`` (a device-kind string, e.g. ``TPU v5e``)
+    overrides the detected device — what-if analysis, and the only way
+    to exercise the TPU arithmetic in a CPU test without fabricating
+    utilisation by default."""
+    kind = os.environ.get("PT_ROOFLINE_KIND")
+    if kind:
+        return chip_peak_flops(kind=kind), chip_peak_hbm_bw(kind=kind)
+    return chip_peak_flops(dev), chip_peak_hbm_bw(dev)
+
+
+@dataclass(frozen=True)
+class ModelGeometry:
+    """The shape facts the FLOPs/bytes models need — duck-typed off any
+    of the repo's LLM configs via :meth:`from_config`, never a live
+    model (so the roofline stays importable without jax)."""
+    num_layers: int
+    hidden: int
+    intermediate: int
+    vocab: int
+    heads: int
+    kv_heads: int
+    head_dim: int
+    dtype_bytes: int = 2          # bf16 weights and KV
+    num_experts: int = 0          # routed experts (0 = dense MLP)
+    experts_per_tok: int = 0
+
+    @classmethod
+    def from_config(cls, cfg, dtype_bytes: int = 2) -> "ModelGeometry":
+        h = int(cfg.hidden_size)
+        nh = int(cfg.num_attention_heads)
+        experts = int(getattr(cfg, "num_experts", 0)
+                      or getattr(cfg, "num_local_experts", 0) or 0)
+        per_tok = int(getattr(cfg, "num_experts_per_tok", 0)
+                      or getattr(cfg, "experts_per_tok", 0) or 0)
+        inter = int(getattr(cfg, "moe_intermediate_size", 0)
+                    or cfg.intermediate_size)
+        return cls(num_layers=int(cfg.num_hidden_layers), hidden=h,
+                   intermediate=inter, vocab=int(cfg.vocab_size), heads=nh,
+                   kv_heads=int(getattr(cfg, "num_key_value_heads", nh)),
+                   head_dim=h // nh, dtype_bytes=int(dtype_bytes),
+                   num_experts=experts, experts_per_tok=per_tok)
+
+    # ---- derived counts -------------------------------------------------
+    @property
+    def attn_params_per_layer(self) -> int:
+        """Fused qkv + output projection."""
+        return (self.hidden * (self.heads + 2 * self.kv_heads)
+                * self.head_dim + self.heads * self.head_dim * self.hidden)
+
+    @property
+    def mlp_params_per_expert(self) -> int:
+        """gate + up + down projections of one (dense or expert) MLP."""
+        return 3 * self.hidden * self.intermediate
+
+    @property
+    def activated_params(self) -> int:
+        """Weight parameters ONE token's forward multiplies against:
+        attention + experts_per_tok MLPs (all of the dense MLP) + head."""
+        e = self.experts_per_tok if self.num_experts else 1
+        return (self.num_layers * (self.attn_params_per_layer
+                                   + e * self.mlp_params_per_expert)
+                + self.hidden * self.vocab)
+
+    @property
+    def resident_params(self) -> int:
+        """Weight parameters a batched forward streams from HBM: every
+        expert is resident (the batch routes across all of them)."""
+        e = self.num_experts if self.num_experts else 1
+        return (self.num_layers * (self.attn_params_per_layer
+                                   + e * self.mlp_params_per_expert)
+                + self.hidden * self.vocab)
+
+
+def weight_bytes(geom: ModelGeometry) -> float:
+    """Bytes of weights one jitted forward reads from HBM."""
+    return float(geom.resident_params) * geom.dtype_bytes
+
+
+def kv_bytes_per_position(geom: ModelGeometry) -> float:
+    """K + V bytes of ONE cached position across all layers; GQA head
+    grouping makes this kv_heads/heads of the MHA figure."""
+    return float(geom.num_layers * 2 * geom.kv_heads * geom.head_dim
+                 * geom.dtype_bytes)
+
+
+def phase_flops(geom: ModelGeometry, tokens: float,
+                kv_read_positions: float) -> float:
+    """Forward FLOPs of a phase that computed ``tokens`` token positions
+    attending ``kv_read_positions`` (query, cached-position) pairs in
+    total: 2 × activated params per token (matmuls), plus the qk^T and
+    p·v terms — 4 × heads × head_dim FLOPs per attended pair per layer
+    (2 mult-adds). The attention term rides the PAIR count, so callers
+    describe causal prefill (Σ ctx per query) and single-query decode
+    (whole table per token) with the same argument."""
+    matmul = 2.0 * geom.activated_params * tokens
+    attn = 4.0 * geom.heads * geom.head_dim * kv_read_positions
+    return matmul + attn
+
+
+def phase_bytes(geom: ModelGeometry, *, tokens: float, weight_passes: float,
+                kv_read_positions: float) -> float:
+    """HBM bytes of a phase: weights once per jitted forward, KV reads
+    per attended (query, position) pair, one KV write per computed
+    token, and the f32 logits row per token."""
+    w = weight_passes * weight_bytes(geom)
+    kv_r = kv_read_positions * kv_bytes_per_position(geom)
+    kv_w = tokens * kv_bytes_per_position(geom)
+    logits = tokens * geom.vocab * 4.0
+    return w + kv_r + kv_w + logits
+
+
+def arith_intensity(flops: float, nbytes: float) -> float:
+    """FLOPs per HBM byte — the roofline x-axis."""
+    return flops / nbytes if nbytes else 0.0
+
+
+def roofline_verdict(intensity: float, peak_flops: float,
+                     peak_hbm_bps: float) -> str:
+    """Which roof the phase sits under: intensity below the machine
+    balance (peak_flops / peak_hbm) means the bandwidth roof caps it."""
+    if not peak_flops or not peak_hbm_bps:
+        return "undefined"
+    return ("compute-bound" if intensity >= peak_flops / peak_hbm_bps
+            else "bandwidth-bound")
+
+
+_MFU = METRICS.gauge(
+    "serving_mfu",
+    "per-phase model FLOPs utilisation vs the chip bf16 peak "
+    "(0.0 = undefined off-TPU)", labelnames=("phase",))
+_MBU = METRICS.gauge(
+    "serving_mbu",
+    "per-phase model bandwidth utilisation vs the chip HBM peak "
+    "(0.0 = undefined off-TPU)", labelnames=("phase",))
+_AI = METRICS.gauge(
+    "serving_arith_intensity",
+    "per-phase arithmetic intensity, FLOPs per HBM byte",
+    labelnames=("phase",))
+
+# last full report per phase, served verbatim at /roofline
+_REPORTS: dict = {}
+_REPORTS_LOCK = threading.Lock()
+
+
+def record_serving_throughput(phase: str, *, seconds: float, tokens: float,
+                              weight_passes: float, kv_read_positions: float,
+                              geom: ModelGeometry, peak_flops: float = 0.0,
+                              peak_hbm_bps: float = 0.0) -> dict:
+    """Single choke point for serving utilisation: fold one phase's
+    cumulative (seconds, tokens, weight passes, KV-read positions)
+    through the analytic models, set the three per-phase gauges, stash
+    the full report for ``/roofline``, and return it. Unknown peaks
+    (CPU, mock backends) keep MFU/MBU at 0.0 — undefined, never
+    fabricated — while intensity and the byte/FLOP tallies stay real."""
+    if seconds <= 0.0 or tokens <= 0:
+        return {}
+    fl = phase_flops(geom, tokens, kv_read_positions)
+    by = phase_bytes(geom, tokens=tokens, weight_passes=weight_passes,
+                     kv_read_positions=kv_read_positions)
+    ai = arith_intensity(fl, by)
+    mfu_v = fl / seconds / peak_flops if peak_flops else 0.0
+    mbu_v = by / seconds / peak_hbm_bps if peak_hbm_bps else 0.0
+    report = {
+        "phase": phase, "seconds": seconds, "tokens": tokens,
+        "weight_passes": weight_passes,
+        "kv_read_positions": kv_read_positions,
+        "flops": fl, "bytes": by,
+        "flops_per_sec": fl / seconds, "bytes_per_sec": by / seconds,
+        "arith_intensity": ai, "mfu": mfu_v, "mbu": mbu_v,
+        "bound": roofline_verdict(ai, peak_flops, peak_hbm_bps),
+        "geometry": asdict(geom),
+    }
+    _MFU.set(mfu_v, phase=phase)
+    _MBU.set(mbu_v, phase=phase)
+    _AI.set(ai, phase=phase)
+    with _REPORTS_LOCK:
+        _REPORTS[phase] = report
+        _REPORTS["_machine"] = {
+            "peak_flops": peak_flops, "peak_hbm_bps": peak_hbm_bps,
+            "balance_flops_per_byte": (peak_flops / peak_hbm_bps
+                                       if peak_hbm_bps else 0.0),
+        }
+    return report
+
+
+def serving_roofline_report() -> dict:
+    """The ``/roofline`` document: machine roofs + the last per-phase
+    reports the choke point recorded."""
+    with _REPORTS_LOCK:
+        machine = _REPORTS.get("_machine", {
+            "peak_flops": 0.0, "peak_hbm_bps": 0.0,
+            "balance_flops_per_byte": 0.0})
+        phases = {k: dict(v) for k, v in _REPORTS.items()
+                  if k != "_machine"}
+    return {"machine": machine, "phases": phases}
+
+
+def reset_serving_roofline():
+    """Drop every stashed phase report (test hygiene)."""
+    with _REPORTS_LOCK:
+        _REPORTS.clear()

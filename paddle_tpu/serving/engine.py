@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import os
 import time
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +51,9 @@ from paddle_tpu.observability import span as _span
 from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.observability.goodput import GOODPUT
 from paddle_tpu.observability.requests import REQUESTS
+from paddle_tpu.observability.roofline import (ModelGeometry,
+                                               record_serving_throughput,
+                                               resolve_serving_peaks)
 from paddle_tpu.serving.executor import ModelExecutor, _SAMPLE_ROWS_JIT  # noqa: F401  (re-exported)
 from paddle_tpu.serving.kv import KVManager
 from paddle_tpu.serving.scheduler import Scheduler
@@ -60,7 +64,8 @@ from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
                                           _SPEC_DRAFT_REUSE,
                                           _SPEC_FALLBACKS,
                                           _SPEC_PROPOSED, _SPEC_RATE,
-                                          _SPEC_TOKENS, _TICK, _TIMEOUTS,
+                                          _SPEC_TOKENS, _TICK,
+                                          _TICK_BREAKDOWN, _TIMEOUTS,
                                           _TOK_LAT, _TOKENS, _TTFT)
 from paddle_tpu.serving.transfer import (KVPayload, _GATHER_BLOCKS_JIT,
                                          _INSTALL_BLOCKS_JIT)
@@ -215,6 +220,31 @@ class LLMEngine:
                       "spec_accepted": 0, "spec_fallbacks": 0}
         self._adm_counter = 0                # admission recency, per slot
         self.adm_order = np.zeros(num_slots, np.int64)
+
+        # ---- roofline ledger (ISSUE 12): cumulative per-phase
+        # [seconds, tokens, weight passes, KV-read positions], folded
+        # into serving_mfu/mbu/arith_intensity at each gauge sweep.
+        # Peaks resolve once from device 0 (0.0 off-TPU → gauges read
+        # 0.0 = undefined; PT_ROOFLINE_KIND overrides for what-if).
+        # _tick_phase holds the CURRENT tick's wall-time split; step()
+        # folds it into the breakdown histogram and these accumulators.
+        def _geom(m):
+            try:
+                return ModelGeometry.from_config(
+                    m.cfg, dtype_bytes=jnp.dtype(m.cfg.dtype).itemsize)
+            except Exception:
+                return None      # adapter without a full config: no ledger
+        self._geom = _geom(model)
+        self._draft_geom = _geom(draft_model) if draft_model is not None \
+            else None
+        try:
+            dev0 = jax.devices()[0]
+        except Exception:
+            dev0 = None
+        self._peak_flops, self._peak_hbm = resolve_serving_peaks(dev0)
+        self._phase_acc = {p: [0.0, 0, 0, 0] for p in
+                           ("prefill", "decode", "spec_draft", "spec_verify")}
+        self._tick_phase: dict[str, float] = {}
 
     # ------------------------------------------- pre-split attribute surface
     # The monolithic serving.py exposed all of this directly on the
@@ -589,6 +619,9 @@ class LLMEngine:
             beams.append((g, grows, csrc, cdst))
         logits = self.exe.prefill(ids, lens, slots, rows)
         self._staged_admits = frozenset()   # scatter landed: evictable again
+        # roofline: one weight pass; prompts attend causally from offset 0
+        self._acc_phase("prefill", int(lens.sum()), 1,
+                        self._ctx_causal(lens, np.zeros_like(lens)))
         row_temps = np.zeros(a_cap, np.float32)
         row_tps = np.ones(a_cap, np.float32)
         for i, (slot, req) in enumerate(admits):
@@ -807,6 +840,10 @@ class LLMEngine:
         logits = self.exe.prefill_chunk(ids, lens, offs, slots, rows)
         # padded sentinel rows burned device FLOPs on no request's behalf
         GOODPUT.waste("pad_rows", (a_cap - len(staged)) * cap)
+        # roofline: one weight pass; each chunk attends its own tokens
+        # plus everything already consumed (its offset)
+        self._acc_phase("prefill", int(lens.sum()), 1,
+                        self._ctx_causal(lens, offs))
         emitted = []
         done_rows = []
         for i, (rid, (slot, consumed)) in enumerate(batch):
@@ -1031,6 +1068,8 @@ class LLMEngine:
                 GOODPUT.waste("replay_prefill",
                               min(dc + n, int(self._adopted_span[s])) - dc)
             self.exe.draft_rows(ids, rp, cl)
+            self._acc_phase("spec_draft", int(cl.sum()), 1,
+                            self._ctx_causal(cl, rp))
             for s, _, _ in staged:
                 self.draft_cur[s] += int(cl[s])
 
@@ -1049,6 +1088,8 @@ class LLMEngine:
                           min(dc + len(pend),
                               int(self._adopted_span[s])) - dc)
         dl = self.exe.draft_rows(ids, rp, cl)
+        self._acc_phase("spec_draft", int(cl.sum()), 1,
+                        self._ctx_causal(cl, rp))
         for s, _, _ in staged:
             self.draft_cur[s] += int(cl[s])      # == cur + 1 now
         dlast = jnp.take_along_axis(
@@ -1094,6 +1135,8 @@ class LLMEngine:
                 cl1[s] = 1
                 rp1[s] = int(self.draft_cur[s])
             dl1 = self.exe.draft_rows(ids1, rp1, cl1)
+            self._acc_phase("spec_draft", int(cl1.sum()), 1,
+                            self._ctx_causal(cl1, rp1))
             for s in feeding:
                 self.draft_cur[s] += 1           # == cur + r + 1
             pick_all(dl1[:, 0], feeding)
@@ -1141,7 +1184,8 @@ class LLMEngine:
             return handled, emitted
 
         seqs = {s: self._committed_seq(s) for s, _, _ in staged}
-        with _span("serving.draft", slots=len(staged)):
+        with self._tick_timer("draft"), \
+                _span("serving.draft", slots=len(staged)):
             props, qs = self._spec_draft(staged, seqs)
 
         # ---- verify: ONE batched target chunk over (slots, k_eff+1) ----
@@ -1192,12 +1236,17 @@ class LLMEngine:
                                          // self.block_size)
             return np.zeros(self.num_slots, bool), []
         t_dev = time.perf_counter()
-        with _span("serving.verify", slots=len(staged)):
+        with self._tick_timer("verify"), \
+                _span("serving.verify", slots=len(staged)):
             logits = np.asarray(self.exe.verify_chunk(
                 ids, clens, offs, slot_ids, rows).astype(jnp.float32))
         self.stats["device_s"] += time.perf_counter() - t_dev
         # whole sentinel rows of the fixed-shape verify batch are waste
         GOODPUT.waste("pad_rows", (ns - len(staged)) * C)
+        # roofline: one target weight pass; each verify row attends its
+        # k_eff+1 chunk tokens plus the committed context at its offset
+        self._acc_phase("spec_verify", int(clens.sum()), 1,
+                        self._ctx_causal(clens, offs))
 
         # ---- accept/commit per slot; ONE batched length rewind after ----
         rw_slots = np.full(ns, ns, np.int32)
@@ -1464,6 +1513,60 @@ class LLMEngine:
                        blocks=payload.n_blocks, cur=payload.cur)
         return True
 
+    # ------------------------------------------------- roofline anatomy
+    @contextmanager
+    def _tick_timer(self, name: str):
+        """Accumulate a named slice of the CURRENT tick's wall time
+        (same clock as the tick total, so the breakdown reconciles)."""
+        t = time.monotonic()
+        try:
+            yield
+        finally:
+            self._tick_phase[name] = (self._tick_phase.get(name, 0.0)
+                                      + time.monotonic() - t)
+
+    def _acc_phase(self, phase: str, tokens: int, passes: int, ctx: int):
+        """Add one forward's roofline counts to a phase's cumulative
+        [seconds, tokens, weight passes, KV-read positions] row (seconds
+        arrive separately, from the tick timer in ``step``)."""
+        row = self._phase_acc[phase]
+        row[1] += tokens
+        row[2] += passes
+        row[3] += ctx
+
+    def _ctx_blocks(self, mask) -> int:
+        """Σ block-rounded attended context over masked slots: the fused
+        decode kernel walks whole blocks of the table, so a single-query
+        tick reads ceil(len/block)·block positions per slot."""
+        lens = self.cur[mask] + 1
+        bs = self.block_size
+        return int((-(-lens // bs) * bs).sum())
+
+    @staticmethod
+    def _ctx_causal(lens, offs) -> int:
+        """Σ attended (query, position) pairs of a causal chunk batch:
+        a chunk of L tokens at offset O attends L·O + L(L+1)/2 pairs."""
+        ls = np.asarray(lens, np.int64)
+        os_ = np.asarray(offs, np.int64)
+        return int((ls * os_ + ls * (ls + 1) // 2).sum())
+
+    def _push_roofline(self):
+        """Fold the cumulative phase accumulators through the roofline
+        choke point (lifetime-average MFU/MBU per phase, same cumulative
+        convention as the spec acceptance-rate gauge)."""
+        if self._geom is None:
+            return
+        for phase, (sec, tok, passes, ctx) in self._phase_acc.items():
+            if sec <= 0.0 or tok <= 0:
+                continue
+            geom = self._draft_geom if phase == "spec_draft" else self._geom
+            if geom is None:
+                continue
+            record_serving_throughput(
+                phase, seconds=sec, tokens=tok, weight_passes=passes,
+                kv_read_positions=ctx, geom=geom,
+                peak_flops=self._peak_flops, peak_hbm_bps=self._peak_hbm)
+
     def _refresh_gauges(self):
         """Point-in-time engine state → gauges (queue depth, active
         slots, KV-pool utilization). Called after every tick and intake
@@ -1476,17 +1579,34 @@ class LLMEngine:
                      else 0.0)
         self.kv.push_prefix_metrics()
         GOODPUT.refresh_gauge()
+        self._push_roofline()
 
     def step(self):
         """One engine tick — see :meth:`_step_impl`. Wrapped here so the
         tick lands in the trace timeline and the tick-duration histogram
-        even when a chaos rule or a dry pool raises out of the middle."""
+        even when a chaos rule or a dry pool raises out of the middle.
+        The tick's anatomy (prefill/draft/verify/sample slices timed by
+        :meth:`_tick_timer`, host = the remainder) goes to the breakdown
+        histogram: all five phases observe every tick, so the five
+        observations sum to the tick's total by construction."""
         t0 = time.monotonic()
+        self._tick_phase = {}
         try:
             with _span("serving.step"):
                 return self._step_impl()
         finally:
-            _TICK.observe(time.monotonic() - t0)
+            total = time.monotonic() - t0
+            ph = self._tick_phase
+            timed = sum(ph.values())
+            for name in ("prefill", "draft", "verify", "sample"):
+                _TICK_BREAKDOWN.observe(ph.get(name, 0.0), phase=name)
+            _TICK_BREAKDOWN.observe(max(0.0, total - timed), phase="host")
+            _TICK.observe(total)
+            acc = self._phase_acc
+            acc["prefill"][0] += ph.get("prefill", 0.0)
+            acc["spec_draft"][0] += ph.get("draft", 0.0)
+            acc["spec_verify"][0] += ph.get("verify", 0.0)
+            acc["decode"][0] += ph.get("sample", 0.0)
             self._refresh_gauges()
 
     def _step_impl(self):
@@ -1505,9 +1625,10 @@ class LLMEngine:
         for rid in list(self.groups):
             emitted += self._beam_advance(rid, self.groups[rid])
         admits, beam_admits = self._admit()
-        if admits or beam_admits:
-            emitted += self._prefill(admits, beam_admits)
-        emitted += self._prefill_chunks()
+        with self._tick_timer("prefill"):
+            if admits or beam_admits:
+                emitted += self._prefill(admits, beam_admits)
+            emitted += self._prefill_chunks()
         if self.prefill_only:
             # prefill-role replica: newly activated slots carry their
             # first token; the router extracts them — never decode here
@@ -1543,12 +1664,17 @@ class LLMEngine:
         rows, cols, vals = self._grow_tables(run_mask & ~self.is_beam)
         # growth may have preempted slots — recompute the mask after it
         run_mask = self.active & ~spec_handled
+        # roofline: one weight pass over the batch; every running slot
+        # reads its whole block-rounded context and writes one position
+        self._acc_phase("decode", int(run_mask.sum()), 1,
+                        self._ctx_blocks(run_mask))
         t1 = time.perf_counter()
-        nxt, logp = self.exe.decode_tick(
-            self.last_tok, run_mask, rows, cols, vals, self.temps,
-            self.top_ps, bool(self.groups))
-        was_active = run_mask.copy()
-        nxt = np.asarray(nxt)                 # the one per-tick host fetch
+        with self._tick_timer("sample"):
+            nxt, logp = self.exe.decode_tick(
+                self.last_tok, run_mask, rows, cols, vals, self.temps,
+                self.top_ps, bool(self.groups))
+            was_active = run_mask.copy()
+            nxt = np.asarray(nxt)             # the one per-tick host fetch
         t2 = time.perf_counter()
         for g in self.groups.values():        # device-resident, lazy gather
             g.logp = logp[np.asarray(g.slots)]

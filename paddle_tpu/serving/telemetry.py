@@ -45,6 +45,18 @@ _QUEUE_WAIT = METRICS.histogram(
     "serving_queue_wait_seconds", "submission → admission (engine clock)")
 _TICK = METRICS.histogram(
     "serving_tick_seconds", "wall time of one engine tick")
+# decode-tick anatomy (ISSUE 12): every tick observes all five phases
+# (zero seconds included), so per phase count == tick count and the five
+# observations of a tick sum to that tick's serving_tick_seconds
+# observation by construction — host is defined as the remainder
+_TICK_BREAKDOWN = METRICS.histogram(
+    "serving_tick_breakdown_seconds",
+    "per-tick wall time by phase: prefill (admission + chunk forwards), "
+    "draft, verify, sample (the fused decode forward + token fetch), "
+    "host (everything else in the tick)",
+    labelnames=("phase",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
 _DRAIN = METRICS.histogram(
     "serving_drain_seconds", "wall time of graceful drain",
     buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))

@@ -1,0 +1,151 @@
+"""Metrics-server endpoint surface (ISSUE 12 satellite): the
+``/roofline`` report, the guarded ``/profile`` capture (400 on bad
+input, 409 while busy, one real capture into ``PT_PROFILE_DIR``), and
+the 404 catch-all that names every route."""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import paddle_tpu.observability.httpd as httpd
+from paddle_tpu.observability.httpd import MetricsServer
+from paddle_tpu.observability.roofline import (
+    ModelGeometry, record_serving_throughput, reset_serving_roofline)
+
+
+def _get(url, timeout=30):
+    """(status, body text) — error statuses arrive as HTTPError with the
+    same body."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture()
+def srv():
+    s = MetricsServer(port=0, host="127.0.0.1")
+    yield s
+    s.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_roofline():
+    reset_serving_roofline()
+    yield
+    reset_serving_roofline()
+
+
+def test_unknown_path_404_names_the_routes(srv):
+    status, body = _get(f"http://127.0.0.1:{srv.port}/nope")
+    assert status == 404
+    for route in ("/metrics", "/healthz", "/roofline", "/profile"):
+        assert route in body
+
+
+def test_roofline_endpoint_serves_the_ledger(srv):
+    base = f"http://127.0.0.1:{srv.port}"
+    status, body = _get(base + "/roofline")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc == {"machine": {"peak_flops": 0.0, "peak_hbm_bps": 0.0,
+                               "balance_flops_per_byte": 0.0},
+                   "phases": {}}                    # nothing recorded yet
+    g = ModelGeometry(num_layers=2, hidden=8, intermediate=16, vocab=32,
+                      heads=2, kv_heads=1, head_dim=4)
+    record_serving_throughput("decode", seconds=1.0, tokens=4,
+                              weight_passes=1, kv_read_positions=16,
+                              geom=g, peak_flops=197e12,
+                              peak_hbm_bps=819e9)
+    status, body = _get(base + "/roofline")
+    doc = json.loads(body)
+    assert status == 200
+    assert doc["machine"]["peak_hbm_bps"] == pytest.approx(819e9)
+    assert set(doc["phases"]) == {"decode"}
+    assert doc["phases"]["decode"]["bound"] == "bandwidth-bound"
+    assert doc["phases"]["decode"]["mbu"] > 0
+
+
+@pytest.mark.parametrize("query", [
+    "",                       # missing seconds entirely
+    "?seconds=",              # present but empty
+    "?seconds=abc",           # non-numeric
+    "?seconds=0",             # must be > 0
+    "?seconds=-3",
+    "?seconds=601",           # above the cap
+])
+def test_profile_bad_seconds_is_400(srv, query):
+    status, body = _get(f"http://127.0.0.1:{srv.port}/profile{query}")
+    assert status == 400, body
+
+
+def test_profile_second_capture_while_busy_is_409(srv, monkeypatch):
+    release = threading.Event()
+    started = threading.Event()
+
+    def fake_capture(seconds):
+        started.set()
+        assert release.wait(timeout=10)
+        return {"dir": "fake", "seconds": seconds}
+
+    monkeypatch.setattr(httpd, "_run_profile_capture", fake_capture)
+    base = f"http://127.0.0.1:{srv.port}"
+    first: dict = {}
+
+    def go():
+        first["resp"] = _get(base + "/profile?seconds=1")
+
+    t = threading.Thread(target=go, name="pt-test-profile")
+    t.start()
+    try:
+        assert started.wait(timeout=10)          # capture is in flight
+        status, body = _get(base + "/profile?seconds=1")
+        assert status == 409
+        assert "already running" in body
+    finally:
+        release.set()
+        t.join(timeout=10)
+    status, body = _get(base + "/profile?seconds=1")   # lock released
+    assert status == 200
+    assert json.loads(body)["dir"] == "fake"
+    assert first["resp"][0] == 200
+
+
+def test_profile_capture_failure_is_500_and_releases_lock(srv, monkeypatch):
+    def boom(seconds):
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(httpd, "_run_profile_capture", boom)
+    base = f"http://127.0.0.1:{srv.port}"
+    status, body = _get(base + "/profile?seconds=1")
+    assert status == 500
+    assert "RuntimeError" in body
+    monkeypatch.setattr(httpd, "_run_profile_capture",
+                        lambda s: {"dir": "ok", "seconds": s})
+    status, _ = _get(base + "/profile?seconds=1")
+    assert status == 200                          # the 500 path unlocked
+
+
+@pytest.mark.slow
+def test_profile_real_capture_writes_pt_profile_dir(srv, tmp_path,
+                                                    monkeypatch):
+    """One real (short) jax.profiler capture through the endpoint: 200,
+    the JSON names the dir, and trace artifacts land under it."""
+    out = tmp_path / "cap"
+    monkeypatch.setenv("PT_PROFILE_DIR", str(out))
+    t0 = time.monotonic()
+    # generous timeout: the first profiler start in a process initialises
+    # the backend trace machinery, which can dwarf the capture itself
+    status, body = _get(f"http://127.0.0.1:{srv.port}/profile?seconds=0.2",
+                        timeout=240)
+    assert status == 200, body
+    assert time.monotonic() - t0 >= 0.2           # it really slept
+    doc = json.loads(body)
+    assert doc == {"dir": str(out), "seconds": 0.2}
+    files = [f for _, _, fs in os.walk(out) for f in fs]
+    assert files, "capture wrote no trace artifacts"

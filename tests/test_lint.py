@@ -220,6 +220,42 @@ def test_metric_instruments_have_help_and_approved_prefix():
         + "\n".join(offenders))
 
 
+# ----------------------------------------------- metrics-reference coverage
+# The generated metrics reference (``python -m paddle_tpu.observability``)
+# renders whatever _INSTRUMENT_MODULES imports — a module that registers
+# instruments but is missing from that tuple silently drops its metrics
+# from the reference. Modules whose registrations are intentionally
+# off-reference go in the allowlist with a reason.
+_REFERENCE_ALLOWLIST = {
+    # e.g. "paddle_tpu/some/module.py": "registers per-test scratch names",
+}
+
+
+def test_instrument_registering_modules_are_in_the_reference():
+    from paddle_tpu.observability.__main__ import _INSTRUMENT_MODULES
+    root = pathlib.Path(__file__).resolve().parent.parent
+    pkg = root / "paddle_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        if rel in _REFERENCE_ALLOWLIST:
+            continue
+        if not re.search(r"\bMETRICS\.(counter|gauge|histogram)\s*\(",
+                         path.read_text()):
+            continue
+        mod = ".".join(path.relative_to(root).with_suffix("").parts)
+        if mod.endswith(".__init__"):
+            mod = mod[:-len(".__init__")]
+        if mod not in _INSTRUMENT_MODULES:
+            offenders.append(f"{rel}: registers instruments but {mod!r} "
+                             "is not in observability.__main__."
+                             "_INSTRUMENT_MODULES")
+    assert not offenders, (
+        "modules whose instruments the generated metrics reference would "
+        "silently omit (add them to _INSTRUMENT_MODULES or allowlist with "
+        "a reason):\n" + "\n".join(offenders))
+
+
 def test_pipeline_divergent_handoff_flagged():
     """A stage that only hands off inside one cond branch deadlocks —
     the lint catches it before it reaches hardware."""
