@@ -739,6 +739,13 @@ def bench_serving_spec():
         # (0 when every adopted span was fully resident)
         "draft_reuse_tokens": draft_reuse,
         "draft_reuse_replay_waste": reuse_replay,
+        # memory ledger (ISSUE 13): the quantized-KV baseline — peak HBM
+        # bytes per resident token and peak pool occupancy by state over
+        # the spec-on run
+        "kv_bytes_per_token": round(
+            eng_on.kv.ledger.peak_bytes_per_token, 1),
+        "kv_peak_blocks": {s: int(v) for s, v in
+                           sorted(eng_on.kv.ledger.peak_states.items())},
     }
 
 
@@ -1079,12 +1086,16 @@ def bench_serving_prefix():
             eng = mk()
             dt, out = run(eng, prompts, ttft)
             stats = eng.mgr.cache_stats
+            led = eng.kv.ledger
             results[label] = {
                 "rps": len(prompts) / dt,
                 "ttft_p50": float(np.percentile(list(ttft.values()), 50)),
                 "token_hit_rate": (stats.get("token_hits", 0)
                                    / max(stats.get("lookup_tokens", 0), 1)),
                 "out": {r: list(map(int, t)) for r, t in out.items()},
+                "kv_bytes_per_token": led.peak_bytes_per_token,
+                "kv_peak_blocks": {s: int(v) for s, v in
+                                   sorted(led.peak_states.items())},
             }
     finally:
         if saved is None:
@@ -1101,6 +1112,10 @@ def bench_serving_prefix():
         "ttft_p50_radix_s": round(radix["ttft_p50"], 4),
         "token_hit_rate_full_block": round(flat["token_hit_rate"], 4),
         "token_hit_rate_radix": round(radix["token_hit_rate"], 4),
+        # memory ledger (ISSUE 13): radix-leg peaks — the COW sharing
+        # shows up directly as fewer bytes per resident token
+        "kv_bytes_per_token": round(radix["kv_bytes_per_token"], 1),
+        "kv_peak_blocks": radix["kv_peak_blocks"],
         "overlap": 0.9, "prompt_len": 80, "block_size": 128,
     }
 

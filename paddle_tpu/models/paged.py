@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.observability.memledger import MemLedger
 from paddle_tpu.ops import attention as A
 from paddle_tpu.ops.pallas.paged_attention import (paged_chunk_attention,
                                                    paged_decode_attention)
@@ -79,6 +80,10 @@ class BlockManager:
         self._free = list(range(num_blocks - 1, -1, -1))
         self.tables: dict[int, list[int]] = {}
         self._prefix_done: dict[int, int] = {}  # free_prefix resume index
+        # per-pool memory ledger: every mutation choke point below
+        # notifies it (test_lint enforces the list), so the five-state
+        # block classification reconciles by construction
+        self.ledger = MemLedger(num_blocks, block_size)
 
     @property
     def free_blocks(self):
@@ -96,7 +101,9 @@ class BlockManager:
                 f"paged cache out of blocks: need {need}, "
                 f"free {self.free_blocks} (of {self.num_blocks})")
         for _ in range(max(need, 0)):
-            table.append(self._pop_free())
+            blk = self._pop_free()
+            table.append(blk)
+            self.ledger.table_enter(seq_id, blk)
         return table
 
     def _pop_free(self) -> int:
@@ -106,8 +113,12 @@ class BlockManager:
         return self._free.pop()
 
     def free(self, seq_id: int):
-        self._free.extend(b for b in reversed(self.tables.pop(seq_id, []))
-                          if b is not None)
+        for b in reversed(self.tables.pop(seq_id, [])):
+            if b is None:
+                continue
+            self.ledger.table_exit(seq_id, b)
+            self._free.append(b)
+        self.ledger.table_drop(seq_id)
         self._prefix_done.pop(seq_id, None)
 
     def free_prefix(self, seq_id: int, n_blocks: int):
@@ -125,6 +136,7 @@ class BlockManager:
         for idx in range(start, upto):
             if table[idx] is not None:
                 freed.append((idx, table[idx]))
+                self.ledger.table_exit(seq_id, table[idx], hole=True)
                 self._release(table[idx])
                 table[idx] = None
         if upto > start:
@@ -197,13 +209,18 @@ class RefBlockManager(BlockManager):
             copy = (table[-1], fresh)
             table[-1] = fresh
         self.tables[dst_id] = table
+        for blk in table:
+            if blk is not None:
+                self.ledger.table_enter(dst_id, blk)
         return copy
 
     def free(self, seq_id):
         for blk in self.tables.pop(seq_id, []):
             if blk is None:
                 continue
+            self.ledger.table_exit(seq_id, blk)
             self._release(blk)
+        self.ledger.table_drop(seq_id)
         self._prefix_done.pop(seq_id, None)
 
     def _release(self, blk):
@@ -271,6 +288,7 @@ class PrefixCachingBlockManager(RefBlockManager):
                 del self._hash_to_block[h]
             self.cache_stats["evictions"] += 1
             self.cache_epoch += 1
+            self.ledger.unpark(blk)
             return blk
         raise MemoryError("paged cache out of blocks")
 
@@ -281,12 +299,14 @@ class PrefixCachingBlockManager(RefBlockManager):
             if blk in self._block_hash:       # park, MRU end
                 self._evictable[blk] = None
                 self._evictable.move_to_end(blk)
+                self.ledger.park(blk)
             else:
                 self._free.append(blk)
 
     def _retain(self, blk):
         if blk in self._evictable:            # revive a parked block
             del self._evictable[blk]
+            self.ledger.unpark(blk)
         super()._retain(blk)
 
     # ------------------------------------------------------------ hashing
@@ -324,6 +344,8 @@ class PrefixCachingBlockManager(RefBlockManager):
         for blk in blocks:
             self._retain(blk)
         self.tables[seq_id] = list(blocks)
+        for blk in self.tables[seq_id]:
+            self.ledger.table_enter(seq_id, blk)
         self.cache_stats["hit_blocks"] += len(blocks)
         return self.tables[seq_id]
 
@@ -486,6 +508,7 @@ class RadixPrefixBlockManager(RefBlockManager):
                     blk=victim.blocks[-1], touch=victim.touch)
         blk = victim.blocks.pop()
         self._parked.discard(blk)
+        self.ledger.unpark(blk)
         del self._in_trie[blk]
         victim.tokens = victim.tokens[:len(victim.blocks)
                                       * self.block_size]
@@ -504,14 +527,17 @@ class RadixPrefixBlockManager(RefBlockManager):
                 # the adopter died before its COW executed: cancel the
                 # order and drop the pin on the source block
                 pend.dead = True
+                self.ledger.unpin(pend.src)
                 self._release(pend.src)
             if blk in self._in_trie:
                 self._parked.add(blk)
+                self.ledger.park(blk)
             else:
                 self._free.append(blk)
 
     def _retain(self, blk):
         self._parked.discard(blk)
+        self.ledger.unpark(blk)
         super()._retain(blk)
 
     # --------------------------------------------------------- matching
@@ -594,6 +620,12 @@ class RadixPrefixBlockManager(RefBlockManager):
                 self._release(blk)
             raise
         self.tables[seq_id] = table
+        # ledger transitions only on the success path: the rollback above
+        # re-parks/frees via _release, whose own hooks keep it consistent
+        for blk in table:
+            self.ledger.table_enter(seq_id, blk)
+        if cow is not None:
+            self.ledger.pin(cow[0])
         self.cache_stats["hit_blocks"] += len(blocks)
         self.cache_stats["token_hits"] += getattr(
             match, "token_count", len(blocks) * self.block_size)
@@ -614,6 +646,7 @@ class RadixPrefixBlockManager(RefBlockManager):
                 continue
             pairs.append((e.src, e.dst))
             self._copy_dst.pop(e.dst, None)
+            self.ledger.unpin(e.src)
             self._release(e.src)
         return pairs
 

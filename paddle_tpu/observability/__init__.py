@@ -42,6 +42,15 @@ aggregates:
     useful-vs-wasted device-token ledger behind
     ``serving_goodput_tokens_total`` / ``serving_waste_total{why}``.
 
+The memory layer (ISSUE 13) accounts for where the KV pool's blocks are:
+
+  * :mod:`paddle_tpu.observability.memledger` — :class:`MemLedger`, the
+    per-pool block-state ledger (active/parked/cow_pending/reserved/
+    free, ``sum == num_blocks`` by construction) behind
+    ``serving_kv_blocks{state}``, per-request peak attribution,
+    admission-stall forensics, and the ``GET /memory`` endpoint
+    (:func:`memory_doc`).
+
 ``python -m paddle_tpu.observability`` prints a generated reference of
 every registered metric instrument.
 """
@@ -71,6 +80,7 @@ from paddle_tpu.observability.health import (HEALTH, HealthEvaluator,
                                              install_default_rules)
 from paddle_tpu.observability.requests import REQUESTS, RequestTracker
 from paddle_tpu.observability.goodput import GOODPUT, GoodputLedger
+from paddle_tpu.observability.memledger import MemLedger, memory_doc
 
 __all__ = [
     "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -85,6 +95,7 @@ __all__ = [
     "MetricsShipper", "start_metrics_shipper", "stop_metrics_shipper",
     "HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
     "REQUESTS", "RequestTracker", "GOODPUT", "GoodputLedger",
+    "MemLedger", "memory_doc",
     "enable", "disable", "metrics_snapshot", "dump",
 ]
 

@@ -22,6 +22,7 @@ _INSTRUMENT_MODULES = (
     "paddle_tpu.observability.roofline",
     "paddle_tpu.observability.compile",
     "paddle_tpu.observability.goodput",
+    "paddle_tpu.observability.memledger",
     "paddle_tpu.serving.telemetry",
     "paddle_tpu.ops.pallas.paged_attention",
     "paddle_tpu.train.trainer",

@@ -140,6 +140,15 @@ class FlightRecorder:
                 doc["requests"] = REQUESTS.flight_excerpt()
         except Exception:
             pass                        # dump paths must never raise
+        # KV memory-ledger snapshots (ISSUE 13): where every pool block
+        # was when the dump fired — the OOM-forensics payload
+        try:
+            from paddle_tpu.observability.memledger import flight_excerpt
+            mem = flight_excerpt()
+            if mem:
+                doc["memory"] = mem
+        except Exception:
+            pass                        # dump paths must never raise
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, separators=(",", ":"))

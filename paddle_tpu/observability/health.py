@@ -22,6 +22,7 @@ and the goodput waste ratio (ISSUE 9).
 from __future__ import annotations
 
 import math
+import os
 from typing import Callable, List, Optional, Sequence
 
 from paddle_tpu.observability.metrics import METRICS, Histogram
@@ -29,7 +30,7 @@ from paddle_tpu.observability.metrics import METRICS, Histogram
 __all__ = ["HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
            "counter_value", "gauge_value", "counter_ratio", "counter_share",
            "gauge_imbalance", "gauge_deficit", "histogram_quantile",
-           "histogram_sum_ratio"]
+           "histogram_sum_ratio", "kv_parked_ratio"]
 
 _ORDER = {"OK": 0, "WARN": 1, "CRIT": 2}
 
@@ -119,6 +120,31 @@ def gauge_deficit(name: str, registry=None, **labels) -> Callable[[], float]:
         except Exception:
             return float("nan")
         return 1.0 - v if v > 0.0 else float("nan")
+    return get
+
+
+def kv_parked_ratio(registry=None) -> Callable[[], float]:
+    """serving_kv_blocks{state="parked"} / serving_kv_pool_blocks — the
+    reclaimable prefix-cache share of the pool. NaN (→ OK) while the
+    radix cache is disabled (``PT_RADIX_CACHE=0`` — a flat-manager pool
+    parking ~everything after a burst is normal LRU behavior, and with
+    caching off entirely there is nothing to rule on) or while the pool
+    gauges are absent/zero."""
+    def get():
+        if os.environ.get("PT_RADIX_CACHE", "1") == "0":
+            return float("nan")
+        reg = registry if registry is not None else METRICS
+        inst = reg.get("serving_kv_blocks")
+        pool = reg.get("serving_kv_pool_blocks")
+        if inst is None or pool is None:
+            return float("nan")
+        try:
+            denom = float(pool.value())
+            if denom <= 0.0:
+                return float("nan")
+            return float(inst.value(state="parked")) / denom
+        except Exception:
+            return float("nan")
     return get
 
 
@@ -249,6 +275,21 @@ def install_default_rules(ev: HealthEvaluator,
                         "below ~5% on real hardware means the tick is "
                         "nowhere near the HBM roof (skipped while MBU "
                         "reads 0.0 = undefined, e.g. off-TPU)")
+    ev.rule("serving_kv_fragmentation",
+            gauge_value("serving_kv_fragmentation", registry),
+            warn=0.25, crit=0.6,
+            description="window-recycling holes / (holes + live KV "
+                        "table entries): high means block tables are "
+                        "mostly None placeholders — capacity burned on "
+                        "positions nothing will ever attend again")
+    ev.rule("serving_kv_parked_ratio",
+            kv_parked_ratio(registry),
+            warn=0.9, crit=0.995,
+            description="radix-parked blocks / KV pool size: near 1.0 "
+                        "the whole pool is cache residue and every "
+                        "admission pays an eviction walk (skipped while "
+                        "PT_RADIX_CACHE=0 or before the pool gauges "
+                        "exist)")
     ev.rule("serving_tick_host_p95_s",
             histogram_quantile("serving_tick_breakdown_seconds", 0.95,
                                registry, phase="host"),

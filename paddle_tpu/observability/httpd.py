@@ -12,6 +12,9 @@ process-global registry the way a Prometheus scraper expects:
     timelines + summaries (ISSUE 9); empty lists while tracking is off
   * ``GET /roofline``      → the serving roofline ledger's per-phase
     MFU/MBU/intensity reports + the machine roofs (ISSUE 12)
+  * ``GET /memory``        → every live KV pool's memory-ledger snapshot
+    (blocks by state, fragmentation, stalls, top holders) plus the
+    per-device HBM stats (ISSUE 13)
   * ``GET /profile?seconds=N`` → run ONE ``jax.profiler`` trace capture
     of N seconds (0 < N <= 600) into ``PT_PROFILE_DIR`` (default
     ``pt_profile``); 400 on a missing/bad ``seconds``, 409 while a
@@ -102,6 +105,11 @@ class _Handler(BaseHTTPRequestHandler):
             body = (json.dumps(serving_roofline_report(), sort_keys=True)
                     + "\n").encode()
             ctype = "application/json"
+        elif path == "/memory":
+            from paddle_tpu.observability.memledger import memory_doc
+            body = (json.dumps(memory_doc(), sort_keys=True)
+                    + "\n").encode()
+            ctype = "application/json"
         elif path == "/profile":
             qs = parse_qs(self.path.partition("?")[2])
             raw = qs.get("seconds", [None])[0]
@@ -134,7 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self.send_error(
                 404, "try /metrics, /metrics.json, /healthz, /flight, "
-                     "/requests, /roofline or /profile?seconds=N")
+                     "/requests, /roofline, /memory or /profile?seconds=N")
             return
         self.send_response(status)
         self.send_header("Content-Type", ctype)

@@ -13,8 +13,13 @@ markdown/JSON verdict with a configurable regression threshold.
 
 Comparability: a degraded round (CPU smoke during a tunnel outage) is
 never compared against an on-chip round — such a pair yields
-``incomparable`` verdicts and cannot fail the gate. All tracked legs
-are greater-is-better (throughputs, MFU, speedups).
+``incomparable`` verdicts and cannot fail the gate. All GATED legs are
+greater-is-better (throughputs, MFU, speedups). Memory legs
+(``kv_bytes_per_token`` and the per-state ``kv_peak_*`` occupancy from
+the KV memory ledger, ISSUE 13) are TRACKED as trajectories but never
+gated: lower bytes-per-token is better and peak occupancy is
+workload-shaped, so the greater-is-better regression rule does not
+apply — they get a ``tracked`` verdict instead.
 
 Deliberately **pure stdlib, zero imports from this package**: bench.py's
 orchestrator loads this file via ``importlib.util.spec_from_file_location``
@@ -44,7 +49,16 @@ __all__ = ["DEFAULT_THRESHOLD", "HISTORY_BASENAME", "append_history",
 DEFAULT_THRESHOLD = 0.05          # a leg must drop >5% to count as regressed
 HISTORY_BASENAME = "BENCH_HISTORY.jsonl"
 
+# leg-name markers for memory-ledger trajectories: tracked, never gated
+# (not greater-is-better, so the regression rule would misfire)
+_TRACKED_MARKERS = (":kv_bytes_per_token", ":kv_peak_")
+
 _NUM = (int, float)
+
+
+def _gated(leg: str) -> bool:
+    """Whether a leg participates in the regression gate."""
+    return not any(m in leg for m in _TRACKED_MARKERS)
 
 
 def _num(v) -> Optional[float]:
@@ -86,6 +100,18 @@ def flatten_legs(parsed) -> dict:
                 if sv is not None:
                     legs[f"metrics:{name}"] = sv
                     break
+            # memory-ledger trajectories (ISSUE 13): per-leg HBM bytes
+            # per resident token and peak occupancy by state — tracked
+            # (never gated; see _TRACKED_MARKERS)
+            bt = _num(sub.get("kv_bytes_per_token"))
+            if bt is not None and bt > 0.0:
+                legs[f"metrics:{name}:kv_bytes_per_token"] = bt
+            pk = sub.get("kv_peak_blocks")
+            if isinstance(pk, dict):
+                for state in sorted(pk):
+                    pv = _num(pk[state])
+                    if pv is not None:
+                        legs[f"metrics:{name}:kv_peak_{state}"] = pv
     return legs
 
 
@@ -161,7 +187,8 @@ def build_report(rounds: list, threshold: float = DEFAULT_THRESHOLD) -> dict:
     Verdicts: ``regressed``/``ok``/``improved`` (beyond ±threshold) when
     the newest two parseable rounds are comparable (same degraded flag),
     ``incomparable`` otherwise, ``new``/``missing`` when only one side
-    has the leg. ``status`` is ``fail`` iff something regressed."""
+    has the leg, ``tracked`` for memory-ledger legs (trajectory only —
+    never gated). ``status`` is ``fail`` iff something regressed."""
     leg_names: list = []
     for r in rounds:
         for leg in r["legs"]:
@@ -190,8 +217,11 @@ def build_report(rounds: list, threshold: float = DEFAULT_THRESHOLD) -> dict:
                 verdict, pct = "incomparable", None
             else:
                 pct = (new - old) / old if old else 0.0
-                verdict = ("regressed" if pct < -threshold else
-                           "improved" if pct > threshold else "ok")
+                if not _gated(leg):
+                    verdict = "tracked"     # memory leg: trajectory only
+                else:
+                    verdict = ("regressed" if pct < -threshold else
+                               "improved" if pct > threshold else "ok")
             legs[leg] = {"new": new, "old": old, "delta_pct": pct,
                          "verdict": verdict}
     regressed = sorted(k for k, v in legs.items()

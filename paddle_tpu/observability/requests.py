@@ -266,6 +266,11 @@ class RequestTracker:
 
         c = line.counters
         proposed = c["spec_proposed"]
+        # peak KV blocks over the request's whole life — max over the
+        # kv_peak events its finish paths stamped (preemption replays and
+        # multi-replica hops each stamp one)
+        peaks = [ev.get("blocks", 0) for ev in line.events
+                 if ev["kind"] == "kv_peak"]
         return {
             "trace_id": line.trace_id,
             "req_id": line.req_id,
@@ -281,6 +286,7 @@ class RequestTracker:
                 "handoff_s": _delta(t_ext, t_ins),
                 "first_decode_s": _delta(t_ins, t_res),
             },
+            "kv_peak_blocks": max(peaks) if peaks else None,
             "replicas": list(line.replicas),
             "preemptions": c["preemptions"],
             "requeues": c["requeues"],

@@ -106,6 +106,14 @@ class MetricsShipper:
         """Take one snapshot, append it as one JSONL line (rotating
         first when the current segment is over ``max_bytes``), and
         return the shipped record."""
+        # HBM gauges must be fresh in every shipped snapshot — pull them
+        # here rather than hoping an engine tick refreshed them recently
+        # (jax may be unimportable in a metrics-only process: skip)
+        try:
+            from paddle_tpu.utils.profiler import device_memory_stats
+            device_memory_stats()
+        except Exception:
+            pass
         snap = self._reg.snapshot()
         now = time.monotonic()
         flat = self._flat_cumulative(snap)

@@ -138,6 +138,20 @@ class Tracer:
             **({"args": args} if args else {}),
         })
 
+    def counter(self, name: str, **values):
+        """Chrome-trace counter event ("C"): Perfetto renders the
+        series in ``values`` as one stacked counter track, so e.g. KV
+        pool occupancy-by-state draws as an area chart over time next
+        to the span timeline."""
+        if not self._enabled:
+            return
+        self._emit({
+            "name": name, "ph": "C", "cat": "host",
+            "ts": time.monotonic_ns() / 1e3,
+            "pid": self._pid, "tid": threading.get_ident(),
+            "args": {k: float(v) for k, v in values.items()},
+        })
+
     def track_tid(self, label: str) -> int:
         """Stable synthetic tid for a named logical track. Registration
         survives :meth:`clear` — the label registry is metadata, not

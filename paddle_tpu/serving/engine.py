@@ -72,6 +72,7 @@ from paddle_tpu.serving.transfer import (KVPayload, _GATHER_BLOCKS_JIT,
 from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
                                       Request, _BeamGroup)
 from paddle_tpu.utils.faults import fault_point
+from paddle_tpu.utils.profiler import device_memory_stats
 
 
 class LLMEngine:
@@ -168,6 +169,8 @@ class LLMEngine:
 
         # ---- the three extracted layers ----
         self.kv = KVManager(num_blocks, block_size)
+        self._block_bytes = None     # per-block HBM bytes, lazily computed
+        self._dev_mem_t = None       # last device_memory_stats refresh
         self.sched = Scheduler(max_queue_len=max_queue_len, clock=clock)
         self.exe = ModelExecutor(
             model, num_slots=num_slots, num_blocks=num_blocks,
@@ -419,9 +422,16 @@ class LLMEngine:
         req = self.requests.get(req_id)
         if req is None or req.done:
             return False
+        g = self.groups.get(req_id)
+        sids = list(g.sid.values()) if g is not None else None
         if not self._detach(req_id):
             return False                            # mid-transition: punt
         self._release_ledger(req_id)
+        # peak attribution survives the free above (the ledger keeps a
+        # request's lifetime max past its table drop)
+        peak = (sum(self.kv.take_peak(s) for s in sids) if sids
+                else self.kv.take_peak(req_id))
+        REQUESTS.event(req, "kv_peak", replica=self.trace_name, blocks=peak)
         req.done = True
         req.finish_reason = reason
         self.stats["timeouts" if reason == "timeout" else "cancelled"] += 1
@@ -475,9 +485,16 @@ class LLMEngine:
         req = self.requests.get(rid)
         if req is None or req.done:
             return None
+        g = self.groups.get(rid)
+        sids = list(g.sid.values()) if g is not None else None
         if not self._detach(rid):
             return None
         self._release_ledger(rid)
+        # the request leaves this engine: stamp its peak here (the next
+        # replica's incarnation stamps its own; the summary takes the max)
+        peak = (sum(self.kv.take_peak(s) for s in sids) if sids
+                else self.kv.take_peak(rid))
+        REQUESTS.event(req, "kv_peak", replica=self.trace_name, blocks=peak)
         return self.sched.release(rid)
 
     def _expire(self):
@@ -774,6 +791,9 @@ class LLMEngine:
         _TOKENS.inc(len(req.tokens))
         GOODPUT.good(len(req.tokens))
         REQUESTS.tokens(req, len(req.tokens))
+        REQUESTS.event(req, "kv_peak", replica=self.trace_name,
+                       blocks=sum(self.kv.take_peak(s)
+                                  for s in g.sid.values()))
         REQUESTS.finish(req, "beam", replica=self.trace_name)
         for sid in g.sid.values():
             self.mgr.free(sid)
@@ -828,6 +848,10 @@ class LLMEngine:
             # though preemption could evict every OTHER prefill: the pool
             # cannot fit one chunk of the sole remaining request — no
             # future tick can differ, so raise instead of spinning
+            FLIGHT.record("serving.alloc_fail",
+                          rids=[int(r) for r in self.prefilling],
+                          **self.kv.ledger.flight_fields())
+            FLIGHT.dump(reason="kv_alloc_fail")
             raise MemoryError(
                 "paged pool cannot fit one prefill chunk of the remaining "
                 "request(s) even after preemption — increase num_blocks or "
@@ -950,6 +974,10 @@ class LLMEngine:
                         protect_rid=protect):
                     if self.preemption:
                         return None
+                    # hard failure escapes step(): leave the ledger's view
+                    # of who holds the missing blocks in the flight ring
+                    FLIGHT.record("serving.alloc_fail", rid=int(rid),
+                                  **self.kv.ledger.flight_fields())
                     raise
 
     def _mgr_retry(self, fn, *a, protect=None):
@@ -1394,6 +1422,8 @@ class LLMEngine:
             self.kv.release(rid)
             self.active[slot] = False
             self.slot_req[slot] = -1
+            REQUESTS.event(req, "kv_peak", replica=self.trace_name,
+                           blocks=self.kv.take_peak(rid))
             REQUESTS.finish(req, req.finish_reason,
                             replica=self.trace_name)
         return [(rid, token)]
@@ -1430,6 +1460,8 @@ class LLMEngine:
         REQUESTS.event(payload.req, "kv_extract", replica=self.trace_name,
                        blocks=len(t), cur=int(self.cur[slot]))
         self.mgr.free(rid)
+        REQUESTS.event(payload.req, "kv_peak", replica=self.trace_name,
+                       blocks=self.kv.take_peak(rid))
         self.kv.release(rid)
         self.active[slot] = False
         self.slot_req[slot] = -1
@@ -1578,8 +1610,40 @@ class LLMEngine:
         _KV_UTIL.set(used / self.mgr.num_blocks if self.mgr.num_blocks
                      else 0.0)
         self.kv.push_prefix_metrics()
+        led = self.kv.ledger
+        if led.enabled:
+            led.publish(bytes_per_block=self._kv_block_bytes(),
+                        resident_tokens=self._resident_tokens())
+            # HBM gauges ship continuously, but the jax query is not
+            # tick-cheap — refresh at most once a second (and on the
+            # first sweep, so short runs still export them)
+            now = time.monotonic()
+            if self._dev_mem_t is None or now - self._dev_mem_t >= 1.0:
+                self._dev_mem_t = now
+                try:
+                    device_memory_stats()
+                except Exception:
+                    pass
         GOODPUT.refresh_gauge()
         self._push_roofline()
+
+    def _kv_block_bytes(self) -> int:
+        """HBM bytes one pool block holds across all layers (K and V)."""
+        if self._block_bytes is None:
+            try:
+                c = self.cache
+                self._block_bytes = sum(
+                    int(np.prod(p.shape[1:])) * p.dtype.itemsize
+                    for p in (*c.k_pools, *c.v_pools))
+            except Exception:
+                self._block_bytes = 0
+        return self._block_bytes
+
+    def _resident_tokens(self) -> int:
+        """Tokens whose KV currently sits in the pool (active slots'
+        cache frontiers + consumed chunk-prefill spans)."""
+        return (int(self.cur[self.active].sum())
+                + sum(c for _, c in self.prefilling.values()))
 
     def step(self):
         """One engine tick — see :meth:`_step_impl`. Wrapped here so the

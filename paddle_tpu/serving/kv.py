@@ -17,6 +17,7 @@ import os
 
 from paddle_tpu.models.paged import (PrefixCachingBlockManager,
                                      RadixPrefixBlockManager)
+from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.serving.telemetry import (_PREFIX_EVICTIONS,
                                           _PREFIX_HIT_RATE, _PREFIX_HITS,
                                           _PREFIX_PARTIAL_HITS,
@@ -39,6 +40,10 @@ class KVManager:
                if os.environ.get("PT_RADIX_CACHE", "1") == "0"
                else RadixPrefixBlockManager)
         self.mgr = cls(num_blocks, block_size)
+        # the block manager owns the per-pool memory ledger (its own
+        # mutation choke points notify it); this layer mirrors the
+        # reservation count into it and exposes the forensic wrappers
+        self.ledger = self.mgr.ledger
         self.reserved = 0            # blocks promised to in-flight requests
         self.resv: dict[int, int] = {}    # req_id -> outstanding reserve
         self.need: dict[int, int] = {}    # req_id -> worst-case blocks
@@ -85,6 +90,7 @@ class KVManager:
         beam admissions hold their whole worst case up front)."""
         self.reserved += n - self.resv.get(rid, 0)
         self.resv[rid] = n
+        self.ledger.set_reserved(self.reserved)
 
     def update(self, rid: int, live: int = None):
         """Outstanding reserve = worst case minus blocks currently held
@@ -96,11 +102,13 @@ class KVManager:
         new = max(0, self.need[rid] - live)
         self.reserved += new - self.resv[rid]
         self.resv[rid] = new
+        self.ledger.set_reserved(self.reserved)
 
     def release(self, rid: int):
         """Close the ledger entry, returning its reserve to the pool."""
         self.reserved -= self.resv.pop(rid, 0)
         self.need.pop(rid, None)
+        self.ledger.set_reserved(self.reserved)
 
     def headroom(self, rid: int = None) -> int:
         """Free blocks net of OTHER requests' standing reservations."""
@@ -108,17 +116,40 @@ class KVManager:
                                   else 0)
         return self.free_blocks - max(0, others)
 
+    # --------------------------------------------------- memory forensics
+    def record_stall(self, need: int, slots_short: bool = False):
+        """An admission was blocked at the headroom gate — attribute the
+        missing blocks to the ledger state holding them."""
+        self.ledger.record_stall(need, slots_short=slots_short)
+
+    def take_peak(self, rid) -> int:
+        """Pop the request's lifetime peak live-block count."""
+        return self.ledger.take_peak(rid)
+
+    def reconcile(self) -> dict:
+        """Block-for-block walk of the manager vs the ledger mirrors
+        (the per-tick invariant the chaos suites assert)."""
+        return self.ledger.reconcile(self.mgr, reserved=self.reserved)
+
     # ----------------------------------------------------------- hygiene
     def assert_quiescent(self):
         """Every block back in the pool (prefix-cache parked blocks count
-        — they are reclaimable), no standing reservations, no tables."""
-        assert self.mgr.free_blocks == self.mgr.num_blocks, (
-            f"block leak: {self.mgr.num_blocks - self.mgr.free_blocks} "
-            f"of {self.mgr.num_blocks} blocks unaccounted for")
-        assert self.reserved == 0, f"reservation leak: {self.reserved}"
-        assert not self.resv and not self.need, (
-            f"ledger leak: resv={self.resv} need={self.need}")
-        assert not self.mgr.tables, f"table leak: {list(self.mgr.tables)}"
+        — they are reclaimable), no standing reservations, no tables.
+        Failure messages carry the ledger's state breakdown (which states
+        hold the leaked blocks) and land in the flight ring."""
+        try:
+            assert self.mgr.free_blocks == self.mgr.num_blocks, (
+                f"block leak: {self.mgr.num_blocks - self.mgr.free_blocks} "
+                f"of {self.mgr.num_blocks} blocks unaccounted for")
+            assert self.reserved == 0, f"reservation leak: {self.reserved}"
+            assert not self.resv and not self.need, (
+                f"ledger leak: resv={self.resv} need={self.need}")
+            assert not self.mgr.tables, f"table leak: {list(self.mgr.tables)}"
+        except AssertionError as e:
+            FLIGHT.record("serving.quiescence_violation",
+                          **self.ledger.flight_fields())
+            raise AssertionError(
+                f"{e} | kv ledger: {self.ledger.describe()}") from None
 
     def push_prefix_metrics(self):
         """Counters are process-global and cumulative; the manager's

@@ -171,6 +171,9 @@ class Scheduler:
                 need = eng._worst_case_blocks(req)
             if (k > len(free_slots)
                     or need > kv.free_blocks - kv.reserved):
+                # stall forensics: which ledger state holds the blocks
+                # (or slots) the queue head is waiting on
+                kv.record_stall(need, slots_short=(k > len(free_slots)))
                 break                      # FCFS: do not starve the head
             self.queue.popleft()
             req._match_memo = None

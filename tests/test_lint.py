@@ -220,6 +220,51 @@ def test_metric_instruments_have_help_and_approved_prefix():
         + "\n".join(offenders))
 
 
+# ------------------------------------------------- memledger choke points
+# Every block-mutating method on the KV/block-manager stack must notify
+# the per-pool memory ledger (ISSUE 13) — a mutation path that skips it
+# silently breaks the sum(states) == num_blocks reconciliation the chaos
+# suites assert per tick. Methods that mutate only by delegating to a
+# notifying method go on the allowlist with a reason.
+_MEMLEDGER_FILES = ("paddle_tpu/serving/kv.py", "paddle_tpu/models/paged.py")
+_MEMLEDGER_METHODS = {"allocate", "free", "free_prefix", "adopt_prefix",
+                      "_evict_one", "take_copy_plan"}
+_MEMLEDGER_ALLOWLIST = {
+    "paddle_tpu/serving/kv.py::KVManager.allocate":
+        "delegates to the block manager, whose allocate notifies",
+    "paddle_tpu/serving/kv.py::KVManager.free":
+        "delegates to the block manager, whose free notifies",
+    "paddle_tpu/models/paged.py::RefBlockManager.allocate":
+        "delegates to BlockManager.allocate, which notifies",
+}
+
+
+def test_block_mutators_notify_the_memledger():
+    import ast
+    root = pathlib.Path(__file__).resolve().parent.parent
+    offenders = []
+    for rel in _MEMLEDGER_FILES:
+        text = (root / rel).read_text()
+        tree = ast.parse(text)
+        for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+            for fn in cls.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                if fn.name not in _MEMLEDGER_METHODS:
+                    continue
+                key = f"{rel}::{cls.name}.{fn.name}"
+                if key in _MEMLEDGER_ALLOWLIST:
+                    continue
+                body = ast.get_source_segment(text, fn) or ""
+                if "ledger." not in body:
+                    offenders.append(f"{rel}:{fn.lineno}: "
+                                     f"{cls.name}.{fn.name}")
+    assert not offenders, (
+        "block-mutating methods that never notify the memory ledger "
+        "(record the transition with self.ledger.<hook>, or allowlist "
+        "with a reason if a delegate notifies):\n" + "\n".join(offenders))
+
+
 # ----------------------------------------------- metrics-reference coverage
 # The generated metrics reference (``python -m paddle_tpu.observability``)
 # renders whatever _INSTRUMENT_MODULES imports — a module that registers
