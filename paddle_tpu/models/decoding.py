@@ -184,12 +184,17 @@ def _sample(logits, rng, temperature, top_k, top_p):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-def _sample_rows(logits, rng, temps, top_ps, top_k=None):
+def _sample_rows(logits, rng, temps, top_ps, top_k=None, bias=None):
     """Per-ROW temperature/top-p sampling (the serving engine's
     per-request params; ref PaddleNLP predictor per-request
     GenerationConfig). ``temps``/``top_ps``: [B] traced — temperature 0
     means greedy FOR THAT ROW; top_p 1.0 disables the nucleus cut.
-    ``top_k`` stays global/static."""
+    ``top_k`` stays global/static. ``bias`` ([B, V] additive, 0 / -1e30)
+    is the grammar-constraint mask (ISSUE 14): added BEFORE the
+    temperature scale and the greedy argmax, so both the stochastic and
+    the greedy row paths can only pick mask-legal tokens."""
+    if bias is not None:
+        logits = logits + bias
     safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
     scaled = logits / safe_t
     if top_k is not None and top_k > 0:

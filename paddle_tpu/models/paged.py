@@ -20,6 +20,7 @@ lengths (rounded up per block) — NOT batch × max_len as in the static
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax
@@ -310,10 +311,15 @@ class PrefixCachingBlockManager(RefBlockManager):
         super()._retain(blk)
 
     # ------------------------------------------------------------ hashing
-    def _chain_digests(self, tokens, n_full):
+    def _chain_digests(self, tokens, n_full, adapter=None):
         import hashlib
         toks = np.asarray(tokens, np.int32)
-        digest = b""
+        # adapter identity seeds the chain (ISSUE 14): KV computed under
+        # one LoRA adapter differs numerically from another tenant's, so
+        # two tenants' identical prompts must never share blocks. None
+        # keeps the legacy empty seed — old digests stay bit-identical.
+        digest = (b"" if adapter is None
+                  else hashlib.sha1(repr(adapter).encode()).digest())
         out = []
         for i in range(n_full):
             digest = hashlib.sha1(
@@ -322,14 +328,14 @@ class PrefixCachingBlockManager(RefBlockManager):
             out.append(digest)
         return out
 
-    def match_prefix(self, tokens) -> list[int]:
+    def match_prefix(self, tokens, adapter=None) -> list[int]:
         """Longest run of resident full-block prefix matches for this
         prompt. Capped at (len-1)//block_size so at least the last prompt
         token is always prefilled — its logits seed the first sample."""
         n_full = (len(tokens) - 1) // self.block_size
         self.cache_stats["lookup_blocks"] += n_full
         blocks = []
-        for d in self._chain_digests(tokens, n_full):
+        for d in self._chain_digests(tokens, n_full, adapter):
             blk = self._hash_to_block.get(d)
             if blk is None:
                 break
@@ -349,7 +355,7 @@ class PrefixCachingBlockManager(RefBlockManager):
         self.cache_stats["hit_blocks"] += len(blocks)
         return self.tables[seq_id]
 
-    def commit_prefix(self, seq_id, tokens):
+    def commit_prefix(self, seq_id, tokens, adapter=None):
         """Register chain digests for seq_id's full prompt blocks so later
         requests can share them. First-writer-wins per digest; safe to call
         before the prefill has executed on device — any matching request's
@@ -357,7 +363,7 @@ class PrefixCachingBlockManager(RefBlockManager):
         dependency orders them)."""
         table = self.tables.get(seq_id, [])
         n_full = min(len(tokens) // self.block_size, len(table))
-        for i, d in enumerate(self._chain_digests(tokens, n_full)):
+        for i, d in enumerate(self._chain_digests(tokens, n_full, adapter)):
             blk = table[i]
             if blk is None:
                 break                          # window-recycled: stop
@@ -461,6 +467,11 @@ class RadixPrefixBlockManager(RefBlockManager):
     def __init__(self, num_blocks: int, block_size: int):
         super().__init__(num_blocks, block_size)
         self._root = _RadixNode(np.empty(0, np.int32), [], None)
+        # one trie PER ADAPTER IDENTITY (ISSUE 14): KV computed under a
+        # LoRA adapter is numerically that adapter's — tenants must never
+        # adopt each other's blocks. The base-model (None) trie is the
+        # legacy ``_root`` so adapter-free serving is untouched.
+        self._roots: dict[object, _RadixNode] = {None: self._root}
         self._in_trie: dict[int, _RadixNode] = {}   # blk -> owning node
         self._parked: set[int] = set()              # trie blocks, rc == 0
         self._touch = 0
@@ -491,7 +502,8 @@ class RadixPrefixBlockManager(RefBlockManager):
         of its node + every descendant) is parked too — so such a leaf
         always exists while ``_parked`` is non-empty."""
         victim = None
-        stack = list(self._root.children)
+        stack = [ch for root in self._roots.values()
+                 for ch in root.children]
         while stack:
             node = stack.pop()
             if node.children:
@@ -552,19 +564,27 @@ class RadixPrefixBlockManager(RefBlockManager):
                 best, bl = ch, n
         return best, bl
 
-    def match_prefix(self, tokens) -> PrefixMatch:
+    def _root_for(self, adapter) -> _RadixNode:
+        root = self._roots.get(adapter)
+        if root is None:
+            root = self._roots[adapter] = _RadixNode(
+                np.empty(0, np.int32), [], None)
+        return root
+
+    def match_prefix(self, tokens, adapter=None) -> PrefixMatch:
         """Longest shared token span for this prompt, capped at len-1 so
         the last prompt token always prefills (its logits seed the first
         sample). Fully-matched aligned blocks are shared outright; the
         boundary block (divergence or span end mid-block) is offered as a
-        copy-on-write partial hit."""
+        copy-on-write partial hit. Matching walks ONLY the trie of the
+        request's adapter identity — cross-tenant spans never match."""
         toks = np.asarray(tokens, np.int32).reshape(-1)
         cap = len(toks) - 1
         bs = self.block_size
         self.cache_stats["lookup_blocks"] += max(cap, 0) // bs
         self.cache_stats["lookup_tokens"] += max(cap, 0)
         self._touch += 1
-        node, depth = self._root, 0
+        node, depth = self._root_for(adapter), 0
         blocks, cow = [], None
         while depth < cap:
             best, bl = self._best_child(node, toks[depth:cap])
@@ -651,12 +671,13 @@ class RadixPrefixBlockManager(RefBlockManager):
         return pairs
 
     # ------------------------------------------------------- insertion
-    def commit_prefix(self, seq_id, tokens):
+    def commit_prefix(self, seq_id, tokens, adapter=None):
         """Insert seq_id's token span — INCLUDING the partial tail block
         — so later requests can share it. Safe before the writes have
         executed on device (data dependencies order consumers after).
         Callers must pass only tokens whose KV is resident (the engine
-        passes the cache frontier, not the just-sampled token)."""
+        passes the cache frontier, not the just-sampled token). The span
+        lands in the trie of ``adapter``'s identity only."""
         table = self.tables.get(seq_id, [])
         toks = np.asarray(tokens, np.int32).reshape(-1)
         bs = self.block_size
@@ -667,12 +688,12 @@ class RadixPrefixBlockManager(RefBlockManager):
                 break
         if n_tok <= 0:
             return
-        self._insert(toks[:n_tok], table)
+        self._insert(toks[:n_tok], table, self._root_for(adapter))
         self.cache_epoch += 1
 
-    def _insert(self, toks, table):
+    def _insert(self, toks, table, root=None):
         bs = self.block_size
-        node, depth = self._root, 0
+        node, depth = (root if root is not None else self._root), 0
         while depth < len(toks):
             rem = toks[depth:]
             best, bl = self._best_child(node, rem)
@@ -855,8 +876,48 @@ def is_moe_model(model) -> bool:
                for lyr in getattr(_backbone(model), "layers", ()))
 
 
+def _lora_delta(x, lora, kind, li):
+    """Batched multi-LoRA correction for ONE projection of ONE layer
+    (ISSUE 14): ``delta[b] = (x[b] @ A_{aidx[b]}) @ B_{aidx[b]}`` with
+    the alpha/r scale pre-folded into the B stack and zero for
+    null-adapter rows. ``lora`` is the engine-built pytree:
+
+      qkv_a/qkv_b/o_a/o_b  [L, cap, ...]  stacked adapter tensors
+      perm / inv           [B]  rows sorted by cache index (null last) /
+                                the inverse permutation
+      gs                   [cap] TOKEN count per cache index (row count
+                                × per-row width, in sorted order)
+      aidx                 [B]  original-order cache index, -1 = null
+
+    Default impl flattens the sorted rows to [B*S, k] and runs TWO
+    grouped GEMMs (``ops/pallas/grouped_matmul`` — Pallas on TPU, XLA
+    segment fallback elsewhere) so a heterogeneous batch is ragged
+    per-adapter segments through one kernel. Rows past ``sum(gs)`` (the
+    null-adapter tail) are UNSPECIFIED per the kernel contract and are
+    masked to zero here. ``PT_MULTILORA_IMPL=gather`` (trace-time; needs
+    ``clear_jit_caches()`` to flip) selects the naive per-row dense
+    path — the bench baseline the grouped path is measured against."""
+    from paddle_tpu.ops.pallas.grouped_matmul import grouped_matmul
+    a_stack = lora[kind + "_a"][li]          # [cap, k, r]
+    b_stack = lora[kind + "_b"][li]          # [cap, r, n]
+    bsz, s, kdim = x.shape
+    xf = x.astype(jnp.float32)
+    if os.environ.get("PT_MULTILORA_IMPL", "grouped") == "gather":
+        sel = jnp.maximum(lora["aidx"], 0)
+        t = jnp.einsum("bsk,bkr->bsr", xf, a_stack[sel])
+        d = jnp.einsum("bsr,brn->bsn", t, b_stack[sel])
+        return jnp.where((lora["aidx"] >= 0)[:, None, None],
+                         d, 0.0).astype(x.dtype)
+    xp = xf[lora["perm"]].reshape(bsz * s, kdim)
+    t = grouped_matmul(xp, a_stack, lora["gs"])
+    d = grouped_matmul(t, b_stack, lora["gs"])
+    d = jnp.where(jnp.arange(bsz * s)[:, None] < jnp.sum(lora["gs"]),
+                  d, 0.0)
+    return d.reshape(bsz, s, -1)[lora["inv"]].astype(x.dtype)
+
+
 def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
-                        slot_ids=None, table_rows=None):
+                        slot_ids=None, table_rows=None, lora=None):
     """Prefill padded ragged prompts [B, S]; returns (last_logits, cache).
 
     Attention runs the padded-varlen path (kv_lens) — the fused kernel on
@@ -904,6 +965,8 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
         qkv = _wo(h, att.qkv_proj)
+        if lora is not None:
+            qkv = qkv + _lora_delta(h, lora, "qkv", li)
         if getattr(att, "qkv_bias", None) is not None:
             qkv = qkv + att.qkv_bias
         nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
@@ -918,7 +981,11 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
                                         prompt_lens, nb, bs))
         v_pools.append(_scatter_prefill(cache.v_pools[li], v, tables,
                                         prompt_lens, nb, bs))
-        x = x + _wo(out.reshape(b, s, nh * hd), att.o_proj)
+        attn_out = out.reshape(b, s, nh * hd)
+        proj = _wo(attn_out, att.o_proj)
+        if lora is not None:
+            proj = proj + _lora_delta(attn_out, lora, "o", li)
+        x = x + proj
         x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
     x = _backbone(model).norm(x)
     logits = _model_logits(model, x)
@@ -929,7 +996,8 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
     return last, new_cache
 
 
-def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
+def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
+                            lora=None):
     """One decode token per sequence. tokens: [B] int32; active: [B] bool
     (finished rows neither write KV nor advance). Returns (logits, cache)."""
     cfg = model.cfg
@@ -947,6 +1015,8 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
         qkv = _wo(h, att.qkv_proj)
+        if lora is not None:
+            qkv = qkv + _lora_delta(h, lora, "qkv", li)
         if getattr(att, "qkv_bias", None) is not None:
             qkv = qkv + att.qkv_bias
         nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
@@ -966,7 +1036,11 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
         out = paged_decode_attention(q[:, 0], k_pool, v_pool,
                                      cache.block_tables, new_lens,
                                      window=window)
-        x = x + _wo(out.reshape(b, 1, nh * hd), att.o_proj)
+        attn_out = out.reshape(b, 1, nh * hd)
+        proj = _wo(attn_out, att.o_proj)
+        if lora is not None:
+            proj = proj + _lora_delta(attn_out, lora, "o", li)
+        x = x + proj
         x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
     x = _backbone(model).norm(x)
     logits = _model_logits(model, x)[:, 0]
@@ -976,7 +1050,8 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active):
 
 def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
                       upd_rows, upd_cols, upd_vals, rng, temps, top_ps,
-                      top_k=None, want_logp=False):
+                      top_k=None, want_logp=False, lora=None,
+                      logit_bias=None):
     """ONE fused serving tick: apply incremental block-table updates
     (``tables[upd_rows[i], upd_cols[i]] = upd_vals[i]``, sentinel rows
     dropped — no host-side table rebuild/re-upload), run the decode step,
@@ -993,11 +1068,12 @@ def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
     tables = cache.block_tables.at[upd_rows, upd_cols].set(upd_vals,
                                                            mode="drop")
     cache = PagedKVCache(cache.k_pools, cache.v_pools, tables, cache.lens)
-    logits, cache = llama_decode_step_paged(model, tokens, cache, active)
+    logits, cache = llama_decode_step_paged(model, tokens, cache, active,
+                                            lora)
     logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             if want_logp else ())
     nxt = _sample_rows(logits.astype(jnp.float32), rng, temps, top_ps,
-                       top_k)
+                       top_k, logit_bias)
     nxt = jnp.where(active, nxt.astype(jnp.int32), tokens)
     return nxt, logp, cache
 
@@ -1024,8 +1100,8 @@ _TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(10, 11),
 def clear_jit_caches():
     """Drop every module-level serving jit cache. Needed when trace-time
     context changes under the same call signature — flipping
-    ``PT_GROUPED_GEMM`` or entering/leaving a mesh re-routes MoE layers,
-    but the jit caches key on shapes only."""
+    ``PT_GROUPED_GEMM`` or ``PT_MULTILORA_IMPL``, or entering/leaving a
+    mesh re-routes layers, but the jit caches key on shapes only."""
     for f in (_PREFILL_JIT, _DECODE_JIT, _TICK_JIT, _PREFILL_CHUNK_JIT,
               _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT, _PREFIX_COW_JIT):
         f.clear_cache()
@@ -1304,7 +1380,7 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
 
 def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
                               cache: PagedKVCache, slot_ids, table_rows,
-                              full_logits=False):
+                              full_logits=False, lora=None):
     """CONTINUE a prefill: write chunk tokens at positions
     ``offsets[a] .. offsets[a]+chunk_lens[a]-1`` of their slots and attend
     each chunk query over the slot's WHOLE pool prefix (gather-based) —
@@ -1367,6 +1443,8 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
         qkv = _wo(h, att.qkv_proj)
+        if lora is not None:
+            qkv = qkv + _lora_delta(h, lora, "qkv", li)
         if getattr(att, "qkv_bias", None) is not None:
             qkv = qkv + att.qkv_bias
         nh, nkv, hd = att.num_heads, att.num_kv_heads, att.head_dim
@@ -1386,7 +1464,11 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         # gather + dense-mask view, bit-compatible)
         out = paged_chunk_attention(q, k_pool, v_pool, tables, offsets,
                                     chunk_lens, window=window)
-        x = x + _wo(out.reshape(a, c, nh * hd), att.o_proj)
+        attn_out = out.reshape(a, c, nh * hd)
+        proj = _wo(attn_out, att.o_proj)
+        if lora is not None:
+            proj = proj + _lora_delta(attn_out, lora, "o", li)
+        x = x + proj
         x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
     x = _backbone(model).norm(x)
     logits = _model_logits(model, x)
@@ -1431,13 +1513,14 @@ _PREFILL_CHUNK_JIT = jax.jit(llama_prefill_chunk_paged,
 # positionally overwritten by the next append.
 
 def llama_verify_chunk_paged(model, input_ids, chunk_lens, offsets,
-                             cache: PagedKVCache, slot_ids, table_rows):
+                             cache: PagedKVCache, slot_ids, table_rows,
+                             lora=None):
     """Speculative verify: one chunk forward returning [A, C, V] logits
     (see ``llama_prefill_chunk_paged`` — same append semantics, every
     chunk position's logits kept for accept/reject)."""
     return llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
                                      cache, slot_ids, table_rows,
-                                     full_logits=True)
+                                     full_logits=True, lora=lora)
 
 
 def spec_rewind_lens(cache: PagedKVCache, slot_ids, new_lens):

@@ -105,7 +105,24 @@ def lora_state_dict(lora) -> dict:
 
 
 def lora_load_state_dict(lora, state: dict):
-    """Inverse of ``lora_state_dict`` onto an existing adapter tree."""
+    """Inverse of ``lora_state_dict`` onto an existing adapter tree.
+
+    Strict: the state's key set must match the adapter tree exactly.
+    A tenant upload with a typo'd path, a stale target set, or extra
+    tensors is rejected with a ``ValueError`` naming the offending keys
+    (AdapterStore relies on this to bounce malformed uploads cleanly)."""
+    expected = {"_scale"} | {p + sfx for p in lora if p != "_scale"
+                             for sfx in (".lora_A", ".lora_B")}
+    missing = sorted(expected - set(state))
+    unexpected = sorted(set(state) - expected)
+    if missing or unexpected:
+        parts = []
+        if missing:
+            parts.append("missing keys: " + ", ".join(missing))
+        if unexpected:
+            parts.append("unexpected keys: " + ", ".join(unexpected))
+        raise ValueError("lora_load_state_dict: state does not match the "
+                         "adapter tree — " + "; ".join(parts))
     new = {}
     for path, ab in lora.items():
         if path == "_scale":

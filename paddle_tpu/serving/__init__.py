@@ -9,6 +9,8 @@ The monolithic ``paddle_tpu/serving.py`` is now a package (ISSUE 7):
   * :mod:`.router`    — LOR dispatch over N replicas, session affinity,
                         health gating, disaggregated prefill/decode
   * :mod:`.transfer`  — the KV handoff seam between replicas
+  * :mod:`.adapters`  — multi-tenant LoRA adapter store (device LRU)
+  * :mod:`.grammar`   — token-mask automata for constrained decoding
 
 Everything the old module exported is re-exported here, so
 ``from paddle_tpu.serving import LLMEngine, Request`` and every other
@@ -26,7 +28,10 @@ from paddle_tpu.observability import METRICS, span as _span  # noqa: F401
 from paddle_tpu.observability.flight import FLIGHT  # noqa: F401
 from paddle_tpu.utils.faults import fault_point  # noqa: F401
 
+from paddle_tpu.serving.adapters import AdapterStore  # noqa: F401
 from paddle_tpu.serving.engine import LLMEngine  # noqa: F401
+from paddle_tpu.serving.grammar import (  # noqa: F401
+    TokenMaskAutomaton, json_schema_regex)
 from paddle_tpu.serving.executor import (  # noqa: F401
     ModelExecutor, _SAMPLE_ROWS_JIT)
 from paddle_tpu.serving.kv import KVManager  # noqa: F401
@@ -49,4 +54,5 @@ __all__ = [
     "LLMEngine", "Request", "QueueFullError", "EngineDrainingError",
     "Router", "Replica", "Scheduler", "KVManager", "ModelExecutor",
     "KVTransfer", "DeviceKVTransfer", "KVPayload",
+    "AdapterStore", "TokenMaskAutomaton", "json_schema_regex",
 ]

@@ -1,0 +1,213 @@
+"""AdapterStore: the multi-tenant LoRA registry + device cache (ISSUE 14).
+
+A production fleet serves many fine-tuned variants of ONE base model.
+The S-LoRA/Punica pattern this store feeds: every tenant's adapter is a
+rank-r pair (A, B) per targeted projection; the batch runs the shared
+base forward once, and a grouped rank-r correction
+``y += scale * (x @ A) @ B`` is added per slot according to that slot's
+adapter. For that to be one fused program, the resident adapters live
+as STACKED device tensors — ``[L, capacity, in, r_max]`` per projection
+— indexed by a per-slot cache index, so heterogeneous batches flow
+through the grouped-GEMM kernel as ragged per-adapter segments with no
+per-adapter dispatch.
+
+This module owns the lifecycle around that:
+
+* ``register(adapter_id, state_dict)`` — host-resident adapter sets,
+  validated STRICTLY via :func:`~paddle_tpu.peft.lora_load_state_dict`
+  (missing/unexpected keys raise ``ValueError``), rank-padded to
+  ``max_rank`` with the ``alpha/r`` scale folded into B (zero-padding
+  keeps the folded product exact).
+* ``acquire(adapter_id)`` — LRU device cache of ``capacity`` stacked
+  slots with host→device hot-swap; returns the cache index and takes a
+  REF-COUNT pin so an adapter in use by a scheduled slot is never
+  evicted. ``release`` drops the pin. When every resident entry is
+  pinned and a new adapter needs a slot, ``acquire`` raises — the
+  scheduler defers that admission rather than corrupt a live batch.
+* the ``serving.adapter_swap`` chaos site fires BEFORE the upload
+  mutates anything, so an injected fault leaves the cache, the pins,
+  and the free list exactly as they were (exception-atomic; the
+  scheduler turns it into a deferred admission).
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.peft import lora_load_state_dict, lora_targets
+from paddle_tpu.serving.telemetry import (_ADAPTER_EVICTIONS, _ADAPTER_HITS,
+                                          _ADAPTER_MISSES, _ADAPTER_RESIDENT,
+                                          _ADAPTER_UPLOADS)
+from paddle_tpu.utils.faults import fault_point
+
+# serving targets the attention projections (the fused qkv and the
+# output proj) — the pair the paged forwards thread the correction into
+SERVING_TARGETS = ("qkv_proj", "o_proj")
+_KIND_OF = {"qkv_proj": "qkv", "o_proj": "o"}
+
+
+class AdapterStore:
+    """Registered LoRA adapter sets + a device-resident stacked cache."""
+
+    def __init__(self, model, *, capacity: int = 4, max_rank: int = 8,
+                 target_modules=SERVING_TARGETS):
+        import jax
+        from paddle_tpu.core.module import _path_to_str
+        self.capacity = int(capacity)
+        self.max_rank = int(max_rank)
+        if self.capacity < 1:
+            raise ValueError("adapter cache capacity must be >= 1")
+        paths = lora_targets(model, target_modules)
+        flat, _ = jax.tree_util.tree_flatten_with_path(model)
+        shapes = {_path_to_str(p): tuple(leaf.shape) for p, leaf in flat
+                  if hasattr(leaf, "shape")}
+        # path -> (layer, kind); layers must tile 0..L-1 for each kind
+        self._slot_of: dict[str, tuple[int, str]] = {}
+        dims: dict[str, tuple[int, int]] = {}
+        layers = set()
+        for p in paths:
+            m = re.search(r"layers\.(\d+)\.", p)
+            leaf = p.split(".")[-2] if p.endswith(".weight") else \
+                p.split(".")[-1]
+            if m is None or leaf not in _KIND_OF:
+                raise ValueError(f"cannot place LoRA target {p!r}")
+            li, kind = int(m.group(1)), _KIND_OF[leaf]
+            self._slot_of[p] = (li, kind)
+            layers.add(li)
+            d = shapes[p]
+            if dims.setdefault(kind, d) != d:
+                raise ValueError(f"inconsistent {kind} shapes across layers")
+        self.num_layers = max(layers) + 1
+        self._paths = paths
+        self._dims = dims                       # kind -> (fan_in, fan_out)
+        self._host: dict[object, dict[str, tuple[np.ndarray, np.ndarray]]] \
+            = {}
+        self._resident: OrderedDict[object, int] = OrderedDict()  # MRU last
+        self._pins: dict[object, int] = {}
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._stacks = {}
+        for kind, (k, n) in dims.items():
+            self._stacks[kind + "_a"] = jnp.zeros(
+                (self.num_layers, self.capacity, k, self.max_rank),
+                jnp.float32)
+            self._stacks[kind + "_b"] = jnp.zeros(
+                (self.num_layers, self.capacity, self.max_rank, n),
+                jnp.float32)
+
+    # ----------------------------------------------------------- registry
+    def register(self, adapter_id, state_dict: dict):
+        """Validate and install a tenant's adapter set (host-resident).
+        Re-registering an UNPINNED id replaces it (and drops any stale
+        device residency); a pinned id is in use and refuses."""
+        if adapter_id is None:
+            raise ValueError("adapter_id None is reserved for the base model")
+        if self._pins.get(adapter_id):
+            raise ValueError(f"adapter {adapter_id!r} is pinned by "
+                             "scheduled requests; cannot re-register")
+        template = {p: {"a": np.zeros((shape[0], 1), np.float32),
+                        "b": np.zeros((1, shape[1]), np.float32)}
+                    for p, shape in ((p, self._dims[self._slot_of[p][1]])
+                                     for p in self._paths)}
+        template["_scale"] = np.zeros((), np.float32)
+        tree = lora_load_state_dict(template, state_dict)   # strict keys
+        scale = float(tree["_scale"])
+        per_kind: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        for kind, (k, n) in self._dims.items():
+            per_kind[kind] = (
+                np.zeros((self.num_layers, k, self.max_rank), np.float32),
+                np.zeros((self.num_layers, self.max_rank, n), np.float32))
+        for p in self._paths:
+            li, kind = self._slot_of[p]
+            k, n = self._dims[kind]
+            a = np.asarray(tree[p]["a"], np.float32)
+            b = np.asarray(tree[p]["b"], np.float32)
+            r = a.shape[1] if a.ndim == 2 else -1
+            if a.shape != (k, r) or b.shape != (r, n) or r < 1:
+                raise ValueError(
+                    f"adapter {adapter_id!r}: {p} has A{a.shape}/B{b.shape}"
+                    f", expected A({k}, r)/B(r, {n})")
+            if r > self.max_rank:
+                raise ValueError(
+                    f"adapter {adapter_id!r}: rank {r} exceeds the store's "
+                    f"max_rank {self.max_rank}")
+            # zero-padding to max_rank keeps scale*(x@A)@B exact
+            per_kind[kind][0][li, :, :r] = a
+            per_kind[kind][1][li, :r, :] = b * scale   # fold the scale in
+        self._host[adapter_id] = per_kind
+        idx = self._resident.pop(adapter_id, None)
+        if idx is not None:                    # stale device copy: drop it
+            self._free.append(idx)
+            _ADAPTER_RESIDENT.set(len(self._resident))
+
+    def known(self, adapter_id) -> bool:
+        return adapter_id in self._host
+
+    # ------------------------------------------------------- device cache
+    def ensure(self, adapter_id) -> int:
+        """Make ``adapter_id`` device-resident; returns its cache index.
+        Exception-atomic: the ``serving.adapter_swap`` site fires before
+        any mutation, and a failed victim search mutates nothing."""
+        idx = self._resident.get(adapter_id)
+        if idx is not None:
+            self._resident.move_to_end(adapter_id)
+            _ADAPTER_HITS.inc()
+            return idx
+        host = self._host.get(adapter_id)
+        if host is None:
+            raise KeyError(f"adapter {adapter_id!r} is not registered")
+        _ADAPTER_MISSES.inc()
+        victim = None
+        if not self._free:
+            for aid in self._resident:         # LRU first
+                if not self._pins.get(aid):
+                    victim = aid
+                    break
+            if victim is None:
+                raise RuntimeError(
+                    "adapter cache exhausted: all "
+                    f"{self.capacity} resident adapters are pinned")
+        fault_point("serving.adapter_swap", store=self, adapter=adapter_id,
+                    victim=victim)
+        if victim is None:
+            idx = self._free.pop()
+        else:
+            idx = self._resident.pop(victim)
+            _ADAPTER_EVICTIONS.inc()
+        for kind, (a, b) in host.items():
+            self._stacks[kind + "_a"] = \
+                self._stacks[kind + "_a"].at[:, idx].set(a)
+            self._stacks[kind + "_b"] = \
+                self._stacks[kind + "_b"].at[:, idx].set(b)
+        self._resident[adapter_id] = idx
+        _ADAPTER_UPLOADS.inc()
+        _ADAPTER_RESIDENT.set(len(self._resident))
+        return idx
+
+    def acquire(self, adapter_id) -> int:
+        """``ensure`` + pin: the index stays valid until ``release``."""
+        idx = self.ensure(adapter_id)
+        self._pins[adapter_id] = self._pins.get(adapter_id, 0) + 1
+        return idx
+
+    def release(self, adapter_id):
+        n = self._pins.get(adapter_id, 0) - 1
+        if n > 0:
+            self._pins[adapter_id] = n
+        else:
+            self._pins.pop(adapter_id, None)
+
+    def index_of(self, adapter_id) -> int:
+        """Cache index of a RESIDENT adapter (stable while pinned)."""
+        return self._resident[adapter_id]
+
+    def stacks(self) -> dict:
+        """The stacked device tensors the forwards index:
+        ``{qkv_a, qkv_b, o_a, o_b}``, each ``[L, capacity, ...]``."""
+        return dict(self._stacks)
+
+    def assert_quiescent(self):
+        """No pins outstanding (every scheduled slot released its hold)."""
+        assert not self._pins, f"adapter pin leak: {self._pins}"

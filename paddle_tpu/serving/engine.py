@@ -58,15 +58,18 @@ from paddle_tpu.serving.executor import ModelExecutor, _SAMPLE_ROWS_JIT  # noqa:
 from paddle_tpu.serving.kv import KVManager
 from paddle_tpu.serving.scheduler import Scheduler
 from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
-                                          _DRAIN, _FINISHED, _KV_IN_USE,
+                                          _DRAIN, _FINISHED,
+                                          _GRAMMAR_SPEC_REJECTS,
+                                          _GRAMMAR_TOKENS, _KV_IN_USE,
                                           _KV_UTIL, _QUEUE_DEPTH,
                                           _SPEC_ACCEPTED,
                                           _SPEC_DRAFT_REUSE,
                                           _SPEC_FALLBACKS,
                                           _SPEC_PROPOSED, _SPEC_RATE,
-                                          _SPEC_TOKENS, _TICK,
-                                          _TICK_BREAKDOWN, _TIMEOUTS,
-                                          _TOK_LAT, _TOKENS, _TTFT)
+                                          _SPEC_TOKENS, _TENANT_TOKENS,
+                                          _TICK, _TICK_BREAKDOWN,
+                                          _TIMEOUTS, _TOK_LAT, _TOKENS,
+                                          _TTFT)
 from paddle_tpu.serving.transfer import (KVPayload, _GATHER_BLOCKS_JIT,
                                          _INSTALL_BLOCKS_JIT)
 from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
@@ -89,7 +92,8 @@ class LLMEngine:
                  eos_token_id=None, temperature=0.0, top_k=None, top_p=None,
                  seed=0, prefix_caching=True, preemption=False,
                  max_queue_len=None, clock=None, draft_model=None,
-                 spec_k=4, spec_adaptive=True, prefill_only=False):
+                 spec_k=4, spec_adaptive=True, prefill_only=False,
+                 adapter_store=None):
         cfg = model.cfg
         self.model = model
         self.num_slots = num_slots
@@ -186,6 +190,23 @@ class LLMEngine:
         self.max_gen = np.zeros(num_slots, np.int64)
         self.table_len = np.zeros(num_slots, np.int64)
         self.last_tok = np.zeros(num_slots, np.int32)
+
+        # ---- multi-tenant serving (ISSUE 14) ----
+        # ``adapter_store``: a shared AdapterStore; a request carrying an
+        # adapter_id is admitted only once its adapter is device-resident
+        # AND pinned (the scheduler acquires it), and every per-slot
+        # forward adds the grouped rank-r correction for that slot's
+        # cache index. PT_MULTILORA=0 is the kill switch: with it off —
+        # or with no store, or no adapter-carrying rows — the forwards
+        # are handed lora=None and trace EXACTLY the base programs.
+        self.adapter_store = adapter_store
+        self.slot_aidx = np.full(num_slots, -1, np.int64)  # cache idx / -1
+        self._adapter_pins: dict[int, object] = {}   # rid -> adapter_id
+        # grammar-constrained decoding: slot -> [automaton, state]. The
+        # state advances in ``_emit`` as tokens commit, so it is always
+        # the state AFTER everything in req.tokens — a pure function of
+        # the emitted stream (resume/install replays it).
+        self._grammar: dict[int, list] = {}
 
         # spec-decode per-slot state (allocated tiny even when spec is
         # off, so reset sites need no guards). ``draft_cur``: committed-
@@ -382,6 +403,31 @@ class LLMEngine:
             raise ValueError(
                 "request worst case exceeds the WHOLE block pool — it "
                 "could never be admitted (raise num_blocks)")
+        if req.adapter_id is not None:
+            if self.adapter_store is None:
+                raise ValueError(
+                    "request carries an adapter_id but the engine was "
+                    "built without an adapter_store")
+            if not self.adapter_store.known(req.adapter_id):
+                raise ValueError(f"adapter {req.adapter_id!r} is not "
+                                 "registered with the adapter store")
+            if req.num_beams > 1:
+                raise NotImplementedError(
+                    "multi-LoRA + beam search are not combined")
+        if req.grammar is not None:
+            if req.num_beams > 1:
+                raise NotImplementedError(
+                    "grammar-constrained decoding + beam search are not "
+                    "combined (beam tokens come from the select, not "
+                    "the sampler)")
+            if not (hasattr(req.grammar, "bias")
+                    and hasattr(req.grammar, "advance")):
+                raise ValueError("req.grammar must be a "
+                                 "serving.grammar.TokenMaskAutomaton")
+            if len(req.grammar.vocab) != self.model.cfg.vocab_size:
+                raise ValueError(
+                    f"grammar vocab {len(req.grammar.vocab)} != model "
+                    f"vocab {self.model.cfg.vocab_size}")
         rid = self.sched.enqueue(req)
         REQUESTS.submit(req, source="engine")        # idempotent re-submit
         REQUESTS.event(req, "queued", replica=self.trace_name,
@@ -456,6 +502,7 @@ class LLMEngine:
             slot, _ = self.prefilling.pop(req_id)
             self.mgr.free(req_id)
             self.slot_req[slot] = -1
+            self._release_adapter(req_id)
             return True
         if req_id in self.groups:
             g = self.groups.pop(req_id)
@@ -474,6 +521,9 @@ class LLMEngine:
         self.active[slot] = False
         self.slot_req[slot] = -1
         self.draft_cur[slot] = 0
+        self.slot_aidx[slot] = -1
+        self._grammar.pop(slot, None)
+        self._release_adapter(req_id)
         return True
 
     def release_request(self, rid: int):
@@ -525,6 +575,8 @@ class LLMEngine:
         shows up here as missing blocks."""
         assert not self.has_work(), "engine still has work"
         self.kv.assert_quiescent()
+        assert not self._adapter_pins, \
+            f"adapter pin leak: {self._adapter_pins}"
 
     def _pr(self, req) -> np.ndarray:
         """Effective prompt: the resume form (original prompt + tokens
@@ -557,6 +609,78 @@ class LLMEngine:
         live = self.mgr.blocks_needed(
             min(total, self.window + 2 * self.block_size))
         return max(self.mgr.blocks_needed(p), live)
+
+    # --------------------------------------- multi-LoRA / grammar state
+    def _multilora_on(self) -> bool:
+        """PT_MULTILORA=0 kill switch (checked per use, so it also
+        disables a live engine): off — or no store — means every forward
+        gets lora=None and traces the exact base program."""
+        return (self.adapter_store is not None
+                and os.environ.get("PT_MULTILORA", "1") != "0")
+
+    def _release_adapter(self, rid: int):
+        """Drop the ref-count pin the scheduler took at admission (idempotent
+        — every detach/finish/preempt path calls it)."""
+        aid = self._adapter_pins.pop(rid, None)
+        if aid is not None and self.adapter_store is not None:
+            self.adapter_store.release(aid)
+
+    def _req_aidx(self, req) -> int:
+        """Cache index of the request's pinned adapter (-1 = base path).
+        Pinned entries are never evicted, so the index is stable for the
+        request's whole slot tenure."""
+        if req.req_id in self._adapter_pins and self._multilora_on():
+            return self.adapter_store.index_of(req.adapter_id)
+        return -1
+
+    def _lora_arg(self, aidx, width: int):
+        """The per-row lora pytree ``models.paged._lora_delta`` consumes,
+        or None when no row carries an adapter (the None path traces the
+        exact base program — bit-exactness by construction). ``aidx``:
+        per-row cache index (-1 = base); ``width``: padded tokens per row
+        in the forward — rows are contiguous token spans after the
+        perm+reshape, so group sizes are row-counts times width."""
+        if not self._multilora_on():
+            return None
+        aidx = np.asarray(aidx, np.int64)
+        if not (aidx >= 0).any():
+            return None
+        cap = self.adapter_store.capacity
+        order = np.argsort(np.where(aidx < 0, cap, aidx), kind="stable")
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        gs = np.bincount(aidx[aidx >= 0], minlength=cap) * width
+        lora = self.adapter_store.stacks()
+        lora["perm"] = jnp.asarray(order, jnp.int32)
+        lora["inv"] = jnp.asarray(inv, jnp.int32)
+        lora["gs"] = jnp.asarray(gs, jnp.int32)
+        lora["aidx"] = jnp.asarray(aidx, jnp.int32)
+        return lora
+
+    def _bind_grammar(self, slot: int, req):
+        """(Re)bind a slot's grammar state at activation. The state is a
+        pure function of the emitted tokens, so a resume or an install
+        replays ``req.tokens`` — preemption cannot drift the mask."""
+        if req.grammar is None:
+            self._grammar.pop(slot, None)
+            return
+        st = req.grammar.start_state
+        for t in req.tokens:
+            st = req.grammar.advance(st, int(t))
+        self._grammar[slot] = [req.grammar, st]
+
+    def _grammar_bias_rows(self, rows_slots, n_rows: int):
+        """[n_rows, V] logit bias (0 / -1e30) for the listed (row, slot)
+        pairs; None when no listed slot is grammar-bound — the sampler
+        then traces its unbiased program, bit-identical to pre-grammar."""
+        bound = [(i, s) for i, s in rows_slots if s in self._grammar]
+        if not bound:
+            return None
+        bias = np.zeros((n_rows, self.model.cfg.vocab_size), np.float32)
+        for i, s in bound:
+            aut, st = self._grammar[s]
+            bias[i] = aut.bias(st)
+        return bias
 
     # ---------------------------------------------------------- admission
     def _admit(self):
@@ -615,6 +739,8 @@ class LLMEngine:
                                 else req.temperature)
             self.top_ps[slot] = (self.default_top_p if req.top_p is None
                                  else req.top_p)
+            self.slot_aidx[slot] = self._req_aidx(req)
+            self._bind_grammar(slot, req)
             # fresh draft state unless the resident draft cache covers a
             # radix-adopted prefix (an evicted slot's draft cache was
             # "freed" by zeroing this frontier — replay rebuilds it)
@@ -634,7 +760,12 @@ class LLMEngine:
             slots[i] = bslots[0]
             rows[i] = grows[0]
             beams.append((g, grows, csrc, cdst))
-        logits = self.exe.prefill(ids, lens, slots, rows)
+        row_aidx = np.full(a_cap, -1, np.int64)
+        for i, (slot, _) in enumerate(admits):
+            row_aidx[i] = self.slot_aidx[slot]
+        logits = self.exe.prefill(
+            ids, lens, slots, rows,
+            lora=self._lora_arg(row_aidx, self.max_prompt_len))
         self._staged_admits = frozenset()   # scatter landed: evictable again
         # roofline: one weight pass; prompts attend causally from offset 0
         self._acc_phase("prefill", int(lens.sum()), 1,
@@ -644,7 +775,10 @@ class LLMEngine:
         for i, (slot, req) in enumerate(admits):
             row_temps[i] = self.temps[slot]
             row_tps[i] = self.top_ps[slot]
-        first = self.exe.sample(logits, row_temps, row_tps)
+        first = self.exe.sample(
+            logits, row_temps, row_tps,
+            bias=self._grammar_bias_rows(
+                [(i, slot) for i, (slot, _) in enumerate(admits)], a_cap))
         if self.window is not None:
             # a long prompt's below-window blocks die the moment prefill
             # has scattered them — and from here on the sequence can never
@@ -822,6 +956,7 @@ class LLMEngine:
         slots = np.full(a_cap, self.num_slots, np.int32)
         rows = np.full((a_cap, max_b), nb, np.int32)
         batch = list(self.prefilling.items())[:a_cap]
+        row_aidx = np.full(a_cap, -1, np.int64)
         progressed = False
         staged = set()       # rows already in the jitted batch: their KV
         for i, (rid, (slot, consumed)) in enumerate(batch):
@@ -843,6 +978,7 @@ class LLMEngine:
             offs[i] = consumed
             slots[i] = slot
             rows[i, :len(t)] = t
+            row_aidx[i] = self._req_aidx(req)
         if (not progressed and not self.active.any() and not self.groups):
             # nothing decoded this tick and no prefill row got blocks even
             # though preemption could evict every OTHER prefill: the pool
@@ -861,7 +997,8 @@ class LLMEngine:
             # keeps the engine alive): the batch is all-sentinel, so the
             # padded chunk forward would scatter nothing — skip it
             return []
-        logits = self.exe.prefill_chunk(ids, lens, offs, slots, rows)
+        logits = self.exe.prefill_chunk(ids, lens, offs, slots, rows,
+                                        lora=self._lora_arg(row_aidx, cap))
         # padded sentinel rows burned device FLOPs on no request's behalf
         GOODPUT.waste("pad_rows", (a_cap - len(staged)) * cap)
         # roofline: one weight pass; each chunk attends its own tokens
@@ -888,13 +1025,20 @@ class LLMEngine:
                             else req.temperature)
                 row_p[i] = (self.default_top_p if req.top_p is None
                             else req.top_p)
-            first = self.exe.sample(logits, row_t, row_p)
+                # bind grammar BEFORE the first-token sample so the mask
+                # bias covers it (state replays req.tokens for resumes)
+                self._bind_grammar(slot, req)
+            first = self.exe.sample(
+                logits, row_t, row_p,
+                bias=self._grammar_bias_rows(
+                    [(i, s) for i, _, s in done_rows], a_cap))
             for i, rid, slot in done_rows:
                 req = self.requests[rid]
                 del self.prefilling[rid]
                 p = self._pr(req)
                 if self.prefix_caching:
-                    self.mgr.commit_prefix(rid, p)
+                    self.mgr.commit_prefix(rid, p,
+                                           adapter=req.adapter_id)
                 t = self.mgr.tables[rid]
                 self.active[slot] = True
                 self.cur[slot] = len(p)
@@ -905,6 +1049,7 @@ class LLMEngine:
                 self.table_len[slot] = len(t)
                 self.temps[slot] = row_t[i]
                 self.top_ps[slot] = row_p[i]
+                self.slot_aidx[slot] = self._req_aidx(req)
                 # cached/long prompts land here — the site where a radix
                 # adoption can seed the draft frontier from resident K/V
                 self._seed_draft(slot, req)
@@ -1224,6 +1369,7 @@ class LLMEngine:
         slot_ids = np.full(ns, ns, np.int32)
         rows = np.full((ns, self.max_blocks_per_seq), self.mgr.num_blocks,
                        np.int32)
+        v_aidx = np.full(ns, -1, np.int64)
         for slot, rid, k_eff in staged:
             ids[slot, 0] = self.last_tok[slot]
             ids[slot, 1: 1 + k_eff] = props[slot][:k_eff]
@@ -1232,6 +1378,7 @@ class LLMEngine:
             slot_ids[slot] = slot
             t = self.mgr.tables[rid]
             rows[slot, :len(t)] = t
+            v_aidx[slot] = self.slot_aidx[slot]
         try:
             # chaos hook BEFORE the donating jit: an exception here must
             # leave self.cache intact (exception atomicity) — after the
@@ -1267,7 +1414,8 @@ class LLMEngine:
         with self._tick_timer("verify"), \
                 _span("serving.verify", slots=len(staged)):
             logits = np.asarray(self.exe.verify_chunk(
-                ids, clens, offs, slot_ids, rows).astype(jnp.float32))
+                ids, clens, offs, slot_ids, rows,
+                lora=self._lora_arg(v_aidx, C)).astype(jnp.float32))
         self.stats["device_s"] += time.perf_counter() - t_dev
         # whole sentinel rows of the fixed-shape verify batch are waste
         GOODPUT.waste("pad_rows", (ns - len(staged)) * C)
@@ -1282,18 +1430,44 @@ class LLMEngine:
         for slot, rid, k_eff in staged:
             temp = float(self.temps[slot])
             row = logits[slot]                        # [C, V]
+            # grammar slots: reject mask-violating drafts BEFORE the
+            # accept law ever sees them (k_use truncates at the first
+            # illegal proposal), then bias each verify position with the
+            # mask of the state reached by accepting the proposals ahead
+            # of it — the accept rule compares against EXACTLY the
+            # masked distribution the non-spec tick samples from, so
+            # speculation cannot change the constrained output law
+            g = self._grammar.get(slot)
+            gb, k_use = None, k_eff
+            if g is not None:
+                aut, st = g[0], g[1]
+                gb, k_use = [], 0
+                for i in range(k_eff):
+                    b = aut.bias(st)
+                    gb.append(b)
+                    t_i = int(props[slot][i])
+                    if b[t_i] != 0.0:
+                        _GRAMMAR_SPEC_REJECTS.inc(k_eff - i)
+                        break
+                    st = aut.advance(st, t_i)
+                    k_use += 1
+                if k_use == k_eff:
+                    gb.append(aut.bias(st))   # the bonus position's mask
             if temp == 0.0:
-                vs = row[: k_eff + 1].argmax(axis=-1)
-                n_acc = int(greedy_accept_length(vs[:k_eff],
-                                                 props[slot][:k_eff]))
+                vrow = (row[: k_use + 1] if gb is None
+                        else row[: k_use + 1] + np.asarray(gb, np.float32))
+                vs = vrow.argmax(axis=-1)
+                n_acc = int(greedy_accept_length(vs[:k_use],
+                                                 props[slot][:k_use]))
                 new = [int(x) for x in props[slot][:n_acc]] \
                     + [int(vs[n_acc])]
             else:
-                ps = [self._spec_probs(row[i], temp,
-                                       float(self.top_ps[slot]))
-                      for i in range(k_eff + 1)]
+                ps = [self._spec_probs(
+                          row[i] if gb is None else row[i] + gb[i],
+                          temp, float(self.top_ps[slot]))
+                      for i in range(k_use + 1)]
                 new, n_acc = stochastic_accept_row(
-                    props[slot][:k_eff], qs[slot], ps, self._spec_rs)
+                    props[slot][:k_use], qs[slot], ps, self._spec_rs)
             cur0 = int(self.cur[slot])
             cur1 = cur0 + n_acc + 1
             self.cur[slot] = cur1
@@ -1388,6 +1562,15 @@ class LLMEngine:
         req.tokens.append(token)
         _TOKENS.inc()
         GOODPUT.good(1)
+        if req.tenant_id is not None:
+            _TENANT_TOKENS.inc(tenant=str(req.tenant_id))
+        g = self._grammar.get(slot)
+        if g is not None:
+            # advance the mask state past the committed token (EOS keeps
+            # the state; an illegal token here would be a sampler bug and
+            # raises loudly rather than derail the automaton silently)
+            g[1] = g[0].advance(g[1], token)
+            _GRAMMAR_TOKENS.inc()
         now = self._clock()
         if req._first_tok_t is None:
             req._first_tok_t = now
@@ -1417,11 +1600,15 @@ class LLMEngine:
                 seq = np.concatenate([req.prompt,
                                       np.asarray(req.tokens, np.int32)])
                 self.mgr.commit_prefix(
-                    rid, seq[:min(len(seq), int(self.cur[slot]))])
+                    rid, seq[:min(len(seq), int(self.cur[slot]))],
+                    adapter=req.adapter_id)
             self.mgr.free(rid)
             self.kv.release(rid)
             self.active[slot] = False
             self.slot_req[slot] = -1
+            self.slot_aidx[slot] = -1
+            self._grammar.pop(slot, None)
+            self._release_adapter(rid)
             REQUESTS.event(req, "kv_peak", replica=self.trace_name,
                            blocks=self.kv.take_peak(rid))
             REQUESTS.finish(req, req.finish_reason,
@@ -1443,6 +1630,11 @@ class LLMEngine:
         slot = int(slots[0])
         if self.is_beam[slot] or not self.active[slot]:
             raise ValueError(f"req {rid} holds no active greedy slot")
+        if rid in self._adapter_pins:
+            raise NotImplementedError(
+                "cannot extract a multi-LoRA sequence — its KV was "
+                "written under the adapter, and the receiving replica "
+                "holds no pin on it")
         t = self.mgr.tables[rid]
         if any(b is None for b in t):
             raise NotImplementedError(
@@ -1466,6 +1658,8 @@ class LLMEngine:
         self.active[slot] = False
         self.slot_req[slot] = -1
         self.draft_cur[slot] = 0
+        self.slot_aidx[slot] = -1
+        self._grammar.pop(slot, None)
         self.sched.release(rid)
         return payload
 
@@ -1481,6 +1675,11 @@ class LLMEngine:
                 "engine is draining — finishing in-flight requests, "
                 "admitting nothing new")
         req = payload.req
+        if req.adapter_id is not None:
+            raise NotImplementedError(
+                "multi-LoRA sequences do not ride the KV handoff (the "
+                "payload's KV depends on adapter weights this engine "
+                "has not pinned)")
         if payload.block_size != self.block_size:
             raise ValueError(f"block_size mismatch: payload "
                              f"{payload.block_size} != {self.block_size}")
@@ -1536,6 +1735,10 @@ class LLMEngine:
                              else req.top_p)
         self._adm_counter += 1
         self.adm_order[slot] = self._adm_counter
+        self.slot_aidx[slot] = -1
+        # a grammar request resumes mid-stream: the mask state replays
+        # the tokens it generated on the prefill replica
+        self._bind_grammar(slot, req)
         # empty draft frontier: the decode replica's spec path re-feeds
         # the whole committed sequence through its own draft cache
         self.draft_cur[slot] = 0
@@ -1733,10 +1936,15 @@ class LLMEngine:
         self._acc_phase("decode", int(run_mask.sum()), 1,
                         self._ctx_blocks(run_mask))
         t1 = time.perf_counter()
+        d_aidx = np.where(run_mask, self.slot_aidx, -1)
+        d_bias = self._grammar_bias_rows(
+            [(int(s), int(s)) for s in np.nonzero(run_mask)[0]],
+            self.num_slots)
         with self._tick_timer("sample"):
             nxt, logp = self.exe.decode_tick(
                 self.last_tok, run_mask, rows, cols, vals, self.temps,
-                self.top_ps, bool(self.groups))
+                self.top_ps, bool(self.groups),
+                lora=self._lora_arg(d_aidx, 1), bias=d_bias)
             was_active = run_mask.copy()
             nxt = np.asarray(nxt)             # the one per-tick host fetch
         t2 = time.perf_counter()

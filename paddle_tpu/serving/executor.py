@@ -56,30 +56,32 @@ class ModelExecutor:
         return sub
 
     # ------------------------------------------------------------ prefill
-    def prefill(self, ids, lens, slots, rows):
+    def prefill(self, ids, lens, slots, rows, lora=None):
         """Slot-aware padded prefill: admitted prompts scattered into
-        their cache slots while other slots keep decoding state."""
+        their cache slots while other slots keep decoding state.
+        ``lora`` (optional pytree, see ``models.paged._lora_delta``)
+        applies the batched multi-LoRA correction per row."""
         logits, self.cache = _PREFILL_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
-            self.cache, jnp.asarray(slots), jnp.asarray(rows))
+            self.cache, jnp.asarray(slots), jnp.asarray(rows), lora=lora)
         return logits
 
-    def prefill_chunk(self, ids, lens, offs, slots, rows):
+    def prefill_chunk(self, ids, lens, offs, slots, rows, lora=None):
         """One chunk per row, written from an arbitrary offset over the
         slot's pool prefix (chunked prefill / prefix-cache resume)."""
         logits, self.cache = _PREFILL_CHUNK_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             jnp.asarray(offs), self.cache, jnp.asarray(slots),
-            jnp.asarray(rows))
+            jnp.asarray(rows), lora=lora)
         return logits
 
-    def verify_chunk(self, ids, clens, offs, slot_ids, rows):
+    def verify_chunk(self, ids, clens, offs, slot_ids, rows, lora=None):
         """Target forward over each slot's proposal window (spec decode);
         shares the chunked-prefill program shape."""
         logits, self.cache = _VERIFY_CHUNK_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(clens),
             jnp.asarray(offs), self.cache, jnp.asarray(slot_ids),
-            jnp.asarray(rows))
+            jnp.asarray(rows), lora=lora)
         return logits
 
     def rewind_lens(self, slots, lens):
@@ -89,16 +91,19 @@ class ModelExecutor:
 
     # ------------------------------------------------------------- decode
     def decode_tick(self, last_tok, run_mask, rows, cols, vals, temps,
-                    top_ps, need_logp):
+                    top_ps, need_logp, lora=None, bias=None):
         """The fused one-token tick: incremental table update + paged
         attention + on-device sampling. Returns (sampled [num_slots],
-        logp [num_slots, vocab] or None per ``need_logp``)."""
+        logp [num_slots, vocab] or None per ``need_logp``). ``lora`` is
+        the per-slot multi-LoRA pytree; ``bias`` a [num_slots, V]
+        grammar-mask logit bias applied before sampling."""
         sub = self.next_key()
         nxt, logp, self.cache = _TICK_JIT(
             self.model, jnp.asarray(last_tok), self.cache,
             jnp.asarray(run_mask), jnp.asarray(rows), jnp.asarray(cols),
             jnp.asarray(vals), sub, jnp.asarray(temps),
-            jnp.asarray(top_ps), self.top_k, need_logp)
+            jnp.asarray(top_ps), self.top_k, need_logp, lora=lora,
+            logit_bias=(None if bias is None else jnp.asarray(bias)))
         return nxt, logp
 
     def apply_block_copies(self, pairs):
@@ -124,12 +129,14 @@ class ModelExecutor:
             jnp.asarray(copy_dst))
 
     # ------------------------------------------------------------- sample
-    def sample(self, logits, temps, top_ps, key=None):
-        """Per-row temperature/top-k/top-p sampling (host fetch)."""
+    def sample(self, logits, temps, top_ps, key=None, bias=None):
+        """Per-row temperature/top-k/top-p sampling (host fetch).
+        ``bias`` ([rows, V], 0 / -1e30) is the grammar-mask addend."""
         sub = self.next_key() if key is None else key
         return np.asarray(_SAMPLE_ROWS_JIT(
             logits.astype(jnp.float32), sub, jnp.asarray(temps),
-            jnp.asarray(top_ps), self.top_k))
+            jnp.asarray(top_ps), self.top_k,
+            bias=(None if bias is None else jnp.asarray(bias))))
 
     # -------------------------------------------------------------- draft
     def draft_rows(self, ids, rp, cl):

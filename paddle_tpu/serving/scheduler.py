@@ -18,8 +18,10 @@ import numpy as np
 from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.observability.goodput import GOODPUT
 from paddle_tpu.observability.requests import REQUESTS
-from paddle_tpu.serving.telemetry import (_ADMITTED, _PREEMPTED,
-                                          _QUEUE_WAIT, _REJECTED)
+from paddle_tpu.serving.telemetry import (_ADAPTER_DEFERRALS, _ADMITTED,
+                                          _PREEMPTED, _QUEUE_WAIT,
+                                          _REJECTED, _TENANT_ADMITTED,
+                                          _TENANT_QUEUE_WAIT, _TENANT_WASTE)
 from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
                                       Request)
 
@@ -39,6 +41,21 @@ class Scheduler:
         self.clock = clock if clock is not None else time.monotonic
         self.draining = False
         self.has_deadlines = False
+        # fair multi-tenant admission (ISSUE 14): deficit accounting —
+        # each admission charges its tenant prompt+budget tokens, and
+        # the pick favours the queued tenant with the smallest
+        # charged/weight ratio. Empty while no request carries a
+        # tenant_id, in which case admission is EXACTLY the legacy FCFS.
+        self.tenant_weights: dict = {}       # tenant -> share weight (1.0)
+        self.tenant_charged: dict = {}       # tenant -> tokens charged
+
+    def set_tenant_weight(self, tenant, weight: float):
+        """Relative admission share for a tenant (default 1.0). A tenant
+        with weight 2 is charged half as fast, so it wins the fair pick
+        twice as often under contention."""
+        if weight <= 0:
+            raise ValueError("tenant weight must be positive")
+        self.tenant_weights[tenant] = float(weight)
 
     # ------------------------------------------------------------- intake
     def check_backpressure(self, stats: dict):
@@ -134,20 +151,63 @@ class Scheduler:
         if (memo is not None and epoch is not None
                 and memo[0] == epoch and memo[1] == len(p)):
             return memo[2]
-        m = kv.mgr.match_prefix(p)
+        m = kv.mgr.match_prefix(p, adapter=req.adapter_id)
         if epoch is not None:
             req._match_memo = (epoch, len(p), m)
         return m
 
+    def _pick_index(self) -> int:
+        """Queue index of the next admission candidate. Pure FCFS (the
+        head) while no queued request carries a tenant_id — the legacy
+        ordering, byte-for-byte. Otherwise: token-budget-weighted fair
+        pick — the queued tenant with the smallest charged/weight deficit
+        wins, FIFO within the tenant. Starvation-free: every admission
+        charges the winner, so a saturating tenant's deficit climbs past
+        any light tenant's after finitely many admissions. A tenant first
+        seen mid-flight starts at the current MINIMUM charge (no
+        retroactive credit for time away)."""
+        if all(r.tenant_id is None for r in self.queue):
+            return 0
+        floor = min(self.tenant_charged.values(), default=0.0)
+        best_qi, best_key = 0, None
+        seen = set()
+        for qi, r in enumerate(self.queue):
+            t = r.tenant_id
+            if t in seen:
+                continue                   # FIFO within a tenant
+            seen.add(t)
+            w = self.tenant_weights.get(t, 1.0)
+            key = self.tenant_charged.setdefault(t, floor) / w
+            if best_key is None or key < best_key:
+                best_qi, best_key = qi, key
+        return best_qi
+
+    def _charge_tenant(self, req, p):
+        """Deficit charge at admission: prompt + remaining budget — the
+        worst-case token footprint this admission can consume. Replays
+        charge again: a preempted request's re-admission consumes real
+        capacity a second time."""
+        t = req.tenant_id
+        if t is None:
+            return
+        floor = min(self.tenant_charged.values(), default=0.0)
+        gen = max(0, req.max_new_tokens - len(req.tokens))
+        self.tenant_charged[t] = (self.tenant_charged.get(t, floor)
+                                  + len(p) + gen)
+
     def select_admissions(self, eng):
-        """FCFS: move queued requests into free slots while the pool can
-        cover their worst case; returns (greedy (slot, req) pairs,
-        beam (slots, req) pairs). A beam request needs num_beams slots."""
+        """Move queued requests into free slots while the pool can cover
+        their worst case; returns (greedy (slot, req) pairs, beam (slots,
+        req) pairs). A beam request needs num_beams slots. Candidate
+        order is ``_pick_index`` — legacy FCFS without tenants, weighted
+        fair share with them; a blocked candidate stops admission for the
+        tick (capacity pressure must not starve the fair winner)."""
         kv = eng.kv
         free_slots = list(np.nonzero(eng.slot_req < 0)[0])
         admits, beam_admits = [], []
         while self.queue and free_slots:
-            req = self.queue[0]
+            qi = self._pick_index()
+            req = self.queue[qi]
             k = req.num_beams
             p = eng._pr(req)
             # prefix-cache lookup BEFORE the capacity gate: shared blocks
@@ -174,13 +234,38 @@ class Scheduler:
                 # stall forensics: which ledger state holds the blocks
                 # (or slots) the queue head is waiting on
                 kv.record_stall(need, slots_short=(k > len(free_slots)))
-                break                      # FCFS: do not starve the head
-            self.queue.popleft()
+                break                      # do not starve the fair winner
+            if req.adapter_id is not None and eng._multilora_on():
+                # make the adapter device-resident and PIN it before the
+                # request can touch a slot. Failure (cache fully pinned,
+                # or an injected serving.adapter_swap fault) defers the
+                # admission — the request stays queued, retried next tick,
+                # and nothing was mutated (the fault site fires
+                # pre-upload; acquire is exception-atomic)
+                try:
+                    eng.adapter_store.acquire(req.adapter_id)
+                except Exception as e:
+                    _ADAPTER_DEFERRALS.inc()
+                    FLIGHT.record("serving.adapter_defer",
+                                  rid=req.req_id,
+                                  adapter=str(req.adapter_id),
+                                  err=f"{type(e).__name__}: {e}")
+                    break
+                eng._adapter_pins[req.req_id] = req.adapter_id
+            del self.queue[qi]
             req._match_memo = None
             req._adopted = ct if k == 1 else 0
             _ADMITTED.inc()
-            if req._submit_t is not None:
-                _QUEUE_WAIT.observe(max(0.0, self.clock() - req._submit_t))
+            self._charge_tenant(req, p)
+            wait = (max(0.0, self.clock() - req._submit_t)
+                    if req._submit_t is not None else None)
+            if wait is not None:
+                _QUEUE_WAIT.observe(wait)
+            if req.tenant_id is not None:
+                _TENANT_ADMITTED.inc(tenant=str(req.tenant_id))
+                if wait is not None:
+                    _TENANT_QUEUE_WAIT.observe(wait,
+                                               tenant=str(req.tenant_id))
             # token-level hit accounting: every cached token is prefill
             # device work the pool did NOT have to repeat
             GOODPUT.saved(ct)
@@ -188,6 +273,10 @@ class Scheduler:
                 # replayed after preemption: every resume token past the
                 # prefix-cache hit is device work already paid for once
                 GOODPUT.waste("replay_prefill", max(0, len(p) - ct))
+                if req.tenant_id is not None:
+                    _TENANT_WASTE.inc(max(0, len(p) - ct),
+                                      tenant=str(req.tenant_id),
+                                      why="replay_prefill")
                 REQUESTS.event(req, "replayed",
                                replica=getattr(eng, "trace_name", None),
                                resume_tokens=len(p), cached_tokens=ct)
@@ -218,7 +307,8 @@ class Scheduler:
                     continue
                 kv.allocate(req.req_id, len(p))
                 if eng.prefix_caching:
-                    kv.mgr.commit_prefix(req.req_id, p)
+                    kv.mgr.commit_prefix(req.req_id, p,
+                                          adapter=req.adapter_id)
                 kv.update(req.req_id)
                 admits.append((slot, req))
             else:
@@ -280,9 +370,11 @@ class Scheduler:
             # the chunks already scattered are finished device work —
             # commit them so the replay prefill re-matches instead of
             # recomputing (replay_prefill waste shrinks to the tail)
-            eng.kv.mgr.commit_prefix(rid, eng._pr(req)[:consumed])
+            eng.kv.mgr.commit_prefix(rid, eng._pr(req)[:consumed],
+                                     adapter=req.adapter_id)
         eng.kv.free(rid)
         eng.kv.release(rid)
+        eng._release_adapter(rid)
         eng.slot_req[slot] = -1
         self.queue.appendleft(req)
         eng.stats["preemptions"] += 1
@@ -319,9 +411,11 @@ class Scheduler:
             # KV is not scattered yet, so it must not be committed
             eng.kv.mgr.commit_prefix(
                 rid, req._resume[:min(len(req._resume),
-                                      int(eng.cur[slot]))])
+                                      int(eng.cur[slot]))],
+                adapter=req.adapter_id)
         eng.kv.free(rid)
         eng.kv.release(rid)
+        eng._release_adapter(rid)
         eng.active[slot] = False
         eng.slot_req[slot] = -1
         eng.draft_cur[slot] = 0     # draft cache freed with the slot

@@ -115,6 +115,52 @@ _MOE_DROPPED = METRICS.counter(
     "moe_dropped_tokens_total",
     "MoE routing assignments dropped at expert capacity")
 
+# ---------------------------------------------- multi-tenancy (ISSUE 14)
+# per-tenant accounting: the fair scheduler charges token budgets at
+# admission and these break the engine's aggregate goodput/waste story
+# down by tenant — a saturating tenant's waste must not hide in totals
+_TENANT_TOKENS = METRICS.counter(
+    "serving_tenant_tokens_total", "tokens emitted, by tenant",
+    labelnames=("tenant",))
+_TENANT_ADMITTED = METRICS.counter(
+    "serving_tenant_admissions_total", "requests admitted, by tenant",
+    labelnames=("tenant",))
+_TENANT_QUEUE_WAIT = METRICS.histogram(
+    "serving_tenant_queue_wait_seconds",
+    "submission → admission (engine clock), by tenant",
+    labelnames=("tenant",))
+_TENANT_WASTE = METRICS.counter(
+    "serving_tenant_waste_tokens_total",
+    "wasted work, by tenant and cause (replay_prefill, spec_rejected)",
+    labelnames=("tenant", "why"))
+# adapter cache (batched multi-LoRA): device-resident stacked A/B slots
+_ADAPTER_UPLOADS = METRICS.counter(
+    "serving_adapter_uploads_total",
+    "host→device adapter uploads into the stacked LoRA cache")
+_ADAPTER_EVICTIONS = METRICS.counter(
+    "serving_adapter_evictions_total",
+    "resident adapters evicted (LRU) to make room for an upload")
+_ADAPTER_HITS = METRICS.counter(
+    "serving_adapter_cache_hits_total",
+    "adapter lookups served by the device-resident cache")
+_ADAPTER_MISSES = METRICS.counter(
+    "serving_adapter_cache_misses_total",
+    "adapter lookups that required a host→device upload")
+_ADAPTER_RESIDENT = METRICS.gauge(
+    "serving_adapter_resident", "adapters resident in the device cache")
+_ADAPTER_DEFERRALS = METRICS.counter(
+    "serving_adapter_admit_deferrals_total",
+    "admissions deferred because the adapter could not be made resident "
+    "(cache fully pinned, or an injected serving.adapter_swap fault)")
+# grammar-constrained decoding: mask bookkeeping
+_GRAMMAR_TOKENS = METRICS.counter(
+    "serving_grammar_tokens_total",
+    "tokens emitted under a grammar mask (all mask-legal by construction)")
+_GRAMMAR_SPEC_REJECTS = METRICS.counter(
+    "serving_grammar_spec_rejects_total",
+    "drafted tokens rejected by the grammar mask before the target "
+    "accept rule was consulted")
+
 # ------------------------------------------------------------- router
 _R_DISPATCH = METRICS.counter(
     "router_dispatch_total", "requests dispatched to a replica",

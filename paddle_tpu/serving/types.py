@@ -50,6 +50,20 @@ class Request:
     # router affinity (ISSUE 7): requests sharing a session_id stick to
     # one replica, so a session's prefix-cache blocks stay local
     session_id: object = None
+    # multi-tenancy (ISSUE 14):
+    #   adapter_id   LoRA adapter this request decodes under (must be
+    #                registered with the engine's AdapterStore); None =
+    #                the base model. Also part of the prefix-cache key —
+    #                KV blocks never cross adapter identities.
+    #   tenant_id    fair-scheduling identity: queued tenants share
+    #                admission capacity by token-budget-weighted deficit
+    #                (None = legacy FCFS ordering among the unlabelled)
+    #   grammar      constrained decoding: a TokenMaskAutomaton (or a
+    #                (regex, vocab) construction handled by the caller) —
+    #                every sampled/accepted token satisfies its mask
+    adapter_id: object = None
+    tenant_id: object = None
+    grammar: object = None
     # filled by the engine:
     tokens: list = field(default_factory=list)   # generated tokens
     done: bool = False

@@ -33,6 +33,12 @@ rule is installed). Tests install rules against site names:
                      frees its parked block — fires pre-mutation, so an
                      exception leaves the trie and free list untouched
                      (the allocation that triggered it fails cleanly)
+    serving.adapter_swap  before a host→device LoRA adapter upload into
+                     the stacked device cache (AdapterStore.ensure) —
+                     fires pre-mutation, so an exception leaves the
+                     cache, pins, and free list untouched; the scheduler
+                     defers that admission to a later tick (no leaked
+                     device cache entries, ``assert_quiescent`` clean)
     train.step       top of each trainer step (exception / stall)
     train.loss       loss override — return value replaces the real loss
                      (NaN injection)

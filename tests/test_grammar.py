@@ -1,0 +1,247 @@
+"""Grammar-constrained decoding (ISSUE 14).
+
+* regex parser + lazy DFA agree with Python's ``re`` over exhaustive
+  short strings for a spread of patterns (classes, counters, alts)
+* TokenMaskAutomaton surface: bias is exactly 0 / -1e30, EOS is legal
+  iff accepting (with the no-continuation escape hatch), illegal
+  ``advance`` raises
+* ``json_schema_regex`` end-to-end: masked decoding can only spell
+  canonical instances of the schema
+* engine level: greedy, temperature>0, and SPECULATIVE decoding emit
+  only mask-legal tokens; spec greedy under a grammar is token-for-token
+  identical to non-spec greedy under the same grammar
+"""
+import json
+import re
+import string
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import LLMEngine, Request
+from paddle_tpu.serving.grammar import (TokenMaskAutomaton,
+                                        json_schema_regex, regex_escape)
+
+# 63 single-char tokens + one empty-string EOS token = vocab_size 64
+CHARS = (string.digits + string.ascii_lowercase
+         + string.ascii_uppercase[:19] + '{}":,-._')
+VOCAB = list(CHARS) + [""]
+EOS = 63
+assert len(VOCAB) == 64 and len(set(CHARS)) == 63
+
+ENG = dict(num_slots=3, block_size=4, max_prompt_len=16, max_seq_len=24)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+def aut_for(pattern):
+    return TokenMaskAutomaton(pattern, vocab=VOCAB, eos_token_id=EOS)
+
+
+def dfa_accepts(aut, s):
+    """Drive the automaton one char-token at a time; legality must agree
+    with the mask at every step."""
+    sid = aut.start_state
+    for ch in s:
+        tid = VOCAB.index(ch)
+        if not aut.mask(sid)[tid]:
+            return False
+        sid = aut.advance(sid, tid)
+    return aut.accepting(sid)
+
+
+# -------------------------------------------------------- parser vs re
+@pytest.mark.parametrize("pattern", [
+    "ab|ac", "a(b|c)*d", "[a-c]{2,3}", "a?b+c*", "\\d+",
+    "-?\\d+(\\.\\d+)?", "[^ab]c", "(ab){2}", "a{2,}b",
+])
+def test_dfa_agrees_with_re(pattern):
+    aut = aut_for(pattern)
+    gold = re.compile(pattern)
+    alphabet = "abcd01."
+    pool = [""]
+    for _ in range(4):
+        pool = [s + c for s in pool for c in alphabet] + pool
+    for s in set(pool):
+        assert dfa_accepts(aut, s) == bool(gold.fullmatch(s)), (pattern, s)
+
+
+def test_regex_escape_literal_roundtrip():
+    raw = 'a.b{c}"d-e'
+    aut = aut_for(regex_escape(raw))
+    assert dfa_accepts(aut, raw)
+    assert not dfa_accepts(aut, 'azb{c}"d-e')   # '.' escaped: not a wildcard
+
+
+# ----------------------------------------------------- automaton surface
+def test_bias_values_and_mask_consistency():
+    aut = aut_for("[ab]{2}")
+    b = aut.bias(aut.start_state)
+    m = aut.mask(aut.start_state)
+    assert b.dtype == np.float32 and b.shape == (64,)
+    assert set(np.unique(b)) <= {np.float32(0.0), np.float32(-1e30)}
+    np.testing.assert_array_equal(b == 0.0, m)
+    legal = {VOCAB.index("a"), VOCAB.index("b")}
+    assert set(np.nonzero(m)[0]) == legal          # EOS illegal: not accepting
+
+
+def test_eos_iff_accepting_and_illegal_advance_raises():
+    aut = aut_for("ab")
+    s0 = aut.start_state
+    assert not aut.mask(s0)[EOS]
+    s1 = aut.advance(s0, VOCAB.index("a"))
+    assert not aut.mask(s1)[EOS]
+    s2 = aut.advance(s1, VOCAB.index("b"))
+    assert aut.accepting(s2) and aut.mask(s2)[EOS]
+    assert aut.advance(s2, EOS) == s2              # EOS keeps the state
+    with pytest.raises(ValueError, match="illegal"):
+        aut.advance(s0, VOCAB.index("b"))
+
+
+def test_eos_escape_hatch_when_vocab_cannot_continue():
+    # '~' is spellable by no token: after 'a' the state is live but
+    # stuck, so EOS becomes the only way out
+    aut = aut_for("a~")
+    s1 = aut.advance(aut.start_state, VOCAB.index("a"))
+    m = aut.mask(s1)
+    assert m[EOS] and m.sum() == 1
+
+
+def test_empty_and_impossible_patterns():
+    with pytest.raises(ValueError):
+        aut_for("[b-a]")
+    with pytest.raises(ValueError):
+        TokenMaskAutomaton(vocab=VOCAB)            # neither regex nor schema
+    with pytest.raises(ValueError):
+        TokenMaskAutomaton("a", json_schema={"type": "string"}, vocab=VOCAB)
+
+
+# ------------------------------------------------------------ JSON schema
+def test_json_schema_regex_shapes():
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "b": {"enum": ["x", "y"]},
+                             "ok": {"type": "boolean"}}}
+    aut = TokenMaskAutomaton(json_schema=schema, vocab=VOCAB,
+                             eos_token_id=EOS)
+    good = '{"a":-12,"b":"y","ok":true}'
+    assert dfa_accepts(aut, good)
+    assert json.loads(good) == {"a": -12, "b": "y", "ok": True}
+    assert not dfa_accepts(aut, '{"b":"y","a":-12,"ok":true}')   # key order
+    assert not dfa_accepts(aut, '{"a":1.5,"b":"x","ok":true}')   # not int
+    assert not dfa_accepts(aut, '{"a":1,"b":"z","ok":true}')     # enum miss
+
+
+def test_json_schema_standalone_leaves():
+    aut = TokenMaskAutomaton(json_schema={"type": "number"}, vocab=VOCAB,
+                             eos_token_id=EOS)
+    assert dfa_accepts(aut, "-3.25") and dfa_accepts(aut, "7")
+    assert not dfa_accepts(aut, "3.")
+    with pytest.raises(ValueError):
+        json_schema_regex({"type": "array"})
+
+
+# -------------------------------------------------------------- engine
+def _replay_legal(aut, tokens):
+    """Every emitted token must be mask-legal from the replayed state."""
+    sid = aut.start_state
+    for t in tokens:
+        assert aut.mask(sid)[int(t)], (t, VOCAB[int(t)])
+        sid = aut.advance(sid, int(t))
+    return sid
+
+
+def _decode(tokens):
+    return "".join(VOCAB[int(t)] for t in tokens if int(t) != EOS)
+
+
+def test_engine_greedy_respects_grammar(model):
+    p = np.arange(1, 6, dtype=np.int32)
+    free = LLMEngine(model, eos_token_id=EOS, **ENG)
+    rid = free.add_request(Request(p, max_new_tokens=6))
+    unconstrained = free.run()[rid]
+
+    aut = aut_for("[ab]{3}")
+    eng = LLMEngine(model, eos_token_id=EOS, **ENG)
+    rid = eng.add_request(Request(p, max_new_tokens=6, grammar=aut))
+    out = eng.run()[rid]
+    eng.assert_quiescent()
+    sid = _replay_legal(aut, out)
+    assert aut.accepting(sid)
+    assert re.fullmatch("[ab]{3}", _decode(out))
+    assert eng.requests[rid].finish_reason == "eos"  # exact counter: forced
+    assert out != unconstrained                      # the mask actually bound
+
+
+def test_engine_sampled_respects_grammar(model):
+    p = np.arange(2, 8, dtype=np.int32)
+    aut = aut_for("[ab]{8}")
+    eng = LLMEngine(model, eos_token_id=EOS, **ENG)
+    rids = [eng.add_request(Request(p, max_new_tokens=5, grammar=aut,
+                                    temperature=1.0, top_p=0.9))
+            for _ in range(3)]
+    out = eng.run()
+    eng.assert_quiescent()
+    for rid in rids:
+        _replay_legal(aut, out[rid])
+        assert len(out[rid]) == 5                    # never accepting: no EOS
+
+
+def test_engine_spec_decode_respects_grammar_and_matches_nonspec(model):
+    """Spec decoding under a grammar: drafts violating the mask must be
+    rejected before the accept law, so greedy output is token-for-token
+    the non-spec grammar-constrained stream."""
+    from paddle_tpu.serving.telemetry import _GRAMMAR_SPEC_REJECTS
+    p = np.arange(3, 9, dtype=np.int32)
+    aut = aut_for("(ab|ba){4}")
+    plain = LLMEngine(model, eos_token_id=EOS, **ENG)
+    r0 = plain.add_request(Request(p, max_new_tokens=6, grammar=aut))
+    want = plain.run()[r0]
+
+    before = _GRAMMAR_SPEC_REJECTS.value()
+    eng = LLMEngine(model, draft_model=model, spec_k=4, eos_token_id=EOS,
+                    **ENG)
+    r1 = eng.add_request(Request(p, max_new_tokens=6, grammar=aut))
+    got = eng.run()[r1]
+    eng.assert_quiescent()
+    assert got == want
+    _replay_legal(aut, got)
+    assert eng.stats["spec_ticks"] > 0
+    assert _GRAMMAR_SPEC_REJECTS.value() >= before   # counter never regresses
+
+
+def test_engine_mixed_grammar_and_free_rows(model):
+    """A grammar row and free rows decode in the same ticks; the free
+    rows are untouched by the neighbour's bias."""
+    p = np.arange(1, 6, dtype=np.int32)
+    free = LLMEngine(model, eos_token_id=EOS, **ENG)
+    rf = free.add_request(Request(p, max_new_tokens=4))
+    want_free = free.run()[rf]
+
+    aut = aut_for("[ab]{8}")
+    eng = LLMEngine(model, eos_token_id=EOS, **ENG)
+    rg = eng.add_request(Request(p, max_new_tokens=4, grammar=aut))
+    rf2 = eng.add_request(Request(p, max_new_tokens=4))
+    out = eng.run()
+    eng.assert_quiescent()
+    assert out[rf2] == want_free
+    _replay_legal(aut, out[rg])
+
+
+def test_add_request_validates_grammar(model):
+    eng = LLMEngine(model, **ENG)
+    short = TokenMaskAutomaton("[ab]*", vocab=VOCAB[:32], eos_token_id=31)
+    with pytest.raises(ValueError):
+        eng.add_request(Request(np.arange(3), grammar=short))  # vocab size
+    with pytest.raises(ValueError):
+        eng.add_request(Request(np.arange(3), grammar=aut_for("[ab]*"),
+                                num_beams=2))
