@@ -154,7 +154,8 @@ def cpu_legs_main():
                     ("serving_moe", bench_serving_moe),
                     ("serving_router", bench_serving_router),
                     ("serving_prefix", bench_serving_prefix),
-                    ("serving_multilora", bench_serving_multilora)):
+                    ("serving_multilora", bench_serving_multilora),
+                    ("serving_degradation", bench_serving_degradation)):
         try:
             out[key] = fn()
         except Exception as e:  # noqa: BLE001 — per-leg isolation
@@ -166,6 +167,7 @@ def cpu_legs_main():
         if k.startswith(("serving_spec_", "serving_prefix_",
                          "serving_pallas_", "serving_adapter_",
                          "serving_tenant_", "serving_grammar_",
+                         "serving_degrade_", "serving_session_",
                          "moe_", "router_"))}
     print(json.dumps(out))
 
@@ -1214,6 +1216,106 @@ def bench_serving_multilora():
     }
 
 
+def bench_serving_degradation():
+    """Graceful-degradation leg (ISSUE 16): goodput ratio and TTFT p95
+    under a seeded fault storm, ladder on vs ``PT_DEGRADE=0``. The
+    pressure source is real spec-decode waste: the draft model is an
+    independently initialized 1-layer net, so its proposals are mostly
+    rejected and every verify tick bleeds ``spec_rejected`` tokens —
+    exactly the failure mode L1 exists for. Seeded ``serving.alloc``
+    faults add preemption/replay churn on top. Both arms run the
+    identical seeded workload; the ladder arm notices the collapsing
+    windowed goodput ratio, climbs to L1, stops drafting and recovers
+    the ratio, while the kill-switch arm keeps paying for rejected
+    drafts all the way to the end. CPU-safe."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import GOODPUT
+    from paddle_tpu.serving import DegradationController, LLMEngine, Request
+    from paddle_tpu.utils.faults import FAULTS
+
+    pt.seed(0)
+    kw = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+              num_attention_heads=8, num_key_value_heads=4,
+              max_position_embeddings=256)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=4, **kw))
+    # an UNcalibrated draft: proposals mostly rejected, spec is a net
+    # loss — the pathological regime the ladder is supposed to catch
+    draft = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1, **kw))
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 512, (int(l),))
+               for l in rs.randint(8, 32, size=24)]
+    max_new = 24
+
+    def pressure_sig(c):
+        ratio, volume = c.window_goodput()
+        if volume < 32 or ratio != ratio:
+            return 0
+        return 1 if ratio < 0.8 else 0
+
+    def arm(ladder_on):
+        saved = os.environ.get("PT_DEGRADE")
+        os.environ["PT_DEGRADE"] = "1" if ladder_on else "0"
+        try:
+            # long down-patience: the rung that fixed the waste must not
+            # un-fix itself the moment the window it fixed looks healthy
+            ctrl = DegradationController(
+                signals=[("pressure", pressure_sig)],
+                up_patience=1, down_patience=64)
+            eng = LLMEngine(model, num_slots=8, block_size=8,
+                            max_prompt_len=32, max_seq_len=64,
+                            preemption=True, draft_model=draft, spec_k=3,
+                            degrade=ctrl)
+            FAULTS.schedule("serving.alloc", seed=7, p=0.05, horizon=200,
+                            exc=MemoryError)
+            g0, w0 = GOODPUT.good_total(), GOODPUT.waste_total()
+            ttft = {}
+            t0 = time.perf_counter()
+
+            def first_tok(req, tok):
+                ttft.setdefault(req.req_id, time.perf_counter() - t0)
+
+            for i, p in enumerate(prompts):
+                eng.add_request(Request(p, max_new_tokens=max_new,
+                                        tenant_id=f"t{i % 6}",
+                                        stream=first_tok))
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            g = GOODPUT.good_total() - g0
+            w = GOODPUT.waste_total() - w0
+            return {
+                "goodput_ratio": round(g / (g + w), 4) if g + w else None,
+                "ttft_p95_s": round(
+                    float(np.percentile(list(ttft.values()), 95)), 4),
+                "tokens_per_sec": round(
+                    sum(len(t) for t in out.values()) / dt, 1),
+                "all_finished": len(out) == len(prompts),
+                "peak_level": eng.degrade.peak_level,
+                "final_level": eng.degrade.level,
+                "transitions": len(eng.degrade.transitions),
+            }
+        finally:
+            FAULTS.clear("serving.alloc")
+            if saved is None:
+                os.environ.pop("PT_DEGRADE", None)
+            else:
+                os.environ["PT_DEGRADE"] = saved
+
+    arm(False)                              # warmup / compile
+    off = arm(False)
+    on = arm(True)
+    gain = (None if not (on["goodput_ratio"] and off["goodput_ratio"])
+            else round(on["goodput_ratio"] - off["goodput_ratio"], 4))
+    return {
+        "ladder_on": on, "ladder_off": off,
+        "goodput_gain": gain,
+        "win": bool(gain is not None and gain > 0),
+        "requests": len(prompts), "max_new_tokens": max_new,
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1418,6 +1520,8 @@ def main():
                                       "serving_adapter_",
                                       "serving_tenant_",
                                       "serving_grammar_",
+                                      "serving_degrade_",
+                                      "serving_session_",
                                       "moe_", "router_"))},
         "host_overlap": host_overlap,
         "serving_spec": serving_spec,

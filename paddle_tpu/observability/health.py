@@ -297,6 +297,21 @@ def install_default_rules(ev: HealthEvaluator,
             description="p95 host-bookkeeping share of an engine tick "
                         "(the tick-anatomy remainder after prefill/"
                         "draft/verify/sample device phases)")
+    ev.rule("serving_degrade_level",
+            gauge_value("serving_degrade_level", registry),
+            warn=2, crit=4,
+            description="degradation-ladder rung: L2+ is shrinking "
+                        "prefill budgets, L4 rejects new sessions. NOTE "
+                        "this rule reads the gauge the controller "
+                        "writes — never feed THIS evaluator back into "
+                        "DegradationController(health=...), or the rung "
+                        "becomes its own input and latches")
+    ev.rule("router_hedge_rate",
+            gauge_value("router_hedge_rate", registry),
+            warn=0.2, crit=0.6,
+            description="hedged / successful KV handoffs (lifetime): "
+                        "sustained hedging means a straggling decode "
+                        "replica or transport link")
     return ev
 
 

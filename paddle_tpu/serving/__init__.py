@@ -29,6 +29,8 @@ from paddle_tpu.observability.flight import FLIGHT  # noqa: F401
 from paddle_tpu.utils.faults import fault_point  # noqa: F401
 
 from paddle_tpu.serving.adapters import AdapterStore  # noqa: F401
+from paddle_tpu.serving.degrade import (  # noqa: F401
+    DegradationController, SessionSnapshot, default_signals)
 from paddle_tpu.serving.engine import LLMEngine  # noqa: F401
 from paddle_tpu.serving.grammar import (  # noqa: F401
     TokenMaskAutomaton, json_schema_regex)
@@ -46,13 +48,18 @@ from paddle_tpu.serving.telemetry import (  # noqa: F401
     _SPEC_PROPOSED, _SPEC_RATE, _SPEC_TOKENS, _TICK, _TIMEOUTS, _TOK_LAT,
     _TOKENS, _TTFT)
 from paddle_tpu.serving.transfer import (  # noqa: F401
-    DeviceKVTransfer, KVPayload, KVTransfer)
+    DeviceKVTransfer, KVPayload, KVTransfer, KVTransferError,
+    TransportPolicy, validate_payload)
 from paddle_tpu.serving.types import (  # noqa: F401
-    EngineDrainingError, QueueFullError, Request, _BeamGroup)
+    EngineDrainingError, OverloadError, QueueFullError, Request,
+    _BeamGroup)
 
 __all__ = [
     "LLMEngine", "Request", "QueueFullError", "EngineDrainingError",
+    "OverloadError",
     "Router", "Replica", "Scheduler", "KVManager", "ModelExecutor",
-    "KVTransfer", "DeviceKVTransfer", "KVPayload",
+    "KVTransfer", "DeviceKVTransfer", "KVPayload", "KVTransferError",
+    "TransportPolicy", "validate_payload",
+    "DegradationController", "SessionSnapshot", "default_signals",
     "AdapterStore", "TokenMaskAutomaton", "json_schema_regex",
 ]

@@ -57,11 +57,13 @@ from paddle_tpu.observability.roofline import (ModelGeometry,
 from paddle_tpu.serving.executor import ModelExecutor, _SAMPLE_ROWS_JIT  # noqa: F401  (re-exported)
 from paddle_tpu.serving.kv import KVManager
 from paddle_tpu.serving.scheduler import Scheduler
+from paddle_tpu.serving.degrade import SessionSnapshot
 from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
                                           _DRAIN, _FINISHED,
                                           _GRAMMAR_SPEC_REJECTS,
                                           _GRAMMAR_TOKENS, _KV_IN_USE,
                                           _KV_UTIL, _QUEUE_DEPTH,
+                                          _REJECTED, _SNAPSHOTS,
                                           _SPEC_ACCEPTED,
                                           _SPEC_DRAFT_REUSE,
                                           _SPEC_FALLBACKS,
@@ -72,8 +74,8 @@ from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
                                           _TTFT)
 from paddle_tpu.serving.transfer import (KVPayload, _GATHER_BLOCKS_JIT,
                                          _INSTALL_BLOCKS_JIT)
-from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
-                                      Request, _BeamGroup)
+from paddle_tpu.serving.types import (EngineDrainingError, OverloadError,
+                                      QueueFullError, Request, _BeamGroup)
 from paddle_tpu.utils.faults import fault_point
 from paddle_tpu.utils.profiler import device_memory_stats
 
@@ -93,11 +95,16 @@ class LLMEngine:
                  seed=0, prefix_caching=True, preemption=False,
                  max_queue_len=None, clock=None, draft_model=None,
                  spec_k=4, spec_adaptive=True, prefill_only=False,
-                 adapter_store=None):
+                 adapter_store=None, degrade=None):
         cfg = model.cfg
         self.model = model
         self.num_slots = num_slots
         self.block_size = block_size
+        # graceful degradation (ISSUE 16): an optional shared
+        # DegradationController — consulted by the spec gate, the
+        # chunked-prefill budget, admission shedding, and the session
+        # gate. None (the default) means full service, always.
+        self.degrade = degrade
         self.max_prompt_len = max_prompt_len
         self.max_seq_len = max_seq_len or (max_prompt_len + 256)
         self.max_blocks_per_seq = -(-self.max_seq_len // block_size)
@@ -361,6 +368,16 @@ class LLMEngine:
     # ------------------------------------------------------------- intake
     def add_request(self, req: Request) -> int:
         self.sched.check_backpressure(self.stats)
+        # ladder L4: explicit backpressure on NEW sessions. Requests a
+        # Router already accepted (_preadmitted) pass — rejecting them
+        # here would double-gate dispatches and death requeues.
+        if (self.degrade is not None and not req._preadmitted
+                and not self.degrade.accepting_sessions()):
+            self.stats["rejected"] += 1
+            _REJECTED.inc(reason="degraded")
+            raise OverloadError(
+                "degradation ladder at L4 — new sessions rejected, "
+                "retry after the cluster recovers")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "itself produces the first token)")
@@ -949,6 +966,11 @@ class LLMEngine:
             return []
         a_cap = self.num_slots
         cap = self.max_prompt_len
+        # ladder L2: shrink the per-tick chunk budget, not the jitted
+        # geometry — the ids array keeps its (a_cap, cap) shape (lens
+        # just come up shorter), so degrading never recompiles
+        budget = (cap if self.degrade is None
+                  else min(cap, self.degrade.prefill_budget(cap)))
         nb, max_b = self.mgr.num_blocks, self.max_blocks_per_seq
         ids = np.zeros((a_cap, cap), np.int32)
         lens = np.zeros(a_cap, np.int32)
@@ -963,7 +985,7 @@ class LLMEngine:
             if rid not in self.prefilling:   # scatter is pending — a later
                 continue     # row's preemption must never evict them
             req = self.requests[rid]
-            chunk = self._pr(req)[consumed: consumed + cap]
+            chunk = self._pr(req)[consumed: consumed + budget]
             t = self._allocate_or_preempt(rid, consumed + len(chunk),
                                           protect=staged)
             if t is None:
@@ -1648,6 +1670,9 @@ class LLMEngine:
             req=self.requests[rid], cur=int(self.cur[slot]),
             gen=int(self.gen[slot]), last_tok=int(self.last_tok[slot]),
             n_blocks=len(t), block_size=self.block_size, k=k, v=v)
+        # wire contract: geometry + checksums recorded while the blocks
+        # are known-good, so the router can reject a partial transfer
+        payload.seal()
         # gather landed — now release host state (same order as cancel)
         REQUESTS.event(payload.req, "kv_extract", replica=self.trace_name,
                        blocks=len(t), cur=int(self.cur[slot]))
@@ -1662,6 +1687,28 @@ class LLMEngine:
         self._grammar.pop(slot, None)
         self.sched.release(rid)
         return payload
+
+    def snapshot_session(self, rid: int):
+        """Host-side durability capture (ISSUE 16): prompt + generated
+        ids + sampler RNG + adapter/grammar refs for one in-flight
+        request — everything a surviving replica needs to resume the
+        session by replaying prefill. Token ids only, never KV blocks,
+        so the capture is tick-cheap. Returns None for unknown/finished
+        requests; the ``serving.snapshot`` chaos site fires pre-capture,
+        so an injected fault skips this capture cleanly (the caller
+        keeps its previous, staler snapshot)."""
+        req = self.requests.get(rid)
+        if req is None or req.done:
+            return None
+        fault_point("serving.snapshot", engine=self, rid=rid)
+        snap = SessionSnapshot(
+            req_id=rid, prompt=req.prompt, tokens=tuple(req.tokens),
+            session_id=req.session_id, tenant_id=req.tenant_id,
+            adapter_id=req.adapter_id, grammar=req.grammar,
+            rng=self.rng, gen=len(req.tokens),
+            captured_t=self.sched.clock())
+        _SNAPSHOTS.inc()
+        return snap
 
     def install_sequence(self, payload: KVPayload) -> bool:
         """Adopt a sequence extracted from another replica: scatter its
@@ -1828,6 +1875,12 @@ class LLMEngine:
                 except Exception:
                     pass
         GOODPUT.refresh_gauge()
+        # degradation control loop: the gauge sweep doubles as the poll
+        # cadence. A router-owned controller is polled by the router
+        # only, so N replicas sharing it don't multiply the hysteresis
+        # clock by N.
+        if self.degrade is not None and self.degrade.owner in (None, self):
+            self.degrade.poll()
         self._push_roofline()
 
     def _kv_block_bytes(self) -> int:
@@ -1908,7 +1961,8 @@ class LLMEngine:
         # verify fault). PT_SPEC_DECODE=0 kills the whole path.
         spec_handled = np.zeros(self.num_slots, bool)
         if (self.draft_model is not None
-                and os.environ.get("PT_SPEC_DECODE", "1") != "0"):
+                and os.environ.get("PT_SPEC_DECODE", "1") != "0"
+                and (self.degrade is None or self.degrade.spec_enabled())):
             elig = (self.active & ~self.is_beam
                     & (self.max_gen - self.gen >= 2))
             if elig.any():

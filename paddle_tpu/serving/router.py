@@ -23,10 +23,22 @@ ROADMAP's "millions of users" north-star enters through. Three jobs:
     is the kill switch: roles collapse to "both" and every replica
     serves end-to-end.
 
+Graceful degradation (ISSUE 16) adds the reaction layer: an optional
+shared :class:`~paddle_tpu.serving.degrade.DegradationController`
+(polled once per step; L4 rejects new sessions here with
+``OverloadError``), periodic host-side session snapshots
+(``snapshot_every``) that restore a request onto a surviving replica
+after a *second* replica death instead of failing it, and a hardened
+handoff transport (:class:`~paddle_tpu.serving.transfer.TransportPolicy`)
+— per-attempt geometry+checksum validation with bounded retries, plus
+straggler hedging to another decode replica when a delivery blows its
+p95-derived deadline (first install wins; the loser copy is dropped
+without ever touching a pool).
+
 The router is deliberately single-threaded per ``step()`` — replicas
 advance in one round-robin sweep, which keeps the chaos sites
-(``router.dispatch``, ``router.kv_transfer``, ``router.replica_death``)
-deterministic. ``run(parallel=True)`` is the throughput mode: one
+(``router.dispatch``, ``router.kv_transfer``, ``router.kv_stall``,
+``router.kv_partial``, ``router.replica_death``) deterministic. ``run(parallel=True)`` is the throughput mode: one
 driver thread per replica free-runs its engine (pure scale-out; used by
 the bench), falling back to sequential rounds when disaggregation or
 router-level work needs the orchestration loop.
@@ -36,6 +48,7 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 from collections import deque
 
 import numpy as np
@@ -47,12 +60,17 @@ from paddle_tpu.observability.health import (HEALTH, HealthEvaluator,
 from paddle_tpu.observability.requests import REQUESTS
 from paddle_tpu.serving.engine import LLMEngine
 from paddle_tpu.serving.telemetry import (_R_DEATHS, _R_DISPATCH,
-                                          _R_HEALTH, _R_OUTSTANDING,
-                                          _R_REQUEUES, _R_TRANSFER_BLOCKS,
-                                          _R_TRANSFERS)
-from paddle_tpu.serving.transfer import DeviceKVTransfer
-from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
-                                      Request)
+                                          _R_HEALTH, _R_HEDGE_RATE,
+                                          _R_HEDGES, _R_OUTSTANDING,
+                                          _R_REQUEUES, _R_RESTORES,
+                                          _R_TRANSFER_BLOCKS,
+                                          _R_TRANSFER_RETRIES,
+                                          _R_TRANSFER_SECONDS,
+                                          _R_TRANSFERS, _REJECTED)
+from paddle_tpu.serving.transfer import (DeviceKVTransfer, KVTransferError,
+                                         TransportPolicy, validate_payload)
+from paddle_tpu.serving.types import (EngineDrainingError, OverloadError,
+                                      QueueFullError, Request)
 from paddle_tpu.utils.faults import fault_point
 
 _VERDICT_NUM = {"OK": 0, "WARN": 1, "CRIT": 2}
@@ -89,7 +107,9 @@ class Router:
     """Least-outstanding-requests front end over N engine replicas."""
 
     def __init__(self, replicas, *, affinity=True, max_queue_len=None,
-                 kv_transfer=None, install_imbalance_rule=True):
+                 kv_transfer=None, install_imbalance_rule=True,
+                 degrade=None, snapshot_every=None,
+                 max_session_restores=4, transport=None, clock=None):
         self.replicas: list[Replica] = []
         for i, r in enumerate(replicas):
             if not isinstance(r, Replica):
@@ -127,6 +147,29 @@ class Router:
         self.affinity = bool(affinity)
         self.kv_transfer = (kv_transfer if kv_transfer is not None
                             else DeviceKVTransfer())
+        # hardened handoff transport (ISSUE 16): deadline + bounded
+        # retries + straggler hedging around ship/validate/install
+        self.transport = (transport if transport is not None
+                          else TransportPolicy())
+        self._clock = clock if clock is not None else time.monotonic
+        # graceful degradation: one shared controller for the fleet —
+        # the router claims it (owner) and polls it once per step from
+        # the gauge sweep; replica engines consult its effect queries
+        # but never advance its hysteresis clocks
+        self.degrade = degrade
+        if degrade is not None:
+            degrade.owner = self
+            for r in self.replicas:
+                if r.engine.degrade is None:
+                    r.engine.degrade = degrade
+        # session durability: periodic host-side snapshots every N
+        # steps. None/0 = OFF — the legacy contract (a request's second
+        # replica death fails it) stays the default
+        self.snapshot_every = snapshot_every
+        self.max_session_restores = max_session_restores
+        self._snapshots: dict[int, object] = {}   # rid -> SessionSnapshot
+        self._restores: dict[int, int] = {}       # rid -> restore count
+        self._step_i = 0
         self.max_queue_len = max_queue_len
         self._queue: deque[Request] = deque()     # awaiting dispatch
         self.requests: dict[int, Request] = {}    # every request ever seen
@@ -136,7 +179,7 @@ class Router:
         self._requeued: set[int] = set()          # death-requeue, ONCE each
         self._ids = itertools.count()
         self.stats = {"dispatched": 0, "requeues": 0, "transfers": 0,
-                      "deaths": 0, "rejected": 0}
+                      "deaths": 0, "rejected": 0, "hedges": 0}
         if install_imbalance_rule:
             # stock rule on the process-global evaluator: flags one
             # replica hoarding outstanding requests (LOR should keep the
@@ -164,6 +207,15 @@ class Router:
             raise QueueFullError(
                 f"router queue full ({self.max_queue_len} waiting) — "
                 "shed load or retry later")
+        # ladder L4: explicit backpressure on NEW sessions — in-flight
+        # work keeps running and finishes; only intake is refused
+        if (self.degrade is not None
+                and not self.degrade.accepting_sessions()):
+            self.stats["rejected"] += 1
+            _REJECTED.inc(reason="degraded")
+            raise OverloadError(
+                "degradation ladder at L4 — new sessions rejected, "
+                "retry after the cluster recovers")
         if req.req_id is None:
             req.req_id = next(self._ids)
         else:
@@ -172,6 +224,10 @@ class Router:
             self._ids = itertools.count(
                 max(req.req_id + 1, next(self._ids)))
         self.requests[req.req_id] = req
+        # the router's intake gate is THE session gate for the fleet —
+        # replica engines skip theirs for router-owned work, so L4
+        # never re-rejects an accepted request mid-dispatch or requeue
+        req._preadmitted = True
         REQUESTS.submit(req, source="router")
         self._queue.append(req)
         self._flush_queue()
@@ -180,11 +236,18 @@ class Router:
     def generate(self, prompt, **kw) -> int:
         return self.add_request(Request(prompt, **kw))
 
+    def _forget(self, rid: int):
+        """Drop all per-request router state once a request is done."""
+        self._where.pop(rid, None)
+        self._snapshots.pop(rid, None)
+        self._restores.pop(rid, None)
+
     def pop_finished(self) -> dict:
         done = {rid: r for rid, r in self.requests.items() if r.done}
         for rid in done:
             del self.requests[rid]
             self._requeued.discard(rid)
+            self._forget(rid)
         return done
 
     def has_work(self) -> bool:
@@ -203,6 +266,7 @@ class Router:
                 del self._queue[i]
                 req.done = True
                 req.finish_reason = reason
+                self._forget(rid)
                 REQUESTS.finish(req, reason)
                 return True
         for j, p in enumerate(self._pending):
@@ -210,11 +274,15 @@ class Router:
                 del self._pending[j]
                 req.done = True
                 req.finish_reason = reason
+                self._forget(rid)
                 REQUESTS.finish(req, reason)
                 return True
         i = self._where.get(rid)
         if i is not None:
-            return self.replicas[i].engine.cancel(rid, reason)
+            out = self.replicas[i].engine.cancel(rid, reason)
+            if out:
+                self._forget(rid)
+            return out
         return False
 
     # ----------------------------------------------------------- dispatch
@@ -340,10 +408,96 @@ class Router:
                 self._pending.append(payload)
                 self._where.pop(rid, None)
 
+    def _deliver(self, payload, rep):
+        """One validated delivery of ``payload`` to ``rep``: the
+        ``router.kv_stall`` chaos window (straggler delay), ship, the
+        ``router.kv_partial`` corruption window (a rule action returns
+        a corrupted REPLACEMENT — the source payload stays pristine),
+        then geometry+checksum validation. Failed attempts retry with
+        bounded exponential backoff up to ``transport.max_attempts``.
+        Returns the validated shipped payload, or None when every
+        attempt failed (the payload stays pending; nothing was
+        installed)."""
+        rid = payload.req.req_id
+        for attempt in range(self.transport.max_attempts):
+            if attempt:
+                self.transport.sleep(self.transport.backoff_s(attempt - 1))
+            try:
+                fault_point("router.kv_stall", router=self, rid=rid,
+                            replica=rep.name, attempt=attempt)
+                shipped = self.kv_transfer.ship(payload, rep.engine)
+                alt = fault_point("router.kv_partial", router=self,
+                                  rid=rid, replica=rep.name,
+                                  attempt=attempt, payload=shipped)
+                if alt is not None:
+                    shipped = alt
+                validate_payload(shipped, rep.engine)
+                return shipped
+            except EngineDrainingError:
+                raise
+            except Exception as e:
+                why = ("partial" if isinstance(e, KVTransferError)
+                       else "error")
+                _R_TRANSFER_RETRIES.inc(replica=rep.name, why=why)
+                FLIGHT.record("router.kv_retry", rid=rid,
+                              replica=rep.name, attempt=attempt, why=why,
+                              error=f"{type(e).__name__}: {e}")
+        return None
+
+    def _installed(self, payload, i: int):
+        """Common bookkeeping once a payload's install succeeded."""
+        req = payload.req
+        rep = self.replicas[i]
+        self._where[req.req_id] = i
+        if self.affinity and req.session_id is not None:
+            self._sessions[("decode", req.session_id)] = i
+        self.stats["transfers"] += 1
+        _R_TRANSFERS.inc()
+        _R_TRANSFER_BLOCKS.inc(payload.n_blocks)
+        REQUESTS.event(req, "kv_ship", replica=rep.name,
+                       blocks=payload.n_blocks)
+
+    def _hedge(self, payload, slow_i: int, others: list,
+               elapsed: float, deadline: float) -> bool:
+        """Straggler hedging: the primary delivery blew its deadline,
+        so re-dispatch the handoff to the next-least-loaded decode
+        replica. First copy to INSTALL wins; returns True when the
+        hedge won — the slow primary copy is then dropped without ever
+        being installed (the exactly-once loser cancellation: no slot,
+        no blocks, no second registration). Returns False to fall back
+        to the late primary copy."""
+        req = payload.req
+        j = min(others, key=lambda x:
+                (self.replicas[x].engine.outstanding(), x))
+        hrep = self.replicas[j]
+        self.stats["hedges"] += 1
+        _R_HEDGES.inc()
+        FLIGHT.record("router.kv_hedge", rid=req.req_id,
+                      slow=self.replicas[slow_i].name, hedge=hrep.name,
+                      elapsed_s=round(elapsed, 6),
+                      deadline_s=round(deadline, 6))
+        t0 = self._clock()
+        try:
+            shipped = self._deliver(payload, hrep)
+            if shipped is None or not hrep.engine.install_sequence(shipped):
+                return False
+        except EngineDrainingError:
+            return False
+        _R_TRANSFER_SECONDS.observe(self._clock() - t0)
+        FLIGHT.record("router.kv_hedge_win", rid=req.req_id,
+                      replica=hrep.name)
+        REQUESTS.event(req, "kv_hedged", replica=hrep.name)
+        self._installed(payload, j)
+        return True
+
     def _flush_pending(self):
         """Install extracted sequences into decode-capable replicas (LOR
-        with decode-stage affinity). A payload that fits nowhere right
-        now simply waits — slots/blocks free up as decodes finish."""
+        with decode-stage affinity) through the hardened transport:
+        per-attempt validation + bounded retries (:meth:`_deliver`),
+        and straggler hedging when the primary delivery exceeds the
+        policy deadline (p95-derived by default). A payload that fits
+        nowhere right now simply waits — slots/blocks free up as
+        decodes finish."""
         still = []
         for payload in self._pending:
             req = payload.req
@@ -360,25 +514,35 @@ class Router:
             i = min(cands, key=lambda j:
                     (self.replicas[j].engine.outstanding(), j))
             rep = self.replicas[i]
+            deadline = self.transport.deadline(_R_TRANSFER_SECONDS)
+            t0 = self._clock()
             try:
                 with _span("router.kv_transfer", rid=req.req_id,
                            dst=rep.name):
-                    shipped = self.kv_transfer.ship(payload, rep.engine)
-                    ok = rep.engine.install_sequence(shipped)
+                    shipped = self._deliver(payload, rep)
+            except EngineDrainingError:
+                still.append(payload)
+                continue
+            elapsed = self._clock() - t0
+            if shipped is None:
+                still.append(payload)    # retries exhausted this step
+                continue
+            if (self.transport.hedge and deadline is not None
+                    and elapsed > deadline):
+                others = [j for j in cands if j != i]
+                if others and self._hedge(payload, i, others,
+                                          elapsed, deadline):
+                    continue             # hedge won; slow copy dropped
+            try:
+                ok = rep.engine.install_sequence(shipped)
             except EngineDrainingError:
                 still.append(payload)
                 continue
             if not ok:
                 still.append(payload)    # no slot/blocks free yet
                 continue
-            self._where[req.req_id] = i
-            if self.affinity and req.session_id is not None:
-                self._sessions[("decode", req.session_id)] = i
-            self.stats["transfers"] += 1
-            _R_TRANSFERS.inc()
-            _R_TRANSFER_BLOCKS.inc(payload.n_blocks)
-            REQUESTS.event(req, "kv_ship", replica=rep.name,
-                           blocks=payload.n_blocks)
+            _R_TRANSFER_SECONDS.observe(elapsed)
+            self._installed(payload, i)
         self._pending = still
 
     # ------------------------------------------------------ death/drain
@@ -396,15 +560,43 @@ class Router:
                       error=f"{type(exc).__name__}: {exc}")
         eng = rep.engine
         for rid, r in eng.pop_finished().items():
-            self._where.pop(rid, None)       # finished work is still good
+            self._forget(rid)                # finished work is still good
         for rid in list(eng.requests):
             req = eng.release_request(rid)
             self._where.pop(rid, None)
             if req is None:
                 continue
             if rid in self._requeued:
+                snap = self._snapshots.get(rid)
+                restores = self._restores.get(rid, 0)
+                if (snap is not None
+                        and restores < self.max_session_restores):
+                    # session durability (ISSUE 16): the exactly-once
+                    # requeue is spent, but a snapshot outlives the
+                    # replica — restore instead of failing. Tokens roll
+                    # back to the capture point; the resume prefill
+                    # replays them through the radix cache (waste billed
+                    # as replay_prefill), so greedy output still matches
+                    # an undisturbed run.
+                    self._restores[rid] = restores + 1
+                    req.tokens = list(snap.tokens)
+                    req._resume = (snap.resume_ids() if snap.tokens
+                                   else None)
+                    req._match_memo = None
+                    self._queue.appendleft(req)
+                    self.stats["requeues"] += 1
+                    _R_RESTORES.inc()
+                    _R_REQUEUES.inc(replica=rep.name,
+                                    why="session_restore")
+                    FLIGHT.record("router.session_restore", rid=rid,
+                                  replica=rep.name,
+                                  tokens=len(snap.tokens))
+                    REQUESTS.event(req, "restored", replica=rep.name,
+                                   tokens=len(snap.tokens))
+                    continue
                 req.done = True
                 req.finish_reason = "replica_death"
+                self._forget(rid)
                 FLIGHT.record("router.requeue_exhausted", rid=rid)
                 REQUESTS.finish(req, "replica_death", replica=rep.name)
                 continue
@@ -467,7 +659,7 @@ class Router:
         else:
             eng.drain(cancel_queued=cancel_queued)
         for rid in eng.pop_finished():
-            self._where.pop(rid, None)
+            self._forget(rid)
         self._flush_queue()
 
     # ------------------------------------------------------------ stepping
@@ -491,12 +683,37 @@ class Router:
         if self.disagg:
             self._collect_prefilled()
             self._flush_pending()
+        # session durability: capture AFTER the engine ticks, so each
+        # snapshot carries this step's freshly generated tokens
+        if self.snapshot_every:
+            self._step_i += 1
+            if self._step_i % self.snapshot_every == 0:
+                self._snapshot_sessions()
         for rep in self.replicas:
             if rep.alive:
                 for rid in rep.engine.pop_finished():
-                    self._where.pop(rid, None)
+                    self._forget(rid)
         self._refresh_gauges()
         return emitted
+
+    def _snapshot_sessions(self):
+        """Refresh the per-request durability snapshots for everything
+        in flight on a live replica. A failed capture (the
+        ``serving.snapshot`` chaos site) keeps the previous, staler
+        snapshot — restore then just replays a longer tail."""
+        for rid, i in list(self._where.items()):
+            rep = self.replicas[i]
+            if not rep.alive:
+                continue
+            try:
+                snap = rep.engine.snapshot_session(rid)
+            except Exception as e:
+                FLIGHT.record("serving.snapshot_skipped", rid=rid,
+                              replica=rep.name,
+                              error=f"{type(e).__name__}: {e}")
+                continue
+            if snap is not None:
+                self._snapshots[rid] = snap
 
     def _progress_key(self):
         toks = sum(len(r.tokens) for r in self.requests.values())
@@ -611,3 +828,7 @@ class Router:
                 rep.engine.outstanding() if rep.alive else 0,
                 replica=rep.name)
             _R_HEALTH.set(_VERDICT_NUM[rep.verdict()], replica=rep.name)
+        tr, hd = self.stats["transfers"], self.stats["hedges"]
+        _R_HEDGE_RATE.set(hd / tr if tr else 0.0)
+        if self.degrade is not None:
+            self.degrade.poll()

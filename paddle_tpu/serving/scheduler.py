@@ -19,9 +19,11 @@ from paddle_tpu.observability.flight import FLIGHT
 from paddle_tpu.observability.goodput import GOODPUT
 from paddle_tpu.observability.requests import REQUESTS
 from paddle_tpu.serving.telemetry import (_ADAPTER_DEFERRALS, _ADMITTED,
-                                          _PREEMPTED, _QUEUE_WAIT,
-                                          _REJECTED, _TENANT_ADMITTED,
-                                          _TENANT_QUEUE_WAIT, _TENANT_WASTE)
+                                          _DEGRADE_SHED, _PREEMPTED,
+                                          _QUEUE_WAIT, _REJECTED,
+                                          _TENANT_ADMITTED,
+                                          _TENANT_QUEUE_WAIT,
+                                          _TENANT_THROTTLED, _TENANT_WASTE)
 from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
                                       Request)
 
@@ -48,6 +50,18 @@ class Scheduler:
         # tenant_id, in which case admission is EXACTLY the legacy FCFS.
         self.tenant_weights: dict = {}       # tenant -> share weight (1.0)
         self.tenant_charged: dict = {}       # tenant -> tokens charged
+        # graceful degradation (ISSUE 16): tenant service class — the
+        # ladder's L3 rung sheds (defers, never cancels) "best_effort"
+        # tenants at admission; everyone defaults to "standard"
+        self.tenant_priority: dict = {}      # tenant -> service class
+        # per-tenant token-bucket rate limits (max_tokens_per_s): a
+        # tenant with an empty bucket is skipped by the fair pick until
+        # refill. Admission debits the same prompt+budget cost the
+        # deficit charge uses, and the bucket may go negative — so one
+        # large request eventually passes instead of starving forever,
+        # and the long-run rate still holds.
+        self.tenant_rate: dict = {}          # tenant -> (rate/s, burst)
+        self.tenant_bucket: dict = {}        # tenant -> [tokens, last_t]
 
     def set_tenant_weight(self, tenant, weight: float):
         """Relative admission share for a tenant (default 1.0). A tenant
@@ -56,6 +70,39 @@ class Scheduler:
         if weight <= 0:
             raise ValueError("tenant weight must be positive")
         self.tenant_weights[tenant] = float(weight)
+
+    def set_tenant_priority(self, tenant, priority: str):
+        """Service class: "standard" (default) or "best_effort" — the
+        degradation ladder sheds best-effort admissions at L3+."""
+        if priority not in ("standard", "best_effort"):
+            raise ValueError(f"priority must be 'standard' or "
+                             f"'best_effort', got {priority!r}")
+        self.tenant_priority[tenant] = priority
+
+    def set_tenant_rate(self, tenant, max_tokens_per_s, burst=None):
+        """Token-bucket rate limit for one tenant (None removes it).
+        ``burst`` is the bucket capacity — the tokens a cold tenant may
+        consume instantly — and defaults to one second's worth."""
+        if max_tokens_per_s is None:
+            self.tenant_rate.pop(tenant, None)
+            self.tenant_bucket.pop(tenant, None)
+            return
+        if max_tokens_per_s <= 0:
+            raise ValueError("max_tokens_per_s must be positive")
+        burst = float(max_tokens_per_s if burst is None else burst)
+        if burst <= 0:
+            raise ValueError("burst must be positive")
+        self.tenant_rate[tenant] = (float(max_tokens_per_s), burst)
+        self.tenant_bucket[tenant] = [burst, self.clock()]
+
+    def _bucket_level(self, tenant, now) -> float:
+        """Refill the tenant's bucket up to ``now`` and return its level
+        (scheduler clock, so rate tests drive a fake clock)."""
+        rate, burst = self.tenant_rate[tenant]
+        b = self.tenant_bucket.setdefault(tenant, [burst, now])
+        b[0] = min(burst, b[0] + max(0.0, now - b[1]) * rate)
+        b[1] = now
+        return b[0]
 
     # ------------------------------------------------------------- intake
     def check_backpressure(self, stats: dict):
@@ -156,26 +203,30 @@ class Scheduler:
             req._match_memo = (epoch, len(p), m)
         return m
 
-    def _pick_index(self) -> int:
-        """Queue index of the next admission candidate. Pure FCFS (the
-        head) while no queued request carries a tenant_id — the legacy
-        ordering, byte-for-byte. Otherwise: token-budget-weighted fair
-        pick — the queued tenant with the smallest charged/weight deficit
-        wins, FIFO within the tenant. Starvation-free: every admission
-        charges the winner, so a saturating tenant's deficit climbs past
-        any light tenant's after finitely many admissions. A tenant first
-        seen mid-flight starts at the current MINIMUM charge (no
-        retroactive credit for time away)."""
-        if all(r.tenant_id is None for r in self.queue):
+    def _pick_index(self, skip=frozenset()):
+        """Queue index of the next admission candidate, or None when
+        every queued tenant is in ``skip`` (shed or throttled). Pure
+        FCFS (the head) while no queued request carries a tenant_id and
+        nothing is skipped — the legacy ordering, byte-for-byte.
+        Otherwise: token-budget-weighted fair pick — the queued tenant
+        with the smallest charged/weight deficit wins, FIFO within the
+        tenant. Starvation-free: every admission charges the winner, so
+        a saturating tenant's deficit climbs past any light tenant's
+        after finitely many admissions. A tenant first seen mid-flight
+        starts at the current MINIMUM charge (no retroactive credit for
+        time away)."""
+        if not skip and all(r.tenant_id is None for r in self.queue):
             return 0
         floor = min(self.tenant_charged.values(), default=0.0)
-        best_qi, best_key = 0, None
+        best_qi, best_key = None, None
         seen = set()
         for qi, r in enumerate(self.queue):
             t = r.tenant_id
             if t in seen:
                 continue                   # FIFO within a tenant
             seen.add(t)
+            if t is not None and t in skip:
+                continue                   # shed/throttled this pass
             w = self.tenant_weights.get(t, 1.0)
             key = self.tenant_charged.setdefault(t, floor) / w
             if best_key is None or key < best_key:
@@ -192,8 +243,40 @@ class Scheduler:
             return
         floor = min(self.tenant_charged.values(), default=0.0)
         gen = max(0, req.max_new_tokens - len(req.tokens))
-        self.tenant_charged[t] = (self.tenant_charged.get(t, floor)
-                                  + len(p) + gen)
+        cost = len(p) + gen
+        self.tenant_charged[t] = self.tenant_charged.get(t, floor) + cost
+        if t in self.tenant_rate:
+            # debit the rate bucket with the same worst-case cost; it
+            # may go negative, which is what lets one oversized request
+            # through and then makes the tenant wait out the overdraft
+            b = self.tenant_bucket.setdefault(
+                t, [self.tenant_rate[t][1], self.clock()])
+            b[0] -= cost
+
+    def _admission_skips(self, eng, counted: set) -> frozenset:
+        """Tenants excluded from the current admission pass: best-effort
+        tenants while the degradation ladder holds L3+, and tenants
+        whose token bucket ran dry. Skipped requests stay queued — both
+        mechanisms defer, never drop. ``counted`` dedupes the skip
+        metrics to once per tenant per ``select_admissions`` call."""
+        deg = getattr(eng, "degrade", None)
+        shed = deg is not None and deg.shed_best_effort()
+        if not shed and not self.tenant_rate:
+            return frozenset()
+        now = self.clock()
+        skip = set()
+        for t in {r.tenant_id for r in self.queue if r.tenant_id is not None}:
+            if shed and self.tenant_priority.get(t) == "best_effort":
+                skip.add(t)
+                if ("shed", t) not in counted:
+                    counted.add(("shed", t))
+                    _DEGRADE_SHED.inc(tenant=str(t))
+            elif t in self.tenant_rate and self._bucket_level(t, now) <= 0.0:
+                skip.add(t)
+                if ("throttle", t) not in counted:
+                    counted.add(("throttle", t))
+                    _TENANT_THROTTLED.inc(tenant=str(t))
+        return frozenset(skip)
 
     def select_admissions(self, eng):
         """Move queued requests into free slots while the pool can cover
@@ -205,8 +288,14 @@ class Scheduler:
         kv = eng.kv
         free_slots = list(np.nonzero(eng.slot_req < 0)[0])
         admits, beam_admits = [], []
+        skip_counted: set = set()
         while self.queue and free_slots:
-            qi = self._pick_index()
+            # recomputed every iteration: an admission can drain its
+            # tenant's rate bucket mid-pass
+            skips = self._admission_skips(eng, skip_counted)
+            qi = self._pick_index(skips)
+            if qi is None:
+                break                      # everyone queued is deferred
             req = self.queue[qi]
             k = req.num_beams
             p = eng._pr(req)

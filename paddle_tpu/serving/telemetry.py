@@ -185,3 +185,51 @@ _R_TRANSFER_BLOCKS = METRICS.counter(
     "KV blocks shipped prefill→decode (disaggregated mode)")
 _R_DEATHS = METRICS.counter(
     "router_replica_deaths_total", "replicas declared dead by the router")
+
+# ----------------------------------- graceful degradation (ISSUE 16)
+# the reaction layer: ladder rung + transitions, shed/throttle skips,
+# session durability, and the hardened KV-handoff transport
+_DEGRADE_LEVEL = METRICS.gauge(
+    "serving_degrade_level",
+    "current degradation-ladder rung: 0 none, 1 spec off, 2 prefill "
+    "budget shrunk, 3 best-effort tenants shed, 4 new sessions rejected")
+_DEGRADE_TRANSITIONS = METRICS.counter(
+    "serving_degrade_transitions_total",
+    "degradation-ladder transitions, by direction (up/down) and target "
+    "rung", labelnames=("direction", "to"))
+_DEGRADE_SHED = METRICS.counter(
+    "serving_degrade_shed_total",
+    "admission passes that skipped a best-effort tenant while the "
+    "ladder held L3+ (requests stay queued and admit on recovery)",
+    labelnames=("tenant",))
+_TENANT_THROTTLED = METRICS.counter(
+    "serving_tenant_throttled_total",
+    "admission passes that skipped a tenant whose token bucket was "
+    "empty (max_tokens_per_s rate limit), by tenant",
+    labelnames=("tenant",))
+_SNAPSHOTS = METRICS.counter(
+    "serving_session_snapshots_total",
+    "host-side session-durability snapshots captured")
+_R_RESTORES = METRICS.counter(
+    "router_session_restores_total",
+    "sessions restored from a snapshot onto a surviving replica after "
+    "a repeat replica death (instead of failing with replica_death)")
+_R_TRANSFER_RETRIES = METRICS.counter(
+    "router_transfer_retries_total",
+    "KV-handoff ship attempts retried, by replica and cause (partial = "
+    "failed geometry/checksum validation, error = transport exception)",
+    labelnames=("replica", "why"))
+_R_HEDGES = METRICS.counter(
+    "router_hedges_total",
+    "KV handoffs re-dispatched to another decode replica after the "
+    "primary ship blew its p95-derived deadline (straggler hedging)")
+_R_HEDGE_RATE = METRICS.gauge(
+    "router_hedge_rate",
+    "lifetime hedged / successful KV handoffs — sustained hedging "
+    "means a straggling replica or transport link")
+_R_TRANSFER_SECONDS = METRICS.histogram(
+    "router_kv_transfer_seconds",
+    "wall time of one successful KV-handoff delivery (ship + "
+    "validation) — feeds the p95-derived hedging deadline",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))

@@ -18,15 +18,38 @@ repeated handoffs never recompile: gather pads the block-index vector
 to ``max_blocks_per_seq`` (extra rows are gathered then ignored),
 install pads with the ``num_blocks`` sentinel so the donating scatter
 drops them.
+
+The handoff is hardened against a lossy wire (ISSUE 16): the source
+engine seals each payload (:meth:`KVPayload.seal`) with its expected
+geometry plus per-tensor checksums, and the router runs
+:func:`validate_payload` on the shipped copy before install — a
+truncated or corrupted transfer raises :class:`KVTransferError` and is
+retried from the pristine source payload under a
+:class:`TransportPolicy` (deadline, bounded exponential backoff,
+straggler hedging to another decode replica).
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.serving.types import Request
+
+
+class KVTransferError(RuntimeError):
+    """A shipped payload failed geometry/checksum validation — a
+    partial or corrupted transfer. The handoff is retried from the
+    pristine source payload; the rejected copy is never installed."""
+
+
+def _tensor_checksum(x) -> float:
+    """Order-independent content checksum: the f32 sum of all elements.
+    Cheap (one reduce), device-friendly, and any zeroed/truncated block
+    row of real KV activations moves it far past tolerance."""
+    return float(jnp.sum(jnp.asarray(x, jnp.float32)))
 
 
 @dataclass
@@ -40,10 +63,111 @@ class KVPayload:
     block_size: int
     k: object                # [L, max_blocks, block_size, H_kv, D]
     v: object
+    # filled by seal(): what the payload looked like when it left the
+    # source pool — validate_payload checks the shipped copy against it
+    expect: dict = None
 
     @property
     def tokens_bytes(self):
         return self.k.nbytes + self.v.nbytes
+
+    def seal(self):
+        """Record the wire contract at the source: geometry + content
+        checksums. Called once by ``extract_sequence`` before the
+        payload leaves the engine."""
+        self.expect = {
+            "shape": tuple(self.k.shape),
+            "cur": self.cur,
+            "n_blocks": self.n_blocks,
+            "ksum": _tensor_checksum(self.k),
+            "vsum": _tensor_checksum(self.v),
+        }
+        return self
+
+
+def validate_payload(payload: KVPayload, target_engine) -> KVPayload:
+    """Reject partial/corrupt transfers before they touch the target
+    pool. Geometry is checked against both the seal and the target
+    engine; checksums against the seal (tolerance covers f32 summation
+    order, not content). Unsealed payloads (hand-built in tests, or a
+    custom transport that re-packs) get the geometry checks only."""
+    k, v = payload.k, payload.v
+    pool = target_engine.cache.k_pools[0]
+    if tuple(k.shape) != tuple(v.shape):
+        raise KVTransferError(
+            f"k/v geometry diverged in flight: {tuple(k.shape)} vs "
+            f"{tuple(v.shape)}")
+    if k.shape[0] != len(target_engine.cache.k_pools) \
+            or tuple(k.shape[2:]) != tuple(pool.shape[1:]):
+        raise KVTransferError(
+            f"payload geometry {tuple(k.shape)} does not match the "
+            f"target pool [{len(target_engine.cache.k_pools)}, *, "
+            f"{tuple(pool.shape[1:])}]")
+    if payload.n_blocks * payload.block_size < payload.cur:
+        raise KVTransferError(
+            f"payload truncated: {payload.n_blocks} blocks × "
+            f"{payload.block_size} cannot cover cur={payload.cur}")
+    exp = payload.expect
+    if exp is not None:
+        if (tuple(k.shape) != exp["shape"] or payload.cur != exp["cur"]
+                or payload.n_blocks != exp["n_blocks"]):
+            raise KVTransferError(
+                f"payload drifted from its seal: shape={tuple(k.shape)} "
+                f"cur={payload.cur} n_blocks={payload.n_blocks}, sealed "
+                f"{exp['shape']}/{exp['cur']}/{exp['n_blocks']}")
+        ks, vs = _tensor_checksum(k), _tensor_checksum(v)
+        for got, want, name in ((ks, exp["ksum"], "k"),
+                                (vs, exp["vsum"], "v")):
+            if abs(got - want) > 1e-3 * max(1.0, abs(want)):
+                raise KVTransferError(
+                    f"{name}-checksum mismatch (partial/corrupt "
+                    f"transfer): got {got!r}, sealed {want!r}")
+    return payload
+
+
+class TransportPolicy:
+    """Retry/deadline/hedging policy for one handoff delivery.
+
+    ``deadline_s=None`` derives the straggler deadline from live data:
+    ``deadline_margin ×`` the p95 of ``router_kv_transfer_seconds``
+    (floored at ``min_deadline_s``), once at least ``min_samples``
+    deliveries have been observed — before that there is no deadline
+    and no hedging, so cold starts never hedge on noise. Retries use
+    bounded exponential backoff through the injectable ``sleep``."""
+
+    def __init__(self, *, deadline_s: float = None,
+                 deadline_margin: float = 3.0,
+                 min_deadline_s: float = 0.05, min_samples: int = 8,
+                 max_attempts: int = 3, backoff_base_s: float = 0.005,
+                 backoff_max_s: float = 0.1, hedge: bool = True,
+                 sleep=time.sleep):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.deadline_s = deadline_s
+        self.deadline_margin = deadline_margin
+        self.min_deadline_s = min_deadline_s
+        self.min_samples = min_samples
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.hedge = hedge
+        self.sleep = sleep
+
+    def backoff_s(self, attempt: int) -> float:
+        """Backoff before retry ``attempt+1`` (attempt is 0-based)."""
+        return min(self.backoff_base_s * (2 ** attempt), self.backoff_max_s)
+
+    def deadline(self, hist) -> float:
+        """The straggler deadline, or None while underinformed."""
+        if self.deadline_s is not None:
+            return self.deadline_s
+        count = sum(s.count for s in hist._series.values())
+        if count < self.min_samples:
+            return None
+        p95 = hist.quantile(0.95)
+        if p95 != p95:                       # NaN: no data
+            return None
+        return max(self.min_deadline_s, self.deadline_margin * p95)
 
 
 def _gather_blocks(k_pools, v_pools, idx):

@@ -22,6 +22,13 @@ class EngineDrainingError(RuntimeError):
     admits nothing new."""
 
 
+class OverloadError(QueueFullError):
+    """The degradation ladder is at L4: new sessions are rejected with
+    explicit backpressure until the cluster recovers. A subclass of
+    :class:`QueueFullError` so existing shed/retry handlers compose —
+    the correct client reaction (back off, retry later) is the same."""
+
+
 @dataclass
 class Request:
     """One generation request. ``stream`` (optional) is called as
@@ -90,6 +97,10 @@ class Request:
     # serves
     trace_id: object = None
     trace_summary: object = None
+    # set by the Router once ITS admission gate (queue depth + ladder
+    # L4) has passed — replica engines then skip their own session gate,
+    # so accepted work is never re-rejected mid-dispatch or on requeue
+    _preadmitted: bool = False
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -45,12 +45,35 @@ rule is installed). Tests install rules against site names:
     ckpt.write       before the checkpoint tmp file is written (OSError)
     ckpt.rename      between tmp-write and the atomic rename — the
                      crash window (InjectedCrash)
+    collective.all_reduce  before a mesh all_reduce (dead-link chaos)
+    router.kv_stall  straggler window inside one prefill→decode handoff
+                     attempt — fires before ``KVTransfer.ship``; a
+                     ``delay_s`` rule here makes the transfer slow (which
+                     trips the hedging deadline), an exception makes it
+                     fail (which burns one retry attempt)
+    router.kv_partial  after ship, before install — a rule action
+                     receives the shipped payload and returns a
+                     corrupted/truncated replacement; geometry+checksum
+                     validation must reject it and the router retries
+                     from the pristine source payload
+    serving.snapshot  before a host-side session snapshot is captured —
+                     an exception skips this capture (the router keeps
+                     the previous, staler snapshot)
 
 Rules fire on specific hit counts of their site (``on={3, 5}``), every
 k-th hit (``every=3``), or a seeded pseudo-random schedule
 (:meth:`FaultRegistry.schedule`). An exhausted rule (``times``) stops
 firing; ``clear()`` removes everything. All state is per-process and
 host-side only — nothing here ever traces into a jitted program.
+
+Delay faults: ``delay_s`` sleeps *before* the rule's other behaviour
+(exc/action) and composes with it — a rule with only ``delay_s`` models
+a slow-but-correct straggler, ``delay_s`` + ``exc`` a slow failure. The
+sleep goes through the swappable ``FAULTS.sleep`` so tests can fake
+time. (``stall_s`` is the older exclusive form and always uses real
+``time.sleep``.) The full machine-readable site list lives in
+:data:`SITES`; ``tests/test_faults.py`` cross-checks it against the
+``fault_point``/``fault_value`` call sites in the source tree.
 
 Usage::
 
@@ -80,7 +103,42 @@ _INJECTED = METRICS.counter(
     "fault-injection firings by chaos site", labelnames=("site",))
 
 __all__ = ["FAULTS", "FaultRegistry", "FaultRule", "InjectedFault",
-           "InjectedCrash", "fault_point", "fault_value"]
+           "InjectedCrash", "SITES", "fault_point", "fault_value"]
+
+# Every instrumented chaos site in the tree, site → one-line contract.
+# tests/test_faults.py asserts this stays in sync with the actual
+# fault_point()/fault_value() call sites, so a new site cannot land
+# without documenting what an injected failure there must guarantee.
+SITES = {
+    "serving.alloc": "block allocation inside the engine (MemoryError)",
+    "serving.tick": "top of LLMEngine.step (exception / stall)",
+    "serving.preempt": "induced preemption (action receives the engine)",
+    "serving.spec_verify": "before the speculative verify forward; "
+                           "exception-atomic spec-round abort",
+    "serving.moe_dispatch": "before an MoE decode tick's expert "
+                            "all_to_all; exception-atomic tick abort",
+    "serving.prefix_evict": "before a radix prefix-cache leaf eviction; "
+                            "pre-mutation, trie/free list untouched",
+    "serving.adapter_swap": "before a LoRA adapter host→device upload; "
+                            "pre-mutation, admission deferred",
+    "serving.snapshot": "before a session-durability snapshot capture; "
+                        "exception skips it, stale snapshot kept",
+    "router.dispatch": "before a request is handed to a replica engine; "
+                       "pre-add, request stays with the router",
+    "router.kv_transfer": "before a prefilled sequence is extracted for "
+                          "handoff; exception-atomic pull-back + requeue",
+    "router.kv_stall": "straggler window inside one handoff ship attempt "
+                       "(delay_s = slow, exc = burns a retry)",
+    "router.kv_partial": "action corrupts/truncates the shipped payload; "
+                         "validation rejects, router retries pristine",
+    "router.replica_death": "before a replica's step; exception marks it "
+                            "dead, live requests requeue exactly once",
+    "collective.all_reduce": "before a mesh all_reduce (dead link)",
+    "train.step": "top of each trainer step (exception / stall)",
+    "train.loss": "loss override — action return replaces the loss",
+    "ckpt.write": "before the checkpoint tmp file is written (OSError)",
+    "ckpt.rename": "between tmp-write and atomic rename (InjectedCrash)",
+}
 
 
 class InjectedFault(RuntimeError):
@@ -98,12 +156,16 @@ class FaultRule:
     index (0-based, per site, counted from installation) satisfies
     ``on``/``every``; fires at most ``times`` times (None = unbounded).
 
-    Exactly one behaviour:
+    Exactly one primary behaviour:
       * ``exc``     — an exception class or instance to raise
       * ``action``  — called with the site's context kwargs; its return
                       value is handed back to the fault point (the
                       ``train.loss`` site uses it as the loss override)
-      * ``stall_s`` — sleep this long (stall injection)
+      * ``stall_s`` — sleep this long (legacy exclusive stall injection)
+
+    ``delay_s`` is orthogonal and composes: it sleeps (through the
+    registry's swappable ``sleep``) *before* the primary behaviour runs;
+    a rule with only ``delay_s`` is a pure straggler — slow, not broken.
     """
     site: str
     on: Optional[frozenset] = None
@@ -112,6 +174,7 @@ class FaultRule:
     exc: Any = None
     action: Optional[Callable[..., Any]] = None
     stall_s: Optional[float] = None
+    delay_s: Optional[float] = None
     fired: int = 0
     _base_hit: int = 0          # site hit count when the rule was installed
 
@@ -125,8 +188,10 @@ class FaultRule:
             return self.every > 0 and rel % self.every == self.every - 1
         return True
 
-    def fire(self, ctx: dict):
+    def fire(self, ctx: dict, sleep: Callable[[float], None] = time.sleep):
         self.fired += 1
+        if self.delay_s is not None:
+            sleep(self.delay_s)
         if self.exc is not None:
             raise self.exc if isinstance(self.exc, BaseException) \
                 else self.exc(f"injected fault at {self.site}")
@@ -135,6 +200,8 @@ class FaultRule:
             return None
         if self.action is not None:
             return self.action(ctx)
+        if self.delay_s is not None:
+            return None          # pure delay fault: slow, not broken
         raise InjectedFault(f"injected fault at {self.site}")
 
 
@@ -146,16 +213,19 @@ class FaultRegistry:
         self._rules: dict[str, list[FaultRule]] = defaultdict(list)
         self.hits: dict[str, int] = defaultdict(int)
         self.log: list[tuple[str, int]] = []   # (site, hit) of every firing
+        self.sleep: Callable[[float], None] = time.sleep  # delay_s clock
 
     # ------------------------------------------------------------- admin
     def install(self, site: str, *, on=None, every: Optional[int] = None,
                 times: Optional[int] = None, exc=None,
                 action: Optional[Callable] = None,
-                stall_s: Optional[float] = None) -> FaultRule:
+                stall_s: Optional[float] = None,
+                delay_s: Optional[float] = None) -> FaultRule:
         rule = FaultRule(site=site,
                          on=None if on is None else frozenset(on),
                          every=every, times=times, exc=exc, action=action,
-                         stall_s=stall_s, _base_hit=self.hits[site])
+                         stall_s=stall_s, delay_s=delay_s,
+                         _base_hit=self.hits[site])
         self._rules[site].append(rule)
         return rule
 
@@ -179,6 +249,7 @@ class FaultRegistry:
             self._rules.clear()
             self.hits.clear()
             self.log.clear()
+            self.sleep = time.sleep   # drop any test-injected fake clock
         else:
             self._rules.pop(site, None)
 
@@ -208,7 +279,7 @@ class FaultRegistry:
                 _INJECTED.inc(site=site)
                 _trace_instant(f"fault:{site}", hit=hit)
                 FLIGHT.record("fault", site=site, hit=hit)
-                out = rule.fire(ctx)
+                out = rule.fire(ctx, self.sleep)
         return out
 
 

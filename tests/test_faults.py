@@ -5,10 +5,15 @@ indices they were given, seeded schedules replay bit-for-bit, scopes
 clean up after themselves. Every serving/training chaos test builds on
 these semantics.
 """
+import pathlib
+import re
+
 import pytest
 
-from paddle_tpu.utils.faults import (FAULTS, FaultRegistry, InjectedCrash,
-                                     InjectedFault, fault_point, fault_value)
+import paddle_tpu.utils.faults as faults
+from paddle_tpu.utils.faults import (FAULTS, SITES, FaultRegistry,
+                                     InjectedCrash, InjectedFault,
+                                     fault_point, fault_value)
 
 pytestmark = pytest.mark.chaos
 
@@ -119,3 +124,60 @@ def test_stall_action_sleeps():
     t0 = time.monotonic()
     fault_point("z")
     assert time.monotonic() - t0 >= 0.04
+
+
+# ------------------------------------------------------- delay faults
+
+def test_delay_alone_sleeps_and_returns_none():
+    """A pure delay rule slows the site down but injects no failure —
+    the straggler fault (ISSUE 16). The sleep goes through the
+    registry's swappable ``FAULTS.sleep`` so tests stay instant."""
+    slept = []
+    FAULTS.sleep = slept.append
+    FAULTS.install("d", on={0, 1}, delay_s=0.25)
+    assert fault_point("d") is None        # delayed, NOT raised
+    assert fault_point("d") is None
+    assert fault_point("d") is None        # hit 2: not matched, no sleep
+    assert slept == [0.25, 0.25]
+
+
+def test_delay_composes_with_exc_and_action():
+    """``delay_s`` stacks under the other behaviours: sleep first, then
+    raise/act — a slow failure, not a fast one."""
+    slept = []
+    FAULTS.sleep = slept.append
+    FAULTS.install("dx", on={0}, delay_s=0.1, exc=InjectedFault)
+    with pytest.raises(InjectedFault):
+        fault_point("dx")
+    FAULTS.install("da", on={0}, delay_s=0.2, action=lambda c: "v")
+    assert fault_value("da", "default") == "v"
+    assert slept == [0.1, 0.2]
+
+
+def test_clear_restores_real_sleep():
+    import time
+    FAULTS.sleep = lambda s: None
+    FAULTS.clear()
+    assert FAULTS.sleep is time.sleep
+
+
+# ------------------------------------------------- site registry (SITES)
+
+def test_sites_registry_matches_code():
+    """Every ``fault_point``/``fault_value`` site literal in the package
+    is documented in ``faults.SITES`` and vice versa — a new chaos site
+    cannot land without its one-line contract, and a dead entry cannot
+    linger after the site is removed."""
+    pkg = pathlib.Path(faults.__file__).resolve().parents[1]
+    pat = re.compile(r"fault_(?:point|value)\(\s*['\"]([a-z_.]+)['\"]")
+    found = set()
+    for py in pkg.rglob("*.py"):
+        found |= set(pat.findall(py.read_text()))
+    assert found == set(SITES), (
+        f"undocumented sites: {sorted(found - set(SITES))}; "
+        f"stale SITES entries: {sorted(set(SITES) - found)}")
+
+
+def test_sites_have_contracts():
+    for site, contract in SITES.items():
+        assert isinstance(contract, str) and contract.strip(), site
