@@ -155,7 +155,8 @@ def cpu_legs_main():
                     ("serving_router", bench_serving_router),
                     ("serving_prefix", bench_serving_prefix),
                     ("serving_multilora", bench_serving_multilora),
-                    ("serving_degradation", bench_serving_degradation)):
+                    ("serving_degradation", bench_serving_degradation),
+                    ("serving_quant", bench_serving_quant)):
         try:
             out[key] = fn()
         except Exception as e:  # noqa: BLE001 — per-leg isolation
@@ -168,7 +169,7 @@ def cpu_legs_main():
                          "serving_pallas_", "serving_adapter_",
                          "serving_tenant_", "serving_grammar_",
                          "serving_degrade_", "serving_session_",
-                         "moe_", "router_"))}
+                         "serving_quant_", "moe_", "router_"))}
     print(json.dumps(out))
 
 
@@ -1316,6 +1317,98 @@ def bench_serving_degradation():
     }
 
 
+def bench_serving_quant():
+    """Quantized-serving leg (ISSUE 17): the same continuous-batch greedy
+    workload through three engine arms — bf16, int8 paged KV, and
+    int8 KV + weight-only int8 checkpoint — reporting tokens/sec, the
+    KV bytes ONE token occupies (codes + per-position scales, from
+    ``cache_block_bytes``), how many max-length sessions a fixed HBM
+    pool budget holds at that footprint, and the quality bar: logit MSE
+    of the quantized checkpoint plus the greedy token match rate of each
+    quantized arm against the bf16 stream. Capacity is arithmetic on
+    actual pool dtypes (exact on CPU); quality is measured, not assumed.
+    CPU-safe."""
+    import copy
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.paged import clear_jit_caches
+    from paddle_tpu.serving import LLMEngine, Request
+    from paddle_tpu.serving.kv import cache_block_bytes
+    from paddle_tpu.serving.quant import quant_quality, quantize_for_serving
+
+    pt.seed(0)
+    kw = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+              num_attention_heads=8, num_key_value_heads=4,
+              max_position_embeddings=256)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=4, **kw))
+    qmodel = quantize_for_serving(copy.deepcopy(model), "weight_only_int8",
+                                  smooth=True)
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 512, (int(l),))
+               for l in rs.randint(8, 32, size=16)]
+    max_new, max_seq = 16, 64
+    pool_budget = 64 << 20                   # fixed HBM budget per chip
+
+    def arm(m, kv_dtype):
+        def mk():
+            return LLMEngine(m, num_slots=8, block_size=8,
+                             max_prompt_len=32, max_seq_len=max_seq,
+                             kv_dtype=kv_dtype)
+        weng = mk()                                  # warmup / compile
+        for p in prompts[:4]:
+            weng.add_request(Request(p, max_new_tokens=2))
+        weng.run()
+        eng = mk()
+        for p in prompts:
+            eng.add_request(Request(p, max_new_tokens=max_new))
+        t0 = time.perf_counter()
+        out = eng.run()
+        dt = time.perf_counter() - t0
+        eng.assert_quiescent()
+        block_bytes = cache_block_bytes(eng.cache)
+        per_tok = block_bytes / eng.mgr.block_size
+        blocks_per_session = -(-max_seq // eng.mgr.block_size)
+        return {
+            "tokens_per_sec": round(
+                sum(len(t) for t in out.values()) / dt, 1),
+            "kv_bytes_per_token": round(per_tok, 1),
+            "sessions_per_chip": pool_budget
+            // (blocks_per_session * block_bytes),
+        }, {r: list(map(int, t)) for r, t in out.items()}
+
+    def match(ref, out):
+        pairs = [(x, y) for r in ref for x, y in zip(ref[r], out[r])]
+        return round(float(np.mean([x == y for x, y in pairs])), 4)
+
+    clear_jit_caches()           # kv mode is baked into traces (PR-10)
+    bf16, ref_out = arm(model, None)
+    clear_jit_caches()
+    int8_kv, kv_out = arm(model, "int8")
+    clear_jit_caches()
+    int8_full, full_out = arm(qmodel, "int8")
+    clear_jit_caches()
+    import jax.numpy as jnp
+    ids = jnp.asarray(rs.randint(0, 512, size=(4, 24)))
+    quality = quant_quality(np.asarray(model(ids)), qmodel(ids))
+    int8_kv["greedy_match_rate"] = match(ref_out, kv_out)
+    int8_full["greedy_match_rate"] = match(ref_out, full_out)
+    return {
+        "bf16": bf16, "int8_kv": int8_kv,
+        "int8_kv_int8_weights": int8_full,
+        "kv_bytes_ratio": round(int8_kv["kv_bytes_per_token"]
+                                / bf16["kv_bytes_per_token"], 3),
+        "sessions_gain": round(int8_full["sessions_per_chip"]
+                               / bf16["sessions_per_chip"], 3),
+        "weight_logit_mse": quality["logit_mse"],
+        "weight_greedy_match_rate": quality["greedy_match_rate"],
+        "pool_budget_bytes": pool_budget,
+        "requests": len(prompts), "max_new_tokens": max_new,
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1522,6 +1615,7 @@ def main():
                                       "serving_grammar_",
                                       "serving_degrade_",
                                       "serving_session_",
+                                      "serving_quant_",
                                       "moe_", "router_"))},
         "host_overlap": host_overlap,
         "serving_spec": serving_spec,

@@ -193,7 +193,20 @@ class ExpertMLP(Module):
 
     def __call__(self, x_e):
         """x_e: [E, C, H] — per-expert token slots."""
-        return expert_mlp_apply(x_e, self.gate_up, self.down)
+        return expert_mlp_apply(x_e, *_expert_arrays(self, x_e.dtype))
+
+
+def _expert_arrays(experts, dtype):
+    """Weight-only-quantized expert stacks (``serving.quant.
+    QuantizedExpertStack``) dequantize on the fly inside the jitted
+    forward; plain arrays pass through untouched. Duck-typed on
+    ``dequantize`` so this module never imports the serving layer."""
+    gu, dn = experts.gate_up, experts.down
+    if hasattr(gu, "dequantize"):
+        gu = gu.dequantize(dtype)
+    if hasattr(dn, "dequantize"):
+        dn = dn.dequantize(dtype)
+    return gu, dn
 
 
 def expert_mlp_apply(x_e, gate_up, down):
@@ -276,12 +289,12 @@ class MoELayer(Module):
         logits = xt.astype(jnp.float32) @ self.gate_w
         route, aux, drop = top_k_route(logits, self.k, cap,
                                        self.norm_topk_prob)
+        gate_up, down = _expert_arrays(self.experts, x.dtype)
         if grouped_gemm_enabled():
-            yt = grouped_forward(xt, route, self.experts.gate_up,
-                                 self.experts.down, t)
+            yt = grouped_forward(xt, route, gate_up, down, t)
         else:
             x_e, dest = sparse_dispatch(xt, route, e, cap)
-            y_e = self.experts(x_e)
+            y_e = expert_mlp_apply(x_e, gate_up, down)
             yt = sparse_combine(y_e, route, dest, t)
         return yt.reshape(b, s, h), aux, drop
 
@@ -380,6 +393,9 @@ class MoELayer(Module):
             local, mesh=mesh.mesh,
             in_specs=(xspec, P(), P("ep", None, None), P("ep", None, None)),
             out_specs=(xspec, P(), P()))
-        yt, aux, drop = fn(x.reshape(t, h), self.gate_w,
-                           self.experts.gate_up, self.experts.down)
+        # quantized stacks dequantize BEFORE the shard_map (codes would
+        # need their own ep pspecs); the all_to_all wire format and the
+        # per-shard compute are unchanged
+        gate_up, down = _expert_arrays(self.experts, x.dtype)
+        yt, aux, drop = fn(x.reshape(t, h), self.gate_w, gate_up, down)
         return yt.reshape(b, s, h), aux, drop

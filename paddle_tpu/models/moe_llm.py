@@ -90,7 +90,8 @@ class MoEForCausalLM(Module):
             else:
                 x = lyr(x, cos, sin)
         x = self.norm(x)
-        return x @ self.lm_head, aux_total
+        from paddle_tpu.quantization import wo_matmul
+        return wo_matmul(x, self.lm_head), aux_total
 
     def loss(self, input_ids, labels):
         from paddle_tpu.distributed.tensor_parallel import parallel_cross_entropy

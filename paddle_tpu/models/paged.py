@@ -29,18 +29,29 @@ import numpy as np
 
 from paddle_tpu.observability.memledger import MemLedger
 from paddle_tpu.ops import attention as A
-from paddle_tpu.ops.pallas.paged_attention import (paged_chunk_attention,
+from paddle_tpu.ops.pallas.paged_attention import (_note_trace,
+                                                   paged_chunk_attention,
                                                    paged_decode_attention)
 from paddle_tpu.quantization import wo_matmul as _wo
 
 
 @dataclass
 class PagedKVCache:
-    """Per-layer block pools + per-sequence block tables (pytree)."""
+    """Per-layer block pools + per-sequence block tables (pytree).
+
+    ``k_scales``/``v_scales`` are EMPTY for the bf16 pool (the legacy
+    4-arg construction still works) and hold per-layer
+    [N_blocks, block_size, H_kv] f32 scale pools when the KV pool is
+    int8 (``init(..., kv_dtype="int8")``): element (n, o, h) is the
+    absmax/127 scale of pool row (n, o, h, :). Tuple truthiness is
+    STATIC pytree structure, so jitted forwards branch on
+    ``if cache.k_scales:`` at trace time — the bf16 trace is unchanged."""
     k_pools: list   # [L] of [N_blocks, block_size, H_kv, D]
     v_pools: list
     block_tables: jnp.ndarray  # [B, max_blocks] int32 (pad = n_blocks)
     lens: jnp.ndarray          # [B] int32 — tokens currently in cache
+    k_scales: tuple = ()       # [L] of [N_blocks, block_size, H_kv] f32
+    v_scales: tuple = ()
 
     @property
     def block_size(self):
@@ -56,20 +67,77 @@ class PagedKVCache:
 
     @staticmethod
     def init(num_layers, num_blocks, block_size, num_kv_heads, head_dim,
-             batch, max_blocks_per_seq, dtype):
+             batch, max_blocks_per_seq, dtype, kv_dtype=None):
+        pool_dtype = dtype
+        k_scales = v_scales = ()
+        if kv_dtype is not None:
+            if jnp.dtype(kv_dtype) != jnp.int8:
+                raise ValueError(
+                    f"unsupported kv_dtype {kv_dtype!r}: only 'int8' "
+                    "(per-position absmax scales) or None (model dtype)")
+            pool_dtype = jnp.int8
+            zs = lambda: jnp.zeros((num_blocks, block_size, num_kv_heads),
+                                   jnp.float32)
+            k_scales = tuple(zs() for _ in range(num_layers))
+            v_scales = tuple(zs() for _ in range(num_layers))
         z = lambda: jnp.zeros((num_blocks, block_size, num_kv_heads,
-                               head_dim), dtype)
+                               head_dim), pool_dtype)
         return PagedKVCache(
             [z() for _ in range(num_layers)],
             [z() for _ in range(num_layers)],
             jnp.full((batch, max_blocks_per_seq), num_blocks, jnp.int32),
-            jnp.zeros((batch,), jnp.int32))
+            jnp.zeros((batch,), jnp.int32), k_scales, v_scales)
 
 
 jax.tree_util.register_pytree_node(
     PagedKVCache,
-    lambda c: ((c.k_pools, c.v_pools, c.block_tables, c.lens), None),
+    lambda c: ((c.k_pools, c.v_pools, c.block_tables, c.lens,
+                c.k_scales, c.v_scales), None),
     lambda aux, ch: PagedKVCache(*ch))
+
+
+# ------------------------------------------------ int8 KV quantization
+def kv_quant_enabled() -> bool:
+    """The ``PT_QUANT_KV`` kill switch, read at TRACE time (flip it
+    between engine constructions together with ``clear_jit_caches``)."""
+    return os.environ.get("PT_QUANT_KV", "1").strip().lower() \
+        not in ("0", "off")
+
+
+def _quantize_kv(vals):
+    """Per-(position, head) symmetric int8: vals [..., H, D] ->
+    (int8 [..., H, D], f32 scales [..., H]). absmax over D / 127; the
+    epsilon floor keeps all-zero rows (padding) at scale ~0 without a
+    0/0."""
+    if not kv_quant_enabled():
+        raise RuntimeError(
+            "PT_QUANT_KV=0 but an int8 KV pool is being traced — rebuild "
+            "the engine under the kill switch (bf16 pool) and call "
+            "models.paged.clear_jit_caches() so no stale int8 trace runs")
+    _note_trace("kv:int8-write")
+    f = vals.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(f), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(f / scale[..., None]), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def _scatter_kv(cache, li, k, v, scatter, *args):
+    """Scatter layer ``li``'s new K/V through ``scatter`` (one of the
+    three scatter primitives below — all are ``(pool, vals, *rest)`` and
+    trailing-dim generic). bf16 pool: plain writes, scale slots None.
+    int8 pool: quantize-on-write — the int8 codes land in the pools and
+    the absmax scales in the parallel scale pools via the SAME scatter
+    (same table/len/active masking, so codes and scales never desync)."""
+    if not cache.k_scales:
+        return (scatter(cache.k_pools[li], k, *args),
+                scatter(cache.v_pools[li], v, *args), None, None)
+    qk, sk = _quantize_kv(k)
+    qv, sv = _quantize_kv(v)
+    return (scatter(cache.k_pools[li], qk, *args),
+            scatter(cache.v_pools[li], qv, *args),
+            scatter(cache.k_scales[li], sk, *args),
+            scatter(cache.v_scales[li], sv, *args))
 
 
 class BlockManager:
@@ -856,7 +924,7 @@ def _model_logits(model, x):
     fn = getattr(model, "logits", None)
     if callable(fn):
         return fn(x)
-    return x @ model.lm_head
+    return _wo(x, model.lm_head)
 
 
 def _mlp_out(lyr, h):
@@ -960,7 +1028,7 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
         cur_len=(prompt_lens if (scaling or {}).get("type") == "dynamic"
                  else None),
         allow_dynamic=False)
-    k_pools, v_pools = [], []
+    k_pools, v_pools, k_scales, v_scales = [], [], [], []
     for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
@@ -974,13 +1042,19 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
         q = A.apply_rope(q.reshape(b, s, nh, hd), cos, sin)
         k = A.apply_rope(k.reshape(b, s, nkv, hd), cos, sin)
         v = v.reshape(b, s, nkv, hd)
+        # the prompt's own attention is dense over the LOCAL pre-
+        # quantization k/v — only the pool writes quantize, so prefill
+        # quality is exactly the decode dequantization error, never worse
         out = A.scaled_dot_product_attention(q, k, v, is_causal=True,
                                              kv_lens=prompt_lens,
                                              window=getattr(cfg, "sliding_window", None))
-        k_pools.append(_scatter_prefill(cache.k_pools[li], k, tables,
-                                        prompt_lens, nb, bs))
-        v_pools.append(_scatter_prefill(cache.v_pools[li], v, tables,
-                                        prompt_lens, nb, bs))
+        kp, vp, ks, vs = _scatter_kv(cache, li, k, v, _scatter_prefill,
+                                     tables, prompt_lens, nb, bs)
+        k_pools.append(kp)
+        v_pools.append(vp)
+        if ks is not None:
+            k_scales.append(ks)
+            v_scales.append(vs)
         attn_out = out.reshape(b, s, nh * hd)
         proj = _wo(attn_out, att.o_proj)
         if lora is not None:
@@ -992,7 +1066,8 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
     last = jnp.take_along_axis(
         logits, jnp.maximum(prompt_lens - 1, 0)[:, None, None].astype(jnp.int32),
         axis=1)[:, 0]
-    new_cache = PagedKVCache(k_pools, v_pools, new_tables, new_lens)
+    new_cache = PagedKVCache(k_pools, v_pools, new_tables, new_lens,
+                             tuple(k_scales), tuple(v_scales))
     return last, new_cache
 
 
@@ -1009,7 +1084,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
                           getattr(cfg, "rope_scaling", None),
                           getattr(cfg, "max_position_embeddings", None))
     window = getattr(cfg, "sliding_window", None)
-    k_pools, v_pools = [], []
+    k_pools, v_pools, k_scales, v_scales = [], [], [], []
     new_lens = jnp.where(active, cache.lens + 1, cache.lens)
     for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
@@ -1024,18 +1099,20 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
         q = _apply_rope_rows(q.reshape(b, 1, nh, hd), cos, sin)
         k = _apply_rope_rows(k.reshape(b, 1, nkv, hd), cos, sin)
         v = v.reshape(b, 1, nkv, hd)
-        k_pool = _scatter_decode(cache.k_pools[li], k, cache.block_tables,
-                                 cache.lens, active, nb, bs)
-        v_pool = _scatter_decode(cache.v_pools[li], v, cache.block_tables,
-                                 cache.lens, active, nb, bs)
+        k_pool, v_pool, ks, vs = _scatter_kv(
+            cache, li, k, v, _scatter_decode, cache.block_tables,
+            cache.lens, active, nb, bs)
         k_pools.append(k_pool)
         v_pools.append(v_pool)
+        if ks is not None:
+            k_scales.append(ks)
+            v_scales.append(vs)
         # sliding-window configs: the pool retains all tokens (blocks
         # below the window could be recycled — not done yet) but decode
         # attends only the last `window` positions, matching prefill
         out = paged_decode_attention(q[:, 0], k_pool, v_pool,
                                      cache.block_tables, new_lens,
-                                     window=window)
+                                     window=window, k_scale=ks, v_scale=vs)
         attn_out = out.reshape(b, 1, nh * hd)
         proj = _wo(attn_out, att.o_proj)
         if lora is not None:
@@ -1045,7 +1122,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
     x = _backbone(model).norm(x)
     logits = _model_logits(model, x)[:, 0]
     return logits, PagedKVCache(k_pools, v_pools, cache.block_tables,
-                                new_lens)
+                                new_lens, tuple(k_scales), tuple(v_scales))
 
 
 def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
@@ -1067,7 +1144,8 @@ def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
     from paddle_tpu.models.decoding import _sample_rows
     tables = cache.block_tables.at[upd_rows, upd_cols].set(upd_vals,
                                                            mode="drop")
-    cache = PagedKVCache(cache.k_pools, cache.v_pools, tables, cache.lens)
+    cache = PagedKVCache(cache.k_pools, cache.v_pools, tables, cache.lens,
+                         cache.k_scales, cache.v_scales)
     logits, cache = llama_decode_step_paged(model, tokens, cache, active,
                                             lora)
     logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -1097,13 +1175,21 @@ _TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(10, 11),
                     donate_argnums=(2,))
 
 
+# jits registered by downstream serving modules (serving/quant.py,
+# serving/transfer.py) so ONE clear_jit_caches() call covers every
+# serving trace — the env-flip contract (PT_QUANT_KV, PT_QUANT_WEIGHTS,
+# PT_PAGED_CHUNK, ...) needs no second clearing entry point
+_EXTRA_CLEAR: list = []
+
+
 def clear_jit_caches():
     """Drop every module-level serving jit cache. Needed when trace-time
     context changes under the same call signature — flipping
     ``PT_GROUPED_GEMM`` or ``PT_MULTILORA_IMPL``, or entering/leaving a
     mesh re-routes layers, but the jit caches key on shapes only."""
     for f in (_PREFILL_JIT, _DECODE_JIT, _TICK_JIT, _PREFILL_CHUNK_JIT,
-              _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT, _PREFIX_COW_JIT):
+              _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT, _PREFIX_COW_JIT,
+              *_EXTRA_CLEAR):
         f.clear_cache()
 
 
@@ -1114,14 +1200,21 @@ def _copy_partial_blocks(pools, copy_src, copy_dst):
                                mode="drop") for p in pools]
 
 
+def _cow_pools(cache: PagedKVCache, copy_src, copy_dst):
+    """COW-copy the K/V pools AND (when quantized) their scale pools —
+    a partial block's int8 codes are meaningless without the matching
+    scale rows, so the two must fork together."""
+    return (_copy_partial_blocks(cache.k_pools, copy_src, copy_dst),
+            _copy_partial_blocks(cache.v_pools, copy_src, copy_dst),
+            tuple(_copy_partial_blocks(cache.k_scales, copy_src, copy_dst)),
+            tuple(_copy_partial_blocks(cache.v_scales, copy_src, copy_dst)))
+
+
 def _beam_cache_update(cache: PagedKVCache, new_tables, copy_src, copy_dst):
     """Apply a beam reorder to the paged cache: install the forked block
     tables and copy the (at most one per beam) private partial blocks."""
-    return PagedKVCache(_copy_partial_blocks(cache.k_pools, copy_src,
-                                             copy_dst),
-                        _copy_partial_blocks(cache.v_pools, copy_src,
-                                             copy_dst),
-                        new_tables, cache.lens)
+    k, v, ks, vs = _cow_pools(cache, copy_src, copy_dst)
+    return PagedKVCache(k, v, new_tables, cache.lens, ks, vs)
 
 
 def _prefix_cow_update(cache: PagedKVCache, copy_src, copy_dst):
@@ -1130,10 +1223,8 @@ def _prefix_cow_update(cache: PagedKVCache, copy_src, copy_dst):
     and lens are untouched — the adopters' tables already point at the
     dst blocks. copy_src/copy_dst: [K] block ids, sentinel num_blocks =
     no copy."""
-    return PagedKVCache(
-        _copy_partial_blocks(cache.k_pools, copy_src, copy_dst),
-        _copy_partial_blocks(cache.v_pools, copy_src, copy_dst),
-        cache.block_tables, cache.lens)
+    k, v, ks, vs = _cow_pools(cache, copy_src, copy_dst)
+    return PagedKVCache(k, v, cache.block_tables, cache.lens, ks, vs)
 
 
 _PREFIX_COW_JIT = jax.jit(_prefix_cow_update, donate_argnums=(0,))
@@ -1158,11 +1249,8 @@ def _beam_group_update(cache: PagedKVCache, slot_ids, rows, lens_val,
     copy_src/copy_dst [K] (sentinel num_blocks = no copy)."""
     tables = cache.block_tables.at[slot_ids].set(rows)
     lens = cache.lens.at[slot_ids].set(jnp.int32(lens_val))
-    return PagedKVCache(_copy_partial_blocks(cache.k_pools, copy_src,
-                                             copy_dst),
-                        _copy_partial_blocks(cache.v_pools, copy_src,
-                                             copy_dst),
-                        tables, lens)
+    k, v, ks, vs = _cow_pools(cache, copy_src, copy_dst)
+    return PagedKVCache(k, v, tables, lens, ks, vs)
 
 
 def _beam_finalize(running_lp, seqs, fin_seqs, fin_scores, prompt_len,
@@ -1237,7 +1325,8 @@ def paged_beam_search(model, prompt, max_new_tokens=32, num_beams=4,
         jnp.asarray(rows[:1]))
     cache = PagedKVCache(cache.k_pools, cache.v_pools,
                          jnp.asarray(rows),
-                         jnp.full((K,), s, jnp.int32))
+                         jnp.full((K,), s, jnp.int32),
+                         cache.k_scales, cache.v_scales)
     cache = _BEAM_UPDATE_JIT(cache, jnp.asarray(rows),
                              jnp.asarray(copy_src), jnp.asarray(copy_dst))
 
@@ -1438,7 +1527,7 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         return jnp.concatenate([t1 * cos - t2 * sin, t2 * cos + t1 * sin],
                                axis=-1).astype(t.dtype)
 
-    k_pools, v_pools = [], []
+    k_pools, v_pools, k_scales, v_scales = [], [], [], []
     for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
@@ -1453,17 +1542,20 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         k = rope(k.reshape(a, c, nkv, hd))
         v = v.reshape(a, c, nkv, hd)
         # scatter the chunk FIRST so the gathered view holds prefix+chunk
-        k_pool = _scatter_decode_chunk(cache.k_pools[li], k, tables,
-                                       offsets, chunk_lens, nb, bs)
-        v_pool = _scatter_decode_chunk(cache.v_pools[li], v, tables,
-                                       offsets, chunk_lens, nb, bs)
+        k_pool, v_pool, ks, vs = _scatter_kv(
+            cache, li, k, v, _scatter_decode_chunk, tables, offsets,
+            chunk_lens, nb, bs)
         k_pools.append(k_pool)
         v_pools.append(v_pool)
+        if ks is not None:
+            k_scales.append(ks)
+            v_scales.append(vs)
         # ragged pool-direct attention: the kernel reads only each row's
         # live blocks (the XLA fallback reconstructs the old full
         # gather + dense-mask view, bit-compatible)
         out = paged_chunk_attention(q, k_pool, v_pool, tables, offsets,
-                                    chunk_lens, window=window)
+                                    chunk_lens, window=window,
+                                    k_scale=ks, v_scale=vs)
         attn_out = out.reshape(a, c, nh * hd)
         proj = _wo(attn_out, att.o_proj)
         if lora is not None:
@@ -1472,7 +1564,8 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         x = x + _mlp_out(lyr, lyr.post_attention_layernorm(x))
     x = _backbone(model).norm(x)
     logits = _model_logits(model, x)
-    new_cache = PagedKVCache(k_pools, v_pools, new_tables, new_lens)
+    new_cache = PagedKVCache(k_pools, v_pools, new_tables, new_lens,
+                             tuple(k_scales), tuple(v_scales))
     if full_logits:
         return logits, new_cache
     last = jnp.take_along_axis(
@@ -1534,7 +1627,7 @@ def spec_rewind_lens(cache: PagedKVCache, slot_ids, new_lens):
     lens = cache.lens.at[slot_ids].set(
         jnp.asarray(new_lens, jnp.int32), mode="drop")
     return PagedKVCache(cache.k_pools, cache.v_pools, cache.block_tables,
-                        lens)
+                        lens, cache.k_scales, cache.v_scales)
 
 
 def spec_advance_frontiers(pos, draft_pos, n_new):

@@ -128,7 +128,8 @@ class Qwen2MoeForCausalLM(Module):
         for lyr in self.layers:
             x, aux = lyr(x, cos, sin)
             aux_total = aux_total + aux
-        return self.norm(x) @ self.lm_head, aux_total
+        from paddle_tpu.quantization import wo_matmul
+        return wo_matmul(self.norm(x), self.lm_head), aux_total
 
     def __call__(self, input_ids):
         return self._forward(input_ids)[0]

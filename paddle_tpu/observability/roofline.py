@@ -119,6 +119,12 @@ class ModelGeometry:
     dtype_bytes: int = 2          # bf16 weights and KV
     num_experts: int = 0          # routed experts (0 = dense MLP)
     experts_per_tok: int = 0
+    # quantized serving (ISSUE 17) — actual storage dtypes, so an int8
+    # pool or weight-only model is not billed at bf16 (which would
+    # double its bytes and overstate MBU). 0 = inherit dtype_bytes.
+    kv_dtype_bytes: int = 0       # bytes per cached KV element
+    kv_scale_bytes: int = 0       # extra bytes per (position, kv-head)
+    weight_dtype_bytes: float = 0.0   # 1.0 int8, 0.5 packed int4
 
     @classmethod
     def from_config(cls, cfg, dtype_bytes: int = 2) -> "ModelGeometry":
@@ -168,15 +174,19 @@ class ModelGeometry:
 
 
 def weight_bytes(geom: ModelGeometry) -> float:
-    """Bytes of weights one jitted forward reads from HBM."""
-    return float(geom.resident_params) * geom.dtype_bytes
+    """Bytes of weights one jitted forward reads from HBM (honouring
+    weight-only quantization when ``weight_dtype_bytes`` is set)."""
+    return float(geom.resident_params) * (geom.weight_dtype_bytes
+                                          or geom.dtype_bytes)
 
 
 def kv_bytes_per_position(geom: ModelGeometry) -> float:
     """K + V bytes of ONE cached position across all layers; GQA head
-    grouping makes this kv_heads/heads of the MHA figure."""
-    return float(geom.num_layers * 2 * geom.kv_heads * geom.head_dim
-                 * geom.dtype_bytes)
+    grouping makes this kv_heads/heads of the MHA figure. An int8 pool
+    stores head_dim codes plus a per-(position, kv-head) scale."""
+    per_head = (geom.head_dim * (geom.kv_dtype_bytes or geom.dtype_bytes)
+                + geom.kv_scale_bytes)
+    return float(geom.num_layers * 2 * geom.kv_heads * per_head)
 
 
 def phase_flops(geom: ModelGeometry, tokens: float,

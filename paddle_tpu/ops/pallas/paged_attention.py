@@ -87,11 +87,17 @@ def _disable_pallas(kernel: str, err: Exception):
         "the rest of the process", RuntimeWarning, stacklevel=3)
 
 
-def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                         acc, m_sc, l_sc, *, block_size, scale, max_blocks,
-                         window):
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                         block_size, scale, max_blocks, window, quantized):
     """Grid (B*H, max_blocks); block j of row bh is pool block
-    tables[bh, j] (resolved by the BlockSpec index maps)."""
+    tables[bh, j] (resolved by the BlockSpec index maps). ``quantized``
+    (static) adds two per-position scale refs after v_ref: the pool holds
+    int8 and K/V are dequantized in-kernel (f32 multiply — the matmul
+    already upcasts, so the bf16 trace is unchanged when off)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc, m_sc, l_sc = rest
+    else:
+        o_ref, acc, m_sc, l_sc = rest
     bh = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -112,9 +118,14 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(live)
     def _compute():
         q = q_ref[0]          # [1, D] — this head's single query row
-        k = k_ref[0, 0]       # [block_size, D]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(q.astype(jnp.float32), k.astype(jnp.float32),
+        k = k_ref[0, 0].astype(jnp.float32)   # [block_size, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            # per-(position, head) absmax scales: [block_size, 1]
+            # broadcasts over D
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
+        s = jax.lax.dot_general(q.astype(jnp.float32), k,
                                 (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         # mask positions beyond the sequence length within the last block
@@ -129,7 +140,7 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)
         l_sc[0, 0] = l_sc[0, 0] * corr + jnp.sum(p)
         m_sc[0, 0] = m_new
-        pv = jax.lax.dot_general(p, v.astype(jnp.float32),
+        pv = jax.lax.dot_general(p, v,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc[:] = acc[:] * corr + pv
@@ -140,17 +151,21 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
-                                  scale=None, window=None,
+                                  scale=None, window=None, k_scale=None,
+                                  v_scale=None,
                                   interpret: bool | None = None):
     """One decode step over block tables. q: [B, H, D];
     k_pool/v_pool: [N, bs, H_kv, D]; block_tables: [B, max_blocks] int32;
     lens: [B] int32 (current lengths INCLUDING the new token, whose K/V
-    must already be written to the pool). Returns [B, H, D]."""
+    must already be written to the pool). ``k_scale``/``v_scale``
+    [N, bs, H_kv] f32 dequantize an int8 pool in-kernel (per-position,
+    per-head absmax scales). Returns [B, H, D]."""
     b, h, d = q.shape
     n, bs, h_kv, _ = k_pool.shape
     kv_rep = h // h_kv
     max_blocks = block_tables.shape[1]
     scale = scale if scale is not None else d ** -0.5
+    quantized = k_scale is not None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -169,14 +184,24 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
         # compute is masked off by lens in the kernel
         return ((bh % h) // kv_rep, jnp.minimum(tables[bh, j], n - 1), 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, d), lambda bh, j, t, l: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [qf, kp, vp]
+    if quantized:
+        # scale pools ride the same index map as their int8 pools:
+        # [H_kv, N, bs, 1], one lane per position
+        in_specs += [pl.BlockSpec((1, 1, bs, 1), kv_index),
+                     pl.BlockSpec((1, 1, bs, 1), kv_index)]
+        operands += [jnp.moveaxis(k_scale, 2, 0)[..., None],
+                     jnp.moveaxis(v_scale, 2, 0)[..., None]]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b * h, max_blocks),
-        in_specs=[
-            pl.BlockSpec((1, 1, d), lambda bh, j, t, l: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, d), lambda bh, j, t, l: (bh, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((1, d), jnp.float32),
@@ -188,7 +213,7 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
     )
     kernel = functools.partial(_paged_decode_kernel, block_size=bs,
                                scale=scale, max_blocks=max_blocks,
-                               window=window)
+                               window=window, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -199,12 +224,13 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
         compiler_params=_CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
-    )(tables_bh, lens_bh, qf, kp, vp)
+    )(tables_bh, lens_bh, *operands)
     return out.reshape(b, h, d)
 
 
 def paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens, *,
-                               scale=None, window=None):
+                               scale=None, window=None, k_scale=None,
+                               v_scale=None):
     """Gather-based reference path (CPU tests / fallback). Same contract as
     the Pallas kernel; materialises the gathered K/V transiently."""
     b, h, d = q.shape
@@ -216,6 +242,13 @@ def paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens, *,
     tables = jnp.minimum(block_tables, n - 1)
     k = jnp.take(k_pool, tables, axis=0)  # [B, MB, bs, H_kv, D]
     v = jnp.take(v_pool, tables, axis=0)
+    if k_scale is not None:
+        # int8 pool: gather the scale rows the same way and dequantize in
+        # f32 (never downcast — the attention math below is f32 anyway)
+        k = k.astype(jnp.float32) * jnp.take(k_scale, tables,
+                                             axis=0)[..., None]
+        v = v.astype(jnp.float32) * jnp.take(v_scale, tables,
+                                             axis=0)[..., None]
     k = k.reshape(b, max_blocks * bs, h_kv, d)
     v = v.reshape(b, max_blocks * bs, h_kv, d)
     if h_kv != h:
@@ -234,22 +267,29 @@ def paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens, *,
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, lens, *,
-                           scale=None, window=None,
-                           interpret: bool | None = None):
+                           scale=None, window=None, k_scale=None,
+                           v_scale=None, interpret: bool | None = None):
     """Dispatch: Pallas on TPU (pool-direct block reads), XLA elsewhere.
     ``window``: sliding-window bound — only the last `window` positions
-    are visible (Mistral decode semantics). A Pallas failure downgrades
-    this process to the XLA path permanently (cached, warned, counted —
-    see ``_disable_pallas``)."""
+    are visible (Mistral decode semantics). ``k_scale``/``v_scale``
+    [N, bs, H_kv] f32 mark an int8 pool — dequantize-on-read in both
+    paths. A Pallas failure downgrades this process to the XLA path
+    permanently (cached, warned, counted — see ``_disable_pallas``)."""
+    if k_scale is not None:
+        # breadcrumb ONLY on the quantized branch, so bf16 traces stay
+        # byte-identical to pre-quantization builds
+        _note_trace("decode:int8-kv")
     if jax.default_backend() == "tpu" and "decode" not in _pallas_disabled:
         try:
             return paged_decode_attention_pallas(
                 q, k_pool, v_pool, block_tables, lens, scale=scale,
-                window=window, interpret=interpret)
+                window=window, k_scale=k_scale, v_scale=v_scale,
+                interpret=interpret)
         except Exception as e:
             _disable_pallas("decode", e)
     return paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens,
-                                      scale=scale, window=window)
+                                      scale=scale, window=window,
+                                      k_scale=k_scale, v_scale=v_scale)
 
 
 # --------------------------------------------------------- chunk kernel
@@ -264,12 +304,18 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lens, *,
 # issues a fresh DMA for them, and their compute is @pl.when-masked.
 
 def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
-                        o_ref, acc, m_scr, l_scr, *, block_size, scale,
-                        max_blocks, q_tile, group, n_kv, window):
+                        *rest, block_size, scale, max_blocks, q_tile,
+                        group, n_kv, window, quantized):
     """Grid (A*H_kv, q-tiles, kv-blocks). Row r serves sequence
     a = r // n_kv, KV head r % n_kv; its q tile holds ``q_tile`` folded
     rows (folded row t = query position t // group, grouped head
-    t % group). Online-softmax accumulation across the kv-block axis."""
+    t % group). Online-softmax accumulation across the kv-block axis.
+    ``quantized`` (static) adds two per-position scale refs after v_ref
+    (int8 pool, dequantize in-kernel)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     r = pl.program_id(0)
     qt = pl.program_id(1)
     j = pl.program_id(2)
@@ -300,9 +346,12 @@ def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
     @pl.when(live)
     def _compute():
         q = q_ref[0]                       # [q_tile, D] folded queries
-        k = k_ref[0, 0]                    # [block_size, D]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(q.astype(jnp.float32), k.astype(jnp.float32),
+        k = k_ref[0, 0].astype(jnp.float32)    # [block_size, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0]           # [block_size, 1] over D
+            v = v * vs_ref[0, 0]
+        s = jax.lax.dot_general(q.astype(jnp.float32), k,
                                 (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         row_t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -325,7 +374,7 @@ def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
             l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        pv = jax.lax.dot_general(p, v.astype(jnp.float32),
+        pv = jax.lax.dot_general(p, v,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc[:] = acc[:] * corr + pv
@@ -339,7 +388,8 @@ def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
 
 def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
                                  chunk_lens, *, scale=None, window=None,
-                                 q_tile=None, interpret: bool | None = None):
+                                 k_scale=None, v_scale=None, q_tile=None,
+                                 interpret: bool | None = None):
     """Ragged chunk attention over block tables. q: [A, C, H, D] (chunk
     queries, already rotated); k_pool/v_pool: [N, bs, H_kv, D] with the
     chunk K/V ALREADY scattered pool-side; block_tables: [A, max_blocks]
@@ -353,6 +403,7 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
     group = h // h_kv
     max_blocks = block_tables.shape[1]
     scale = scale if scale is not None else d ** -0.5
+    quantized = k_scale is not None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -393,14 +444,21 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
         return (r % n_kv_s, jnp.minimum(tables[a_i, jl], n - 1), 0, 0)
 
     n_kv_s = h_kv
+    in_specs = [
+        pl.BlockSpec((1, q_tile, d), q_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [qf, kp, vp]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, bs, 1), kv_index),
+                     pl.BlockSpec((1, 1, bs, 1), kv_index)]
+        operands += [jnp.moveaxis(k_scale, 2, 0)[..., None],
+                     jnp.moveaxis(v_scale, 2, 0)[..., None]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(a * h_kv, n_qt, max_blocks),
-        in_specs=[
-            pl.BlockSpec((1, q_tile, d), q_index),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, q_tile, d), q_index),
         scratch_shapes=[
             pltpu.VMEM((q_tile, d), jnp.float32),
@@ -413,7 +471,7 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
     kernel = functools.partial(_paged_chunk_kernel, block_size=bs,
                                scale=scale, max_blocks=max_blocks,
                                q_tile=q_tile, group=group, n_kv=h_kv,
-                               window=window)
+                               window=window, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -425,13 +483,14 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
                                  pltpu.ARBITRARY)),
         interpret=interpret,
-    )(tables, offs, cls, qf, kp, vp)
+    )(tables, offs, cls, *operands)
     out = out[:, :cg].reshape(a, h_kv, c, group, d)
     return out.transpose(0, 2, 1, 3, 4).reshape(a, c, h, d)
 
 
 def paged_chunk_attention_xla(q, k_pool, v_pool, block_tables, offsets,
-                              chunk_lens, *, scale=None, window=None):
+                              chunk_lens, *, scale=None, window=None,
+                              k_scale=None, v_scale=None):
     """Gather-based reference path (CPU / fallback): materialise each
     row's whole ``max_blocks*bs`` pool view and run dense masked
     attention — exactly the pre-kernel ``llama_prefill_chunk_paged``
@@ -444,8 +503,15 @@ def paged_chunk_attention_xla(q, k_pool, v_pool, block_tables, offsets,
     offsets = jnp.asarray(offsets, jnp.int32)
     chunk_lens = jnp.asarray(chunk_lens, jnp.int32)
     tbl = jnp.minimum(block_tables, n - 1)
-    kg = jnp.take(k_pool, tbl, axis=0).reshape(a, max_blocks * bs, h_kv, d)
-    vg = jnp.take(v_pool, tbl, axis=0).reshape(a, max_blocks * bs, h_kv, d)
+    kg = jnp.take(k_pool, tbl, axis=0)
+    vg = jnp.take(v_pool, tbl, axis=0)
+    if k_scale is not None:
+        kg = kg.astype(jnp.float32) * jnp.take(k_scale, tbl,
+                                               axis=0)[..., None]
+        vg = vg.astype(jnp.float32) * jnp.take(v_scale, tbl,
+                                               axis=0)[..., None]
+    kg = kg.reshape(a, max_blocks * bs, h_kv, d)
+    vg = vg.reshape(a, max_blocks * bs, h_kv, d)
     pool_pos = jnp.arange(max_blocks * bs)[None, None, :]
     q_pos = (offsets[:, None]
              + jnp.arange(c, dtype=jnp.int32))[:, :, None]
@@ -458,6 +524,7 @@ def paged_chunk_attention_xla(q, k_pool, v_pool, block_tables, offsets,
 
 def paged_chunk_attention(q, k_pool, v_pool, block_tables, offsets,
                           chunk_lens, *, scale=None, window=None,
+                          k_scale=None, v_scale=None,
                           interpret: bool | None = None):
     """One dispatch for the ragged chunk path. ``PT_PAGED_CHUNK``
     (read at TRACE time — flip it between engine constructions together
@@ -467,24 +534,30 @@ def paged_chunk_attention(q, k_pool, v_pool, block_tables, offsets,
       0/off/xla   force the XLA gather path (kill switch)
       interpret   force the interpreted Pallas kernel (off-TPU parity)
 
-    Like the decode dispatch, a Pallas failure downgrades the process
-    permanently (cached + warned + counted, never silently retried)."""
+    ``k_scale``/``v_scale`` [N, bs, H_kv] f32 mark an int8 pool —
+    dequantize-on-read in every implementation. Like the decode
+    dispatch, a Pallas failure downgrades the process permanently
+    (cached + warned + counted, never silently retried)."""
+    if k_scale is not None:
+        _note_trace("chunk:int8-kv")
     mode = os.environ.get("PT_PAGED_CHUNK", "1").strip().lower()
     if mode in ("0", "off", "xla"):
         _note_trace("chunk:xla-forced")
         return paged_chunk_attention_xla(
             q, k_pool, v_pool, block_tables, offsets, chunk_lens,
-            scale=scale, window=window)
+            scale=scale, window=window, k_scale=k_scale, v_scale=v_scale)
     if mode == "interpret":
         _note_trace("chunk:pallas-interpret")
         return paged_chunk_attention_pallas(
             q, k_pool, v_pool, block_tables, offsets, chunk_lens,
-            scale=scale, window=window, interpret=True)
+            scale=scale, window=window, k_scale=k_scale, v_scale=v_scale,
+            interpret=True)
     if jax.default_backend() == "tpu" and "chunk" not in _pallas_disabled:
         try:
             out = paged_chunk_attention_pallas(
                 q, k_pool, v_pool, block_tables, offsets, chunk_lens,
-                scale=scale, window=window, interpret=interpret)
+                scale=scale, window=window, k_scale=k_scale,
+                v_scale=v_scale, interpret=interpret)
             _note_trace("chunk:pallas")
             return out
         except Exception as e:
@@ -492,4 +565,4 @@ def paged_chunk_attention(q, k_pool, v_pool, block_tables, offsets,
     _note_trace("chunk:xla")
     return paged_chunk_attention_xla(
         q, k_pool, v_pool, block_tables, offsets, chunk_lens,
-        scale=scale, window=window)
+        scale=scale, window=window, k_scale=k_scale, v_scale=v_scale)

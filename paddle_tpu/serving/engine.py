@@ -39,6 +39,7 @@ from __future__ import annotations
 import os
 import time
 from contextlib import contextmanager
+from dataclasses import replace as _dc_replace
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +47,7 @@ import numpy as np
 
 from paddle_tpu.models.paged import (_beam_finalize, _BEAM_SELECT_JIT,
                                      greedy_accept_length, is_moe_model,
+                                     kv_quant_enabled,
                                      stochastic_accept_row)
 from paddle_tpu.observability import span as _span
 from paddle_tpu.observability.flight import FLIGHT
@@ -55,7 +57,7 @@ from paddle_tpu.observability.roofline import (ModelGeometry,
                                                record_serving_throughput,
                                                resolve_serving_peaks)
 from paddle_tpu.serving.executor import ModelExecutor, _SAMPLE_ROWS_JIT  # noqa: F401  (re-exported)
-from paddle_tpu.serving.kv import KVManager
+from paddle_tpu.serving.kv import KVManager, cache_block_bytes
 from paddle_tpu.serving.scheduler import Scheduler
 from paddle_tpu.serving.degrade import SessionSnapshot
 from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
@@ -95,9 +97,18 @@ class LLMEngine:
                  seed=0, prefix_caching=True, preemption=False,
                  max_queue_len=None, clock=None, draft_model=None,
                  spec_k=4, spec_adaptive=True, prefill_only=False,
-                 adapter_store=None, degrade=None):
+                 adapter_store=None, degrade=None, kv_dtype=None):
         cfg = model.cfg
         self.model = model
+        # quantized KV cache (ISSUE 17): kv_dtype="int8" stores the block
+        # pools as int8 with per-(position, kv-head) f32 scale pools.
+        # PT_QUANT_KV=0 is the kill switch — checked HERE (construction)
+        # so the engine falls back to model-dtype pools, and again at
+        # trace time inside the quantize-on-write path, so a stale int8
+        # trace can never silently run with the switch off.
+        if kv_dtype is not None and not kv_quant_enabled():
+            kv_dtype = None
+        self.kv_dtype = kv_dtype
         self.num_slots = num_slots
         self.block_size = block_size
         # graceful degradation (ISSUE 16): an optional shared
@@ -187,7 +198,8 @@ class LLMEngine:
             model, num_slots=num_slots, num_blocks=num_blocks,
             block_size=block_size, max_blocks_per_seq=self.max_blocks_per_seq,
             top_k=top_k, seed=seed, draft_model=draft_model,
-            spec_k=self.spec_k, max_seq_len=self.max_seq_len)
+            spec_k=self.spec_k, max_seq_len=self.max_seq_len,
+            kv_dtype=kv_dtype)
 
         # host mirrors (vectorised bookkeeping — no per-token python loops)
         self.slot_req = np.full(num_slots, -1, np.int64)   # req_id or -1
@@ -259,13 +271,25 @@ class LLMEngine:
         # 0.0 = undefined; PT_ROOFLINE_KIND overrides for what-if).
         # _tick_phase holds the CURRENT tick's wall-time split; step()
         # folds it into the breakdown histogram and these accumulators.
-        def _geom(m):
+        def _geom(m, cache=None):
             try:
-                return ModelGeometry.from_config(
+                g = ModelGeometry.from_config(
                     m.cfg, dtype_bytes=jnp.dtype(m.cfg.dtype).itemsize)
             except Exception:
                 return None      # adapter without a full config: no ledger
-        self._geom = _geom(model)
+            # quantized serving (ISSUE 17): bill the ACTUAL storage
+            # dtypes — int8 pools carry 1-byte codes + a 4-byte
+            # per-(position, kv-head) scale, weight-only models stream
+            # bits/8 bytes per param — or MBU would be overstated 2x
+            kw = {}
+            if cache is not None and getattr(cache, "k_scales", ()):
+                kw.update(kv_dtype_bytes=cache.k_pools[0].dtype.itemsize,
+                          kv_scale_bytes=4)
+            bits = getattr(m, "_wo_bits", None)
+            if bits:
+                kw["weight_dtype_bytes"] = bits / 8.0
+            return _dc_replace(g, **kw) if kw else g
+        self._geom = _geom(model, self.exe.cache)
         self._draft_geom = _geom(draft_model) if draft_model is not None \
             else None
         try:
@@ -1666,10 +1690,19 @@ class LLMEngine:
         idx[:len(t)] = t
         k, v = _GATHER_BLOCKS_JIT(self.cache.k_pools, self.cache.v_pools,
                                   jnp.asarray(idx))
+        ks = vs = None
+        if self.cache.k_scales:
+            # int8 pool: the codes are meaningless without their scales —
+            # gather the scale rows through the same program (distinct
+            # compile entry; the trailing dims differ)
+            ks, vs = _GATHER_BLOCKS_JIT(self.cache.k_scales,
+                                        self.cache.v_scales,
+                                        jnp.asarray(idx))
         payload = KVPayload(
             req=self.requests[rid], cur=int(self.cur[slot]),
             gen=int(self.gen[slot]), last_tok=int(self.last_tok[slot]),
-            n_blocks=len(t), block_size=self.block_size, k=k, v=v)
+            n_blocks=len(t), block_size=self.block_size, k=k, v=v,
+            k_scale=ks, v_scale=vs)
         # wire contract: geometry + checksums recorded while the blocks
         # are known-good, so the router can reject a partial transfer
         payload.seal()
@@ -1735,6 +1768,10 @@ class LLMEngine:
                 or payload.k.shape[2:] != pool.shape[1:]):
             raise ValueError("KV payload geometry does not match this "
                              "engine's pool (layers/heads/head_dim)")
+        if (payload.k_scale is not None) != bool(self.cache.k_scales):
+            raise ValueError("KV payload quantization does not match this "
+                             "engine's pool — source and target replicas "
+                             "must share kv_dtype")
         if payload.cur + self._remaining(req) > self.max_seq_len:
             raise ValueError("sequence + remaining tokens exceeds this "
                              "engine's max_seq_len")
@@ -1767,6 +1804,7 @@ class LLMEngine:
         row[:len(t)] = t
         self.cache = _INSTALL_BLOCKS_JIT(
             self.cache, jnp.asarray(idx), payload.k, payload.v,
+            payload.k_scale, payload.v_scale,
             jnp.int32(slot), jnp.asarray(row), jnp.int32(payload.cur))
         self.slot_req[slot] = rid
         self.active[slot] = True
@@ -1884,13 +1922,13 @@ class LLMEngine:
         self._push_roofline()
 
     def _kv_block_bytes(self) -> int:
-        """HBM bytes one pool block holds across all layers (K and V)."""
+        """HBM bytes one pool block holds across all layers (K and V,
+        plus the scale pools of a quantized cache) — the actual stored
+        dtypes, so ``serving_kv_bytes_per_token`` reports int8 pools at
+        their true (halved) footprint."""
         if self._block_bytes is None:
             try:
-                c = self.cache
-                self._block_bytes = sum(
-                    int(np.prod(p.shape[1:])) * p.dtype.itemsize
-                    for p in (*c.k_pools, *c.v_pools))
+                self._block_bytes = cache_block_bytes(self.cache)
             except Exception:
                 self._block_bytes = 0
         return self._block_bytes
@@ -1981,6 +2019,14 @@ class LLMEngine:
             # table_len untouched — cancel/free reclaims every block and
             # assert_quiescent stays clean (exception-atomic).
             fault_point("serving.moe_dispatch", engine=self,
+                        slots=np.nonzero(run_mask)[0])
+        if self.exe.cache.k_scales:
+            # chaos: quantize-on-write about to run inside the tick jit
+            # (int8 pools only). Fires BEFORE table growth and the
+            # donating tick, so an injected exception aborts with pools,
+            # scale pools, tables, and the ledger untouched — no leaked
+            # blocks, no stale scales (exception-atomic).
+            fault_point("serving.kv_quant", engine=self,
                         slots=np.nonzero(run_mask)[0])
         rows, cols, vals = self._grow_tables(run_mask & ~self.is_beam)
         # growth may have preempted slots — recompute the mask after it

@@ -31,16 +31,19 @@ class ModelExecutor:
 
     def __init__(self, model, *, num_slots, num_blocks, block_size,
                  max_blocks_per_seq, top_k=None, seed=0, draft_model=None,
-                 spec_k=4, max_seq_len=None):
+                 spec_k=4, max_seq_len=None, kv_dtype=None):
         cfg = model.cfg
         self.model = model
         self.top_k = top_k
         self.rng = jax.random.PRNGKey(seed)
+        # kv_dtype="int8": int8 block pools + parallel per-(position,
+        # kv-head) f32 scale pools; every jit here quantizes on write and
+        # dequantizes on read (ISSUE 17). None = pools in the model dtype.
         self.cache = PagedKVCache.init(
             cfg.num_hidden_layers, num_blocks, block_size,
             cfg.num_key_value_heads,
             cfg.hidden_size // cfg.num_attention_heads,
-            num_slots, max_blocks_per_seq, cfg.dtype)
+            num_slots, max_blocks_per_seq, cfg.dtype, kv_dtype=kv_dtype)
         self.draft_model = draft_model
         self._draft_cache = None
         if draft_model is not None:

@@ -25,6 +25,19 @@ from paddle_tpu.serving.telemetry import (_PREFIX_EVICTIONS,
                                           _PREFIX_TOKEN_HITS)
 
 
+def cache_block_bytes(cache) -> int:
+    """HBM bytes ONE pool block holds across all layers — K and V codes
+    at their ACTUAL stored dtype, plus the parallel scale pools of a
+    quantized cache (ISSUE 17). The memledger's bytes_per_token gauges
+    divide by this, so an int8 pool reports its true (roughly halved)
+    footprint instead of a bf16 assumption."""
+    import numpy as np
+    pools = (*cache.k_pools, *cache.v_pools,
+             *getattr(cache, "k_scales", ()),
+             *getattr(cache, "v_scales", ()))
+    return sum(int(np.prod(p.shape[1:])) * p.dtype.itemsize for p in pools)
+
+
 class KVManager:
     """Block allocation + worst-case reservation accounting."""
 

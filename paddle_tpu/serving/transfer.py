@@ -63,25 +63,39 @@ class KVPayload:
     block_size: int
     k: object                # [L, max_blocks, block_size, H_kv, D]
     v: object
+    # quantized pools (ISSUE 17): int8 codes above are meaningless
+    # without their per-(position, kv-head) scales — the scale rows ride
+    # the same wire as [L, max_blocks, block_size, H_kv] f32 (None for
+    # model-dtype pools)
+    k_scale: object = None
+    v_scale: object = None
     # filled by seal(): what the payload looked like when it left the
     # source pool — validate_payload checks the shipped copy against it
     expect: dict = None
 
     @property
     def tokens_bytes(self):
-        return self.k.nbytes + self.v.nbytes
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
 
     def seal(self):
         """Record the wire contract at the source: geometry + content
-        checksums. Called once by ``extract_sequence`` before the
-        payload leaves the engine."""
+        checksums (scales included for quantized payloads — a corrupted
+        scale row silently rescales whole positions). Called once by
+        ``extract_sequence`` before the payload leaves the engine."""
         self.expect = {
             "shape": tuple(self.k.shape),
             "cur": self.cur,
             "n_blocks": self.n_blocks,
             "ksum": _tensor_checksum(self.k),
             "vsum": _tensor_checksum(self.v),
+            "quant": self.k_scale is not None,
         }
+        if self.k_scale is not None:
+            self.expect["kssum"] = _tensor_checksum(self.k_scale)
+            self.expect["vssum"] = _tensor_checksum(self.v_scale)
         return self
 
 
@@ -107,6 +121,27 @@ def validate_payload(payload: KVPayload, target_engine) -> KVPayload:
         raise KVTransferError(
             f"payload truncated: {payload.n_blocks} blocks × "
             f"{payload.block_size} cannot cover cur={payload.cur}")
+    # quantized-pool compatibility: int8 codes must land in an int8
+    # pool WITH their scales; a bf16 payload must not target one
+    quant_target = bool(getattr(target_engine.cache, "k_scales", ()))
+    quant_payload = payload.k_scale is not None
+    if quant_target != quant_payload:
+        raise KVTransferError(
+            f"KV dtype mismatch: payload is "
+            f"{'int8+scales' if quant_payload else 'model-dtype'} but the "
+            f"target pool is "
+            f"{'int8+scales' if quant_target else 'model-dtype'} — "
+            "replicas in one handoff group must share kv_dtype")
+    if jnp.asarray(k).dtype != pool.dtype:
+        raise KVTransferError(
+            f"payload element dtype {jnp.asarray(k).dtype} != target "
+            f"pool dtype {pool.dtype}")
+    if quant_payload and (tuple(payload.k_scale.shape) != tuple(k.shape[:4])
+                          or tuple(payload.v_scale.shape)
+                          != tuple(v.shape[:4])):
+        raise KVTransferError(
+            f"scale geometry {tuple(payload.k_scale.shape)} does not "
+            f"match the code blocks {tuple(k.shape[:4])}")
     exp = payload.expect
     if exp is not None:
         if (tuple(k.shape) != exp["shape"] or payload.cur != exp["cur"]
@@ -115,9 +150,16 @@ def validate_payload(payload: KVPayload, target_engine) -> KVPayload:
                 f"payload drifted from its seal: shape={tuple(k.shape)} "
                 f"cur={payload.cur} n_blocks={payload.n_blocks}, sealed "
                 f"{exp['shape']}/{exp['cur']}/{exp['n_blocks']}")
-        ks, vs = _tensor_checksum(k), _tensor_checksum(v)
-        for got, want, name in ((ks, exp["ksum"], "k"),
-                                (vs, exp["vsum"], "v")):
+        if exp.get("quant", False) != quant_payload:
+            raise KVTransferError(
+                "payload quantization drifted from its seal (scales "
+                "added or dropped in flight)")
+        checks = [(k, exp["ksum"], "k"), (v, exp["vsum"], "v")]
+        if quant_payload:
+            checks += [(payload.k_scale, exp["kssum"], "k-scale"),
+                       (payload.v_scale, exp["vssum"], "v-scale")]
+        for x, want, name in checks:
+            got = _tensor_checksum(x)
             if abs(got - want) > 1e-3 * max(1.0, abs(want)):
                 raise KVTransferError(
                     f"{name}-checksum mismatch (partial/corrupt "
@@ -171,6 +213,8 @@ class TransportPolicy:
 
 
 def _gather_blocks(k_pools, v_pools, idx):
+    # also reused over the SCALE pools of a quantized cache — the
+    # trailing dims differ, so each use compiles its own entry
     k = jnp.stack([p[idx] for p in k_pools])
     v = jnp.stack([p[idx] for p in v_pools])
     return k, v
@@ -179,17 +223,33 @@ def _gather_blocks(k_pools, v_pools, idx):
 _GATHER_BLOCKS_JIT = jax.jit(_gather_blocks)
 
 
-def _install_blocks(cache, idx, k, v, slot, row, cur):
+def _install_blocks(cache, idx, k, v, ks, vs, slot, row, cur):
+    """``ks``/``vs`` are the per-(position, kv-head) scale blocks of a
+    quantized payload, or None — the None arms are distinct pytree
+    structures, so one jit serves both pool flavours."""
     k_pools = [p.at[idx].set(k[li], mode="drop")
                for li, p in enumerate(cache.k_pools)]
     v_pools = [p.at[idx].set(v[li], mode="drop")
                for li, p in enumerate(cache.v_pools)]
+    k_scales, v_scales = cache.k_scales, cache.v_scales
+    if ks is not None:
+        k_scales = tuple(p.at[idx].set(ks[li], mode="drop")
+                         for li, p in enumerate(cache.k_scales))
+        v_scales = tuple(p.at[idx].set(vs[li], mode="drop")
+                         for li, p in enumerate(cache.v_scales))
     tables = cache.block_tables.at[slot].set(row)
     lens = cache.lens.at[slot].set(cur)
-    return type(cache)(k_pools, v_pools, tables, lens)
+    return type(cache)(k_pools, v_pools, tables, lens, k_scales, v_scales)
 
 
 _INSTALL_BLOCKS_JIT = jax.jit(_install_blocks, donate_argnums=(0,))
+
+# env-flip hygiene (ISSUE 17): these jits trace over the cache pytree,
+# whose quantize-on-write path reads PT_QUANT_KV at trace time —
+# clear_jit_caches() must reach them too
+from paddle_tpu.models.paged import _EXTRA_CLEAR as _PAGED_EXTRA_CLEAR  # noqa: E402
+
+_PAGED_EXTRA_CLEAR.extend([_GATHER_BLOCKS_JIT, _INSTALL_BLOCKS_JIT])
 
 
 class KVTransfer:
@@ -215,4 +275,7 @@ class DeviceKVTransfer(KVTransfer):
         if dev is not None:
             payload.k = jax.device_put(payload.k, dev)
             payload.v = jax.device_put(payload.v, dev)
+            if payload.k_scale is not None:
+                payload.k_scale = jax.device_put(payload.k_scale, dev)
+                payload.v_scale = jax.device_put(payload.v_scale, dev)
         return payload

@@ -117,6 +117,8 @@ SITES = {
                            "exception-atomic spec-round abort",
     "serving.moe_dispatch": "before an MoE decode tick's expert "
                             "all_to_all; exception-atomic tick abort",
+    "serving.kv_quant": "before an int8 pool's quantize-on-write scatter; "
+                        "exception-atomic tick abort, no stale scales",
     "serving.prefix_evict": "before a radix prefix-cache leaf eviction; "
                             "pre-mutation, trie/free list untouched",
     "serving.adapter_swap": "before a LoRA adapter host→device upload; "
