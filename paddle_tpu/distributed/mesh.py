@@ -18,32 +18,39 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep", "cp")
 
 
 class HybridMesh:
-    """dp × fsdp × ep × pp × tp × sp over the device grid.
+    """dp × fsdp × ep × pp × tp × sp × cp over the device grid.
 
     ``ep`` is a first-class expert-parallel axis: MoE expert weights carry
     ``P("ep", ...)`` and the MoE dispatcher's ``lax.all_to_all`` runs over
     it (ref: the MoE NCCL group's ``c_alltoall``). Tokens/batch are sharded
     over (dp, fsdp, ep) — experts ride chips that also carry data, the
     reference's "ep on dp" layout, but with an explicit named axis.
+
+    ``cp`` is the serving-side context-parallel axis (ISSUE 18): the paged
+    KV pool shards its physical blocks over cp while weights stay
+    replicated; prefill partials merge via ring rotation or Ulysses
+    all_to_all and decode merges via psum. Innermost so the per-tick
+    O(heads·dim) merge rides the shortest ICI hops.
     """
 
     def __init__(self, dp: int = 1, fsdp: int = 1, pp: int = 1, tp: int = 1,
-                 sp: int = 1, ep: int = 1,
+                 sp: int = 1, ep: int = 1, cp: int = 1,
                  devices: Optional[Sequence] = None):
         devices = list(devices if devices is not None else jax.devices())
-        n = dp * fsdp * ep * pp * tp * sp
+        n = dp * fsdp * ep * pp * tp * sp * cp
         if n != len(devices):
             raise ValueError(
-                f"mesh {dp}x{fsdp}x{ep}x{pp}x{tp}x{sp}={n} != "
+                f"mesh {dp}x{fsdp}x{ep}x{pp}x{tp}x{sp}x{cp}={n} != "
                 f"{len(devices)} devices")
-        grid = np.array(devices).reshape(dp, fsdp, ep, pp, tp, sp)
-        self.mesh = Mesh(grid, ("dp", "fsdp", "ep", "pp", "tp", "sp"))
+        grid = np.array(devices).reshape(dp, fsdp, ep, pp, tp, sp, cp)
+        self.mesh = Mesh(grid, ("dp", "fsdp", "ep", "pp", "tp", "sp", "cp"))
         self.dp, self.fsdp, self.pp, self.tp, self.sp = dp, fsdp, pp, tp, sp
         self.ep = ep
+        self.cp = cp
 
     # -- reference-style queries (HybridCommunicateGroup API) ---------------
     def get_data_parallel_world_size(self):
@@ -102,6 +109,5 @@ def single_device_mesh() -> HybridMesh:
 
 def make_mesh(shape: dict, devices=None) -> HybridMesh:
     """shape e.g. {"dp":2, "tp":4} — unspecified axes default 1."""
-    kw = {a: int(shape.get(a, 1))
-          for a in ("dp", "fsdp", "pp", "tp", "sp", "ep")}
+    kw = {a: int(shape.get(a, 1)) for a in AXES}
     return HybridMesh(**kw, devices=devices)

@@ -162,6 +162,83 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     return out.astype(q.dtype)
 
 
+# -- online-softmax partial merges (context-parallel serving, ISSUE 18) -----
+#
+# The paged attention kernels emit per-shard (acc, m, l) partials in the
+# TRAILING-head layout — o [..., H, D] with m, l shaped o.shape[:-1] — and
+# the serving engine combines them across the ``cp`` mesh axis. Both merge
+# strategies below are DETERMINISTIC ACROSS MEMBERS: every shard folds the
+# same partials in the same global order (ring) or through symmetric
+# reductions (psum), so the merged result is bit-identical on every member
+# and replicated sampling / quantize-on-write scatters never diverge.
+
+def merge_partials(o, m, l, o_b, m_b, l_b):
+    """One pairwise online-softmax merge of two partial triples
+    (trailing-head layout: m/l shaped ``o.shape[:-1]``)."""
+    m_new = jnp.maximum(m, m_b)
+    c1 = jnp.exp(m - m_new)
+    c2 = jnp.exp(m_b - m_new)
+    return (o * c1[..., None] + o_b * c2[..., None], m_new,
+            l * c1 + l_b * c2)
+
+
+def finalize_partials(o, l, dtype=None):
+    """Normalise a merged accumulator; ``max(l, eps)`` keeps fully-masked
+    rows (padding / all keys on other shards pre-merge) at 0, not NaN."""
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out if dtype is None else out.astype(dtype)
+
+
+def ring_merge_partials(o, m, l, axis_name: str = "cp"):
+    """Ring merge: rotate the triples with ppermute (the same rotation
+    pattern the training ring uses for KV blocks) until every member has
+    collected all ``n`` shard partials, then fold them in GLOBAL shard
+    order 0..n-1. The fold's fp rounding sequence is identical on every
+    member — unlike folding in arrival order, which would differ per
+    member by a rotation and break the bit-identical-replicas contract."""
+    n = axis_size(axis_name)
+    if n == 1:
+        return o, m, l
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    os_, ms_, ls_ = [o], [m], [l]
+    ob, mb, lb = o, m, l
+    for _ in range(n - 1):
+        ob = lax.ppermute(ob, axis_name, perm)
+        mb = lax.ppermute(mb, axis_name, perm)
+        lb = lax.ppermute(lb, axis_name, perm)
+        os_.append(ob)
+        ms_.append(mb)
+        ls_.append(lb)
+    # after s rotations the copy at stack position s came from member
+    # (my - s) % n — shard g therefore sits at position (my - g) % n
+    take = (my - jnp.arange(n)) % n
+
+    def reorder(xs):
+        return jnp.take(jnp.stack(xs), take, axis=0)
+
+    o_s, m_s, l_s = reorder(os_), reorder(ms_), reorder(ls_)
+    o_a, m_a, l_a = o_s[0], m_s[0], l_s[0]
+    for g in range(1, n):
+        o_a, m_a, l_a = merge_partials(o_a, m_a, l_a,
+                                       o_s[g], m_s[g], l_s[g])
+    return o_a, m_a, l_a
+
+
+def psum_merge_partials(o, m, l, axis_name: str = "cp"):
+    """Flat merge through symmetric reductions: one pmax for the global
+    row max, one fused psum for the rescaled (acc, l). O(heads·dim)
+    bytes per member per step — the decode-tick cross-shard merge.
+    pmax/psum are member-order-invariant, so the result is bit-identical
+    on every member by construction."""
+    if axis_size(axis_name) == 1:
+        return o, m, l
+    m_max = lax.pmax(m, axis_name)
+    c = jnp.exp(m - m_max)
+    o, l = lax.psum((o * c[..., None], l * c), axis_name)
+    return o, m_max, l
+
+
 def bias_spec(bias_shape, head_spec, batch_axes=("dp", "fsdp"),
               rows_axis="sp"):
     """PartitionSpec for a [B|1, H|1, Sq, Sk] additive bias: shard only the

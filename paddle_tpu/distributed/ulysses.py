@@ -86,6 +86,48 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
                           tiled=True)
 
 
+def ulysses_merge_partials(o, m, l, axis_name: str = "cp"):
+    """Ulysses-style merge of per-shard online-softmax partials
+    (context-parallel serving, ISSUE 18). Trailing-head layout like the
+    ring variant: ``o`` [..., H, D] with ``m``/``l`` shaped
+    ``o.shape[:-1]``.
+
+    Instead of rotating whole triples around the ring, one tiled
+    ``all_to_all`` re-shards them from partial-per-member to
+    head-sharded — member j receives head slice j of ALL n partials,
+    stacked along a leading axis in source-member (= global shard)
+    order. Each member folds its slice 0..n-1 and an ``all_gather``
+    restores the full head dim, so every member ends with the same
+    bit-identical merged triple. Bytes moved per member:
+    2·(n-1)/n · H·(D+2) floats — same order as the ring, but in one
+    collective round instead of n-1. Requires H % n == 0."""
+    n = axis_size(axis_name)
+    if n == 1:
+        return o, m, l
+    ho, hm = o.ndim - 2, m.ndim - 1
+    if o.shape[ho] % n != 0:
+        raise ValueError(
+            f"ulysses_merge_partials: heads={o.shape[ho]} must divide by "
+            f"axis '{axis_name}' size {n}; use PT_CP_IMPL=ring")
+
+    def split(x, ax):
+        y = lax.all_to_all(x, axis_name, split_axis=ax, concat_axis=0,
+                           tiled=True)
+        return y.reshape((n,) + x.shape[:ax]
+                         + (x.shape[ax] // n,) + x.shape[ax + 1:])
+
+    from paddle_tpu.distributed.ring_attention import merge_partials
+    o_s, m_s, l_s = split(o, ho), split(m, hm), split(l, hm)
+    o_a, m_a, l_a = o_s[0], m_s[0], l_s[0]
+    for g in range(1, n):
+        o_a, m_a, l_a = merge_partials(o_a, m_a, l_a,
+                                       o_s[g], m_s[g], l_s[g])
+    o_a = lax.all_gather(o_a, axis_name, axis=ho, tiled=True)
+    m_a = lax.all_gather(m_a, axis_name, axis=hm, tiled=True)
+    l_a = lax.all_gather(l_a, axis_name, axis=hm, tiled=True)
+    return o_a, m_a, l_a
+
+
 def make_ulysses_attention(mesh, causal: bool = True, axis_name: str = "sp",
                            head_spec=None, batch_axes=("dp", "fsdp"),
                            window: int | None = None,

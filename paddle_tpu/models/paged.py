@@ -911,6 +911,49 @@ def _scatter_decode(pool, vals, tables, lens, active, num_blocks, block_size):
     return pool.at[blk, off].set(vals[:, 0], mode="drop")
 
 
+# --------------------------------------------- context parallelism (cp)
+# Under LLMEngine(cp=N) the executor runs every forward below inside
+# shard_map over the "cp" mesh axis: pool arrays are sharded on their
+# block axis (member s owns GLOBAL block ids [s*per, (s+1)*per),
+# per = num_blocks/cp) while block tables, lens and activations stay
+# replicated with GLOBAL ids. The forwards translate tables to LOCAL
+# coordinates at their use sites (scatters drop non-owned writes, the
+# attention kernels' partials mode masks non-owned reads) and merge the
+# per-shard online-softmax partials — so the host-side block managers,
+# radix trie and ledger never learn about sharding. ``cp_axis=None``
+# (the default everywhere) leaves every trace byte-identical to pre-cp
+# builds.
+
+def _cp_local_tables(tables, cp_axis, per):
+    """GLOBAL block-table entries -> this cp member's LOCAL pool
+    coordinates: ids in [s*per, (s+1)*per) become [0, per); everything
+    else (other members' blocks and the global OOB sentinel) becomes the
+    LOCAL sentinel ``per`` — scatter-dropped on write, ownership-masked
+    on read."""
+    if cp_axis is None:
+        return tables
+    s = jax.lax.axis_index(cp_axis)
+    loc = tables - s * per
+    return jnp.where((loc >= 0) & (loc < per), loc, per)
+
+
+def _cp_merge_chunk(o, m, l, cp_axis, dtype):
+    """Merge chunk-prefill partials across cp. ``PT_CP_IMPL`` (read at
+    TRACE time — flip between engine constructions) picks the ring
+    rotation (default) or the Ulysses all_to_all head-reshard; both are
+    bit-identical across members (global-order fold / symmetric
+    collectives)."""
+    from paddle_tpu.distributed.ring_attention import (finalize_partials,
+                                                       ring_merge_partials)
+    impl = os.environ.get("PT_CP_IMPL", "ring").strip().lower()
+    if impl == "ulysses":
+        from paddle_tpu.distributed.ulysses import ulysses_merge_partials
+        o, m, l = ulysses_merge_partials(o, m, l, cp_axis)
+    else:
+        o, m, l = ring_merge_partials(o, m, l, cp_axis)
+    return finalize_partials(o, l, dtype)
+
+
 def _backbone(model):
     """Decoder backbone holding embed_tokens/layers/norm. Llama-family
     models wrap it in ``.model``; the MoE families (Mixtral, Qwen2-MoE,
@@ -985,7 +1028,8 @@ def _lora_delta(x, lora, kind, li):
 
 
 def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
-                        slot_ids=None, table_rows=None, lora=None):
+                        slot_ids=None, table_rows=None, lora=None,
+                        cp_axis=None):
     """Prefill padded ragged prompts [B, S]; returns (last_logits, cache).
 
     Attention runs the padded-varlen path (kv_lens) — the fused kernel on
@@ -1017,6 +1061,10 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
         tables = jnp.asarray(table_rows, jnp.int32)   # [A, max_blocks]
         new_tables = cache.block_tables.at[slot_ids].set(tables, mode="drop")
         new_lens = cache.lens.at[slot_ids].set(prompt_lens, mode="drop")
+    # cp: tables stay GLOBAL on device; only the pool scatters see the
+    # LOCAL view (non-owned writes drop). In-prompt attention is dense
+    # over the local pre-quant k/v — replicated compute, no merge needed.
+    rtables = _cp_local_tables(tables, cp_axis, cache.num_blocks)
     x = jnp.take(_backbone(model).embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
     scaling = getattr(cfg, "rope_scaling", None)
@@ -1049,7 +1097,7 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
                                              kv_lens=prompt_lens,
                                              window=getattr(cfg, "sliding_window", None))
         kp, vp, ks, vs = _scatter_kv(cache, li, k, v, _scatter_prefill,
-                                     tables, prompt_lens, nb, bs)
+                                     rtables, prompt_lens, nb, bs)
         k_pools.append(kp)
         v_pools.append(vp)
         if ks is not None:
@@ -1072,7 +1120,7 @@ def llama_prefill_paged(model, input_ids, prompt_lens, cache: PagedKVCache,
 
 
 def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
-                            lora=None):
+                            lora=None, cp_axis=None):
     """One decode token per sequence. tokens: [B] int32; active: [B] bool
     (finished rows neither write KV nor advance). Returns (logits, cache)."""
     cfg = model.cfg
@@ -1086,6 +1134,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
     window = getattr(cfg, "sliding_window", None)
     k_pools, v_pools, k_scales, v_scales = [], [], [], []
     new_lens = jnp.where(active, cache.lens + 1, cache.lens)
+    rtables = _cp_local_tables(cache.block_tables, cp_axis, nb)
     for li, lyr in enumerate(_backbone(model).layers):
         h = lyr.input_layernorm(x)
         att = lyr.self_attn
@@ -1100,7 +1149,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
         k = _apply_rope_rows(k.reshape(b, 1, nkv, hd), cos, sin)
         v = v.reshape(b, 1, nkv, hd)
         k_pool, v_pool, ks, vs = _scatter_kv(
-            cache, li, k, v, _scatter_decode, cache.block_tables,
+            cache, li, k, v, _scatter_decode, rtables,
             cache.lens, active, nb, bs)
         k_pools.append(k_pool)
         v_pools.append(v_pool)
@@ -1110,9 +1159,22 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
         # sliding-window configs: the pool retains all tokens (blocks
         # below the window could be recycled — not done yet) but decode
         # attends only the last `window` positions, matching prefill
-        out = paged_decode_attention(q[:, 0], k_pool, v_pool,
-                                     cache.block_tables, new_lens,
-                                     window=window, k_scale=ks, v_scale=vs)
+        if cp_axis is None:
+            out = paged_decode_attention(q[:, 0], k_pool, v_pool,
+                                         rtables, new_lens,
+                                         window=window, k_scale=ks,
+                                         v_scale=vs)
+        else:
+            # per-shard partials over the locally-owned blocks + ONE
+            # psum-style merge: O(heads*dim) cross-shard bytes per step,
+            # bit-identical on every member (replicated sampling)
+            from paddle_tpu.distributed.ring_attention import (
+                finalize_partials, psum_merge_partials)
+            o_p, m_p, l_p = paged_decode_attention(
+                q[:, 0], k_pool, v_pool, rtables, new_lens,
+                window=window, k_scale=ks, v_scale=vs, partials=True)
+            o_p, m_p, l_p = psum_merge_partials(o_p, m_p, l_p, cp_axis)
+            out = finalize_partials(o_p, l_p, q.dtype)
         attn_out = out.reshape(b, 1, nh * hd)
         proj = _wo(attn_out, att.o_proj)
         if lora is not None:
@@ -1128,7 +1190,7 @@ def llama_decode_step_paged(model, tokens, cache: PagedKVCache, active,
 def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
                       upd_rows, upd_cols, upd_vals, rng, temps, top_ps,
                       top_k=None, want_logp=False, lora=None,
-                      logit_bias=None):
+                      logit_bias=None, cp_axis=None):
     """ONE fused serving tick: apply incremental block-table updates
     (``tables[upd_rows[i], upd_cols[i]] = upd_vals[i]``, sentinel rows
     dropped — no host-side table rebuild/re-upload), run the decode step,
@@ -1147,7 +1209,7 @@ def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
     cache = PagedKVCache(cache.k_pools, cache.v_pools, tables, cache.lens,
                          cache.k_scales, cache.v_scales)
     logits, cache = llama_decode_step_paged(model, tokens, cache, active,
-                                            lora)
+                                            lora, cp_axis=cp_axis)
     logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             if want_logp else ())
     nxt = _sample_rows(logits.astype(jnp.float32), rng, temps, top_ps,
@@ -1217,12 +1279,43 @@ def _beam_cache_update(cache: PagedKVCache, new_tables, copy_src, copy_dst):
     return PagedKVCache(k, v, new_tables, cache.lens, ks, vs)
 
 
-def _prefix_cow_update(cache: PagedKVCache, copy_src, copy_dst):
+def _cp_copy_blocks(pools, copy_src, copy_dst, per, cp_axis):
+    """Cross-shard block copy (cp COW): a copy's src and dst blocks may
+    live on DIFFERENT cp members. Every member contributes its owned src
+    rows (zeros elsewhere); since exactly one member owns each id, ONE
+    psum replicates the K src blocks everywhere; the local-translated
+    dst scatter then drops on all members but the dst owner. Sentinel
+    pairs (src = dst = global num_blocks) contribute zero and drop."""
+    s = jax.lax.axis_index(cp_axis)
+    loc_src = copy_src - s * per
+    own = (loc_src >= 0) & (loc_src < per)
+    src_c = jnp.clip(loc_src, 0, per - 1)
+    loc_dst = copy_dst - s * per
+    loc_dst = jnp.where((loc_dst >= 0) & (loc_dst < per), loc_dst, per)
+    out = []
+    for p in pools:
+        rows = jnp.where(own.reshape(own.shape + (1,) * (p.ndim - 1)),
+                         p[src_c], 0)
+        rows = jax.lax.psum(rows, cp_axis)
+        out.append(p.at[loc_dst].set(rows.astype(p.dtype), mode="drop"))
+    return out
+
+
+def _prefix_cow_update(cache: PagedKVCache, copy_src, copy_dst,
+                       cp_axis=None):
     """Radix prefix cache: copy adopted partial boundary blocks into the
     adopters' private blocks (copy-on-write at first divergence). Tables
     and lens are untouched — the adopters' tables already point at the
     dst blocks. copy_src/copy_dst: [K] block ids, sentinel num_blocks =
     no copy."""
+    if cp_axis is not None:
+        per = cache.num_blocks
+        cp = lambda pools: _cp_copy_blocks(pools, copy_src, copy_dst,
+                                           per, cp_axis)
+        return PagedKVCache(cp(cache.k_pools), cp(cache.v_pools),
+                            cache.block_tables, cache.lens,
+                            tuple(cp(cache.k_scales)),
+                            tuple(cp(cache.v_scales)))
     k, v, ks, vs = _cow_pools(cache, copy_src, copy_dst)
     return PagedKVCache(k, v, cache.block_tables, cache.lens, ks, vs)
 
@@ -1469,7 +1562,7 @@ def paged_generate(model, input_ids, prompt_lens, max_new_tokens=32,
 
 def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
                               cache: PagedKVCache, slot_ids, table_rows,
-                              full_logits=False, lora=None):
+                              full_logits=False, lora=None, cp_axis=None):
     """CONTINUE a prefill: write chunk tokens at positions
     ``offsets[a] .. offsets[a]+chunk_lens[a]-1`` of their slots and attend
     each chunk query over the slot's WHOLE pool prefix (gather-based) —
@@ -1507,6 +1600,11 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
     new_lens = cache.lens.at[slot_ids].set(offsets + chunk_lens,
                                            mode="drop")
     window = getattr(cfg, "sliding_window", None)
+    # cp (ring-attention chunked prefill): quantize-on-write scatters land
+    # each chunk's K/V in the owning shard via the LOCAL table view; the
+    # pool read below computes per-shard partials over owned blocks only
+    # and merges them across cp (ring rotation / Ulysses all_to_all)
+    rtables = _cp_local_tables(tables, cp_axis, cache.num_blocks)
 
     x = jnp.take(_backbone(model).embed_tokens, input_ids, axis=0)
     d = cfg.hidden_size // cfg.num_attention_heads
@@ -1543,7 +1641,7 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         v = v.reshape(a, c, nkv, hd)
         # scatter the chunk FIRST so the gathered view holds prefix+chunk
         k_pool, v_pool, ks, vs = _scatter_kv(
-            cache, li, k, v, _scatter_decode_chunk, tables, offsets,
+            cache, li, k, v, _scatter_decode_chunk, rtables, offsets,
             chunk_lens, nb, bs)
         k_pools.append(k_pool)
         v_pools.append(v_pool)
@@ -1553,9 +1651,15 @@ def llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
         # ragged pool-direct attention: the kernel reads only each row's
         # live blocks (the XLA fallback reconstructs the old full
         # gather + dense-mask view, bit-compatible)
-        out = paged_chunk_attention(q, k_pool, v_pool, tables, offsets,
-                                    chunk_lens, window=window,
-                                    k_scale=ks, v_scale=vs)
+        if cp_axis is None:
+            out = paged_chunk_attention(q, k_pool, v_pool, rtables,
+                                        offsets, chunk_lens, window=window,
+                                        k_scale=ks, v_scale=vs)
+        else:
+            o_p, m_p, l_p = paged_chunk_attention(
+                q, k_pool, v_pool, rtables, offsets, chunk_lens,
+                window=window, k_scale=ks, v_scale=vs, partials=True)
+            out = _cp_merge_chunk(o_p, m_p, l_p, cp_axis, q.dtype)
         attn_out = out.reshape(a, c, nh * hd)
         proj = _wo(attn_out, att.o_proj)
         if lora is not None:
@@ -1607,13 +1711,14 @@ _PREFILL_CHUNK_JIT = jax.jit(llama_prefill_chunk_paged,
 
 def llama_verify_chunk_paged(model, input_ids, chunk_lens, offsets,
                              cache: PagedKVCache, slot_ids, table_rows,
-                             lora=None):
+                             lora=None, cp_axis=None):
     """Speculative verify: one chunk forward returning [A, C, V] logits
     (see ``llama_prefill_chunk_paged`` — same append semantics, every
     chunk position's logits kept for accept/reject)."""
     return llama_prefill_chunk_paged(model, input_ids, chunk_lens, offsets,
                                      cache, slot_ids, table_rows,
-                                     full_logits=True, lora=lora)
+                                     full_logits=True, lora=lora,
+                                     cp_axis=cp_axis)
 
 
 def spec_rewind_lens(cache: PagedKVCache, slot_ids, new_lens):

@@ -25,6 +25,7 @@ _INSTRUMENT_MODULES = (
     "paddle_tpu.observability.memledger",
     "paddle_tpu.serving.telemetry",
     "paddle_tpu.serving.quant",
+    "paddle_tpu.serving.cp",
     "paddle_tpu.ops.pallas.paged_attention",
     "paddle_tpu.train.trainer",
     "paddle_tpu.train.checkpoint",

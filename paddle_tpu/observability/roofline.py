@@ -125,6 +125,11 @@ class ModelGeometry:
     kv_dtype_bytes: int = 0       # bytes per cached KV element
     kv_scale_bytes: int = 0       # extra bytes per (position, kv-head)
     weight_dtype_bytes: float = 0.0   # 1.0 int8, 0.5 packed int4
+    # context-parallel serving (ISSUE 18) — cp>1 means every decode
+    # token pays a cross-shard partial merge (psum of the online-softmax
+    # (o, m, l) triple per layer); billed as extra bytes so
+    # serving_mbu{decode} stays honest about the per-step gather cost.
+    cp: int = 1
 
     @classmethod
     def from_config(cls, cfg, dtype_bytes: int = 2) -> "ModelGeometry":
@@ -212,7 +217,14 @@ def phase_bytes(geom: ModelGeometry, *, tokens: float, weight_passes: float,
     kv_r = kv_read_positions * kv_bytes_per_position(geom)
     kv_w = tokens * kv_bytes_per_position(geom)
     logits = tokens * geom.vocab * 4.0
-    return w + kv_r + kv_w + logits
+    total = w + kv_r + kv_w + logits
+    if geom.cp > 1:
+        # cross-shard partial merge per computed token: each member
+        # psums an f32 (o [H, D], m [H], l [H]) triple per layer —
+        # 2·(cp-1)/cp of it crosses the interconnect per member
+        triple = geom.num_layers * geom.heads * (geom.head_dim + 2) * 4.0
+        total += tokens * triple * 2.0 * (geom.cp - 1) / geom.cp
+    return total
 
 
 def arith_intensity(flops: float, nbytes: float) -> float:

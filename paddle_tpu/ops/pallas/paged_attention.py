@@ -88,14 +88,24 @@ def _disable_pallas(kernel: str, err: Exception):
 
 
 def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
-                         block_size, scale, max_blocks, window, quantized):
+                         block_size, scale, max_blocks, window, quantized,
+                         partials, n_pool=0):
     """Grid (B*H, max_blocks); block j of row bh is pool block
     tables[bh, j] (resolved by the BlockSpec index maps). ``quantized``
     (static) adds two per-position scale refs after v_ref: the pool holds
     int8 and K/V are dequantized in-kernel (f32 multiply — the matmul
-    already upcasts, so the bf16 trace is unchanged when off)."""
+    already upcasts, so the bf16 trace is unchanged when off).
+    ``partials`` (static) is the context-parallel output mode: instead
+    of the normalised output, emit the raw online-softmax triple
+    (acc, m, l) and skip table entries this shard does not own (the
+    caller translated non-owned global block ids to the OOB sentinel) —
+    the cross-shard merge renormalises. Off, the trace is byte-identical
+    to the pre-cp kernel."""
     if quantized:
-        ks_ref, vs_ref, o_ref, acc, m_sc, l_sc = rest
+        ks_ref, vs_ref = rest[:2]
+        rest = rest[2:]
+    if partials:
+        o_ref, m_ref, l_ref, acc, m_sc, l_sc = rest
     else:
         o_ref, acc, m_sc, l_sc = rest
     bh = pl.program_id(0)
@@ -110,6 +120,12 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
     seq_len = lens_ref[bh, 0]
     n_live = pl.cdiv(seq_len, block_size)
     live = j < n_live
+    if partials:
+        # ownership mask: under cp the table row interleaves blocks of
+        # every shard; non-owned entries were translated to the local
+        # sentinel (= local num_blocks) and contribute NOTHING here —
+        # their positions are covered by the owning shard's partial
+        live &= tables_ref[bh, j] < n_pool
     if window is not None:
         # sliding window: only the last `window` positions are visible —
         # blocks entirely below seq_len - window are dead
@@ -147,19 +163,31 @@ def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
 
     @pl.when(j == max_blocks - 1)
     def _finalize():
-        o_ref[0] = (acc[:] / jnp.maximum(l_sc[0, 0], 1e-30)).astype(o_ref.dtype)
+        if partials:
+            # emit the raw triple; m/l lane-replicated (vector store —
+            # scalar VMEM stores hit Mosaic layout restrictions)
+            o_ref[0] = acc[:].astype(o_ref.dtype)
+            m_ref[0] = jnp.full((1, 128), m_sc[0, 0], jnp.float32)
+            l_ref[0] = jnp.full((1, 128), l_sc[0, 0], jnp.float32)
+        else:
+            o_ref[0] = (acc[:] / jnp.maximum(l_sc[0, 0], 1e-30)
+                        ).astype(o_ref.dtype)
 
 
 def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
                                   scale=None, window=None, k_scale=None,
-                                  v_scale=None,
+                                  v_scale=None, partials=False,
                                   interpret: bool | None = None):
     """One decode step over block tables. q: [B, H, D];
     k_pool/v_pool: [N, bs, H_kv, D]; block_tables: [B, max_blocks] int32;
     lens: [B] int32 (current lengths INCLUDING the new token, whose K/V
     must already be written to the pool). ``k_scale``/``v_scale``
     [N, bs, H_kv] f32 dequantize an int8 pool in-kernel (per-position,
-    per-head absmax scales). Returns [B, H, D]."""
+    per-head absmax scales). Returns [B, H, D] — or, with
+    ``partials=True`` (context parallelism), the un-normalised
+    online-softmax triple (acc [B, H, D] f32, m [B, H] f32, l [B, H]
+    f32) over the table entries < N only (non-owned entries hold the
+    OOB sentinel and are skipped)."""
     b, h, d = q.shape
     n, bs, h_kv, _ = k_pool.shape
     kv_rep = h // h_kv
@@ -198,11 +226,23 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
         operands += [jnp.moveaxis(k_scale, 2, 0)[..., None],
                      jnp.moveaxis(v_scale, 2, 0)[..., None]]
 
+    out_idx = lambda bh, j, t, l: (bh, 0, 0)  # noqa: E731
+    out_specs = pl.BlockSpec((1, 1, d), out_idx)
+    out_shape = jax.ShapeDtypeStruct((b * h, 1, d), q.dtype)
+    if partials:
+        # acc in f32 (the merge renormalises before the dtype cast) plus
+        # lane-replicated m/l rows
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, 1, 128), out_idx),
+                     pl.BlockSpec((1, 1, 128), out_idx)]
+        out_shape = [jax.ShapeDtypeStruct((b * h, 1, d), jnp.float32),
+                     jax.ShapeDtypeStruct((b * h, 1, 128), jnp.float32),
+                     jax.ShapeDtypeStruct((b * h, 1, 128), jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b * h, max_blocks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, d), lambda bh, j, t, l: (bh, 0, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((1, d), jnp.float32),
             # running max / denom are SCALARS: Mosaic rejects scalar stores
@@ -213,11 +253,12 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
     )
     kernel = functools.partial(_paged_decode_kernel, block_size=bs,
                                scale=scale, max_blocks=max_blocks,
-                               window=window, quantized=quantized)
+                               window=window, quantized=quantized,
+                               partials=partials, n_pool=n)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        out_shape=out_shape,
         # (sequence-head, block) grid: rows are independent; declaring the
         # row axis parallel lets Mosaic pipeline pool-block DMAs across rows
         # (measured 3.5x on the flash grids — benchmarks/_perf_banded.py)
@@ -225,14 +266,20 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables, lens, *,
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)),
         interpret=interpret,
     )(tables_bh, lens_bh, *operands)
+    if partials:
+        acc, m, l = out
+        return (acc.reshape(b, h, d), m[:, 0, 0].reshape(b, h),
+                l[:, 0, 0].reshape(b, h))
     return out.reshape(b, h, d)
 
 
 def paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens, *,
                                scale=None, window=None, k_scale=None,
-                               v_scale=None):
+                               v_scale=None, partials=False):
     """Gather-based reference path (CPU tests / fallback). Same contract as
-    the Pallas kernel; materialises the gathered K/V transiently."""
+    the Pallas kernel; materialises the gathered K/V transiently.
+    ``partials=True`` returns the (acc, m, l) triple over owned table
+    entries only — bit-compatible with the Pallas partials mode."""
     b, h, d = q.shape
     n, bs, h_kv, _ = k_pool.shape
     scale = scale if scale is not None else d ** -0.5
@@ -261,6 +308,19 @@ def paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens, *,
     keep = pos < lens[:, None, None]
     if window is not None:
         keep &= pos >= (lens[:, None, None] - window)
+    if partials:
+        # ownership mask (cp): a clamped non-owned sentinel slot would
+        # otherwise contribute a garbage block the position mask cannot
+        # catch — only entries < N are this shard's
+        keep = keep & jnp.repeat(block_tables < n, bs,
+                                 axis=1)[:, None, :]
+        s = jnp.where(keep, s, _NEG_INF)
+        m = jnp.max(s, axis=-1)                       # [B, H]
+        # the explicit keep multiply kills the all-masked degenerate row
+        # (m == -1e30 -> exp(0) == 1 everywhere without it)
+        p = jnp.exp(s - m[..., None]) * keep
+        acc = jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32))
+        return acc, m, jnp.sum(p, axis=-1)
     s = jnp.where(keep, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhk,bkhd->bhd", p, v.astype(jnp.float32)).astype(q.dtype)
@@ -268,28 +328,35 @@ def paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens, *,
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, lens, *,
                            scale=None, window=None, k_scale=None,
-                           v_scale=None, interpret: bool | None = None):
+                           v_scale=None, partials=False,
+                           interpret: bool | None = None):
     """Dispatch: Pallas on TPU (pool-direct block reads), XLA elsewhere.
     ``window``: sliding-window bound — only the last `window` positions
     are visible (Mistral decode semantics). ``k_scale``/``v_scale``
     [N, bs, H_kv] f32 mark an int8 pool — dequantize-on-read in both
-    paths. A Pallas failure downgrades this process to the XLA path
-    permanently (cached, warned, counted — see ``_disable_pallas``)."""
+    paths. ``partials=True`` (context parallelism) returns the raw
+    (acc, m, l) online-softmax triple over OWNED table entries only
+    (< N; non-owned entries hold the OOB sentinel) — the caller merges
+    across shards. A Pallas failure downgrades this process to the XLA
+    path permanently (cached, warned, counted — see ``_disable_pallas``)."""
     if k_scale is not None:
         # breadcrumb ONLY on the quantized branch, so bf16 traces stay
         # byte-identical to pre-quantization builds
         _note_trace("decode:int8-kv")
+    if partials:
+        _note_trace("decode:partials")
     if jax.default_backend() == "tpu" and "decode" not in _pallas_disabled:
         try:
             return paged_decode_attention_pallas(
                 q, k_pool, v_pool, block_tables, lens, scale=scale,
                 window=window, k_scale=k_scale, v_scale=v_scale,
-                interpret=interpret)
+                partials=partials, interpret=interpret)
         except Exception as e:
             _disable_pallas("decode", e)
     return paged_decode_attention_xla(q, k_pool, v_pool, block_tables, lens,
                                       scale=scale, window=window,
-                                      k_scale=k_scale, v_scale=v_scale)
+                                      k_scale=k_scale, v_scale=v_scale,
+                                      partials=partials)
 
 
 # --------------------------------------------------------- chunk kernel
@@ -305,15 +372,22 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lens, *,
 
 def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
                         *rest, block_size, scale, max_blocks, q_tile,
-                        group, n_kv, window, quantized):
+                        group, n_kv, window, quantized, partials,
+                        n_pool=0):
     """Grid (A*H_kv, q-tiles, kv-blocks). Row r serves sequence
     a = r // n_kv, KV head r % n_kv; its q tile holds ``q_tile`` folded
     rows (folded row t = query position t // group, grouped head
     t % group). Online-softmax accumulation across the kv-block axis.
     ``quantized`` (static) adds two per-position scale refs after v_ref
-    (int8 pool, dequantize in-kernel)."""
+    (int8 pool, dequantize in-kernel). ``partials`` (static, context
+    parallelism): emit the raw (acc, m, l) triple instead of the
+    normalised output and skip non-owned table entries (translated to
+    the OOB sentinel by the caller)."""
     if quantized:
-        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+        ks_ref, vs_ref = rest[:2]
+        rest = rest[2:]
+    if partials:
+        o_ref, m_ref, l_ref, acc, m_scr, l_scr = rest
     else:
         o_ref, acc, m_scr, l_scr = rest
     r = pl.program_id(0)
@@ -334,6 +408,10 @@ def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
     q0 = qt * q_tile                       # first folded row of the tile
     last_q = off + (q0 + q_tile - 1) // group   # tile's last query position
     live = (j < n_live) & (q0 < cl * group)
+    if partials:
+        # ownership mask: non-owned table entries were translated to the
+        # local sentinel — the owning shard's partial covers them
+        live &= tables_ref[a_idx, j] < n_pool
     # causal dead-tile skip: a block whose FIRST key position is past the
     # tile's LAST query position contributes nothing
     live &= j * block_size <= last_q
@@ -370,6 +448,13 @@ def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        if partials:
+            # a row whose visible keys ALL live on other shards is fully
+            # masked here: m_new == _NEG_INF and exp(s - m_new) == 1 —
+            # the explicit keep multiply zeroes it so the merged triple
+            # stays (acc=0, l=0) instead of garbage (cp=1 never hits
+            # this: block 0 always holds visible keys for a real row)
+            p = p * keep.astype(jnp.float32)
         l_scr[:] = jnp.broadcast_to(
             l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
@@ -381,14 +466,20 @@ def _paged_chunk_kernel(tables_ref, offs_ref, cls_ref, q_ref, k_ref, v_ref,
 
     @pl.when(j == max_blocks - 1)
     def _finalize():
-        # fully-masked rows (dead/padding) have l == 0: emit 0, not NaN
-        o_ref[0] = (acc[:] / jnp.maximum(l_scr[:, :1], 1e-30)
-                    ).astype(o_ref.dtype)
+        if partials:
+            o_ref[0] = acc[:].astype(o_ref.dtype)
+            m_ref[0] = m_scr[:]
+            l_ref[0] = l_scr[:]
+        else:
+            # fully-masked rows (dead/padding) have l == 0: emit 0, not NaN
+            o_ref[0] = (acc[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+                        ).astype(o_ref.dtype)
 
 
 def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
                                  chunk_lens, *, scale=None, window=None,
                                  k_scale=None, v_scale=None, q_tile=None,
+                                 partials=False,
                                  interpret: bool | None = None):
     """Ragged chunk attention over block tables. q: [A, C, H, D] (chunk
     queries, already rotated); k_pool/v_pool: [N, bs, H_kv, D] with the
@@ -397,7 +488,9 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
     int32 — row a's queries sit at positions offsets[a] ..
     offsets[a]+chunk_lens[a]-1 and attend over pool positions
     [0, offsets[a]+chunk_lens[a]) causally. Rows with chunk_lens == 0 are
-    dead (output 0). Returns [A, C, H, D]."""
+    dead (output 0). Returns [A, C, H, D] — or, with ``partials=True``
+    (context parallelism), the raw (acc [A, C, H, D] f32, m [A, C, H]
+    f32, l [A, C, H] f32) triple over owned table entries only."""
     a, c, h, d = q.shape
     n, bs, h_kv, _ = k_pool.shape
     group = h // h_kv
@@ -455,11 +548,23 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
                      pl.BlockSpec((1, 1, bs, 1), kv_index)]
         operands += [jnp.moveaxis(k_scale, 2, 0)[..., None],
                      jnp.moveaxis(v_scale, 2, 0)[..., None]]
+    out_specs = pl.BlockSpec((1, q_tile, d), q_index)
+    out_shape = jax.ShapeDtypeStruct((a * h_kv, n_qt * q_tile, d), q.dtype)
+    if partials:
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, q_tile, 128), q_index),
+                     pl.BlockSpec((1, q_tile, 128), q_index)]
+        out_shape = [
+            jax.ShapeDtypeStruct((a * h_kv, n_qt * q_tile, d), jnp.float32),
+            jax.ShapeDtypeStruct((a * h_kv, n_qt * q_tile, 128),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((a * h_kv, n_qt * q_tile, 128),
+                                 jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(a * h_kv, n_qt, max_blocks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, q_tile, d), q_index),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((q_tile, d), jnp.float32),
             # per-folded-row running max / denom, lane-replicated (scalar
@@ -471,12 +576,12 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
     kernel = functools.partial(_paged_chunk_kernel, block_size=bs,
                                scale=scale, max_blocks=max_blocks,
                                q_tile=q_tile, group=group, n_kv=h_kv,
-                               window=window, quantized=quantized)
+                               window=window, quantized=quantized,
+                               partials=partials, n_pool=n)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((a * h_kv, n_qt * q_tile, d),
-                                       q.dtype),
+        out_shape=out_shape,
         # rows and q tiles are independent; only the kv-block axis carries
         # the online-softmax state
         compiler_params=_CompilerParams(
@@ -484,18 +589,28 @@ def paged_chunk_attention_pallas(q, k_pool, v_pool, block_tables, offsets,
                                  pltpu.ARBITRARY)),
         interpret=interpret,
     )(tables, offs, cls, *operands)
-    out = out[:, :cg].reshape(a, h_kv, c, group, d)
-    return out.transpose(0, 2, 1, 3, 4).reshape(a, c, h, d)
+
+    def unfold(x, last):
+        x = x[:, :cg].reshape(a, h_kv, c, group, *((last,) if last else ()))
+        if last:
+            return x.transpose(0, 2, 1, 3, 4).reshape(a, c, h, last)
+        return x.transpose(0, 2, 1, 3).reshape(a, c, h)
+
+    if partials:
+        acc, m, l = out
+        return unfold(acc, d), unfold(m[..., 0], 0), unfold(l[..., 0], 0)
+    return unfold(out, d)
 
 
 def paged_chunk_attention_xla(q, k_pool, v_pool, block_tables, offsets,
                               chunk_lens, *, scale=None, window=None,
-                              k_scale=None, v_scale=None):
+                              k_scale=None, v_scale=None, partials=False):
     """Gather-based reference path (CPU / fallback): materialise each
     row's whole ``max_blocks*bs`` pool view and run dense masked
     attention — exactly the pre-kernel ``llama_prefill_chunk_paged``
     inner loop, kept bit-compatible for the PT_PAGED_CHUNK=0 kill
-    switch."""
+    switch. ``partials=True`` returns the (acc, m, l) triple over owned
+    table entries only (context parallelism)."""
     from paddle_tpu.ops import attention as A
     a, c, h, d = q.shape
     n, bs, h_kv, _ = k_pool.shape
@@ -519,12 +634,30 @@ def paged_chunk_attention_xla(q, k_pool, v_pool, block_tables, offsets,
     keep = (pool_pos <= q_pos) & (pool_pos < row_lens[:, None, None])
     if window is not None:
         keep &= (q_pos - pool_pos) < window
+    if partials:
+        # ownership mask (cp): clamped non-owned sentinel slots must not
+        # contribute — the owning shard's partial covers those positions
+        keep = keep & jnp.repeat(block_tables < n, bs,
+                                 axis=1)[:, None, :]   # [A, C, K]
+        if h_kv != h:
+            kg = jnp.repeat(kg, h // h_kv, axis=2)
+            vg = jnp.repeat(vg, h // h_kv, axis=2)
+        scale_ = scale if scale is not None else d ** -0.5
+        s = jnp.einsum("achd,akhd->ahck", q.astype(jnp.float32),
+                       kg.astype(jnp.float32)) * scale_
+        km = keep[:, None].astype(bool)                # [A, 1, C, K]
+        s = jnp.where(km, s, _NEG_INF)
+        m = jnp.max(s, axis=-1)                        # [A, H, C]
+        p = jnp.exp(s - m[..., None]) * km             # kill all-masked rows
+        acc = jnp.einsum("ahck,akhd->achd", p, vg.astype(jnp.float32))
+        return (acc, jnp.moveaxis(m, 1, 2),            # [A, C, H]
+                jnp.moveaxis(jnp.sum(p, axis=-1), 1, 2))
     return A.xla_attention(q, kg, vg, attn_mask=keep[:, None], scale=scale)
 
 
 def paged_chunk_attention(q, k_pool, v_pool, block_tables, offsets,
                           chunk_lens, *, scale=None, window=None,
-                          k_scale=None, v_scale=None,
+                          k_scale=None, v_scale=None, partials=False,
                           interpret: bool | None = None):
     """One dispatch for the ragged chunk path. ``PT_PAGED_CHUNK``
     (read at TRACE time — flip it between engine constructions together
@@ -540,24 +673,27 @@ def paged_chunk_attention(q, k_pool, v_pool, block_tables, offsets,
     (cached + warned + counted, never silently retried)."""
     if k_scale is not None:
         _note_trace("chunk:int8-kv")
+    if partials:
+        _note_trace("chunk:partials")
     mode = os.environ.get("PT_PAGED_CHUNK", "1").strip().lower()
     if mode in ("0", "off", "xla"):
         _note_trace("chunk:xla-forced")
         return paged_chunk_attention_xla(
             q, k_pool, v_pool, block_tables, offsets, chunk_lens,
-            scale=scale, window=window, k_scale=k_scale, v_scale=v_scale)
+            scale=scale, window=window, k_scale=k_scale, v_scale=v_scale,
+            partials=partials)
     if mode == "interpret":
         _note_trace("chunk:pallas-interpret")
         return paged_chunk_attention_pallas(
             q, k_pool, v_pool, block_tables, offsets, chunk_lens,
             scale=scale, window=window, k_scale=k_scale, v_scale=v_scale,
-            interpret=True)
+            partials=partials, interpret=True)
     if jax.default_backend() == "tpu" and "chunk" not in _pallas_disabled:
         try:
             out = paged_chunk_attention_pallas(
                 q, k_pool, v_pool, block_tables, offsets, chunk_lens,
                 scale=scale, window=window, k_scale=k_scale,
-                v_scale=v_scale, interpret=interpret)
+                v_scale=v_scale, partials=partials, interpret=interpret)
             _note_trace("chunk:pallas")
             return out
         except Exception as e:
@@ -565,4 +701,5 @@ def paged_chunk_attention(q, k_pool, v_pool, block_tables, offsets,
     _note_trace("chunk:xla")
     return paged_chunk_attention_xla(
         q, k_pool, v_pool, block_tables, offsets, chunk_lens,
-        scale=scale, window=window, k_scale=k_scale, v_scale=v_scale)
+        scale=scale, window=window, k_scale=k_scale, v_scale=v_scale,
+        partials=partials)

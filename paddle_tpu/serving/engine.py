@@ -59,6 +59,8 @@ from paddle_tpu.observability.roofline import (ModelGeometry,
 from paddle_tpu.serving.executor import ModelExecutor, _SAMPLE_ROWS_JIT  # noqa: F401  (re-exported)
 from paddle_tpu.serving.kv import KVManager, cache_block_bytes
 from paddle_tpu.serving.scheduler import Scheduler
+from paddle_tpu.serving.cp import (_CP_AXIS, _CP_GATHER_S,
+                                   _CP_SHARD_BLOCKS, shard_occupancy)
 from paddle_tpu.serving.degrade import SessionSnapshot
 from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
                                           _DRAIN, _FINISHED,
@@ -97,7 +99,7 @@ class LLMEngine:
                  seed=0, prefix_caching=True, preemption=False,
                  max_queue_len=None, clock=None, draft_model=None,
                  spec_k=4, spec_adaptive=True, prefill_only=False,
-                 adapter_store=None, degrade=None, kv_dtype=None):
+                 adapter_store=None, degrade=None, kv_dtype=None, cp=1):
         cfg = model.cfg
         self.model = model
         # quantized KV cache (ISSUE 17): kv_dtype="int8" stores the block
@@ -109,6 +111,18 @@ class LLMEngine:
         if kv_dtype is not None and not kv_quant_enabled():
             kv_dtype = None
         self.kv_dtype = kv_dtype
+        # context-parallel serving (ISSUE 18): cp>1 shards the paged KV
+        # pool's physical blocks over a cp-wide mesh; prefill partials
+        # merge via ring/Ulysses and decode merges via psum. PT_CP=0 is
+        # the kill switch — checked HERE (construction) so the engine
+        # collapses to the single-device path with bit-identical traces.
+        cp = int(cp)
+        if cp != 1 and os.environ.get(
+                "PT_CP", "1").strip().lower() in ("0", "off", "false"):
+            cp = 1
+        if cp < 1:
+            raise ValueError(f"cp must be >= 1, got {cp}")
+        self.cp = cp
         self.num_slots = num_slots
         self.block_size = block_size
         # graceful degradation (ISSUE 16): an optional shared
@@ -124,6 +138,20 @@ class LLMEngine:
         # MoE models route tokens through expert all_to_alls inside the
         # tick — give chaos a hook at that boundary (dead expert shard)
         self._is_moe = is_moe_model(model)
+        if self.cp > 1:
+            if self._is_moe:
+                raise NotImplementedError(
+                    "context-parallel serving (cp>1) does not compose with "
+                    "MoE models yet — the expert all_to_all would need its "
+                    "own mesh axis")
+            if adapter_store is not None:
+                raise NotImplementedError(
+                    "context-parallel serving (cp>1) does not compose with "
+                    "multi-LoRA (adapter_store) yet — per-slot adapter "
+                    "gathers are not sharded over cp")
+            # each shard owns num_blocks/cp physical blocks — round the
+            # pool up so the contiguous split is exact
+            num_blocks = -(-num_blocks // self.cp) * self.cp
         self.eos_token_id = eos_token_id
         # engine defaults; each request may override temperature/top_p
         # (top_k stays engine-global — it is a static compile parameter)
@@ -199,7 +227,7 @@ class LLMEngine:
             block_size=block_size, max_blocks_per_seq=self.max_blocks_per_seq,
             top_k=top_k, seed=seed, draft_model=draft_model,
             spec_k=self.spec_k, max_seq_len=self.max_seq_len,
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype, cp=self.cp)
 
         # host mirrors (vectorised bookkeeping — no per-token python loops)
         self.slot_req = np.full(num_slots, -1, np.int64)   # req_id or -1
@@ -288,6 +316,10 @@ class LLMEngine:
             bits = getattr(m, "_wo_bits", None)
             if bits:
                 kw["weight_dtype_bytes"] = bits / 8.0
+            # context parallelism (ISSUE 18): bill the per-token
+            # cross-shard merge traffic in the decode bytes model
+            if self.cp > 1 and cache is not None:
+                kw["cp"] = self.cp
             return _dc_replace(g, **kw) if kw else g
         self._geom = _geom(model, self.exe.cache)
         self._draft_geom = _geom(draft_model) if draft_model is not None \
@@ -411,6 +443,11 @@ class LLMEngine:
             if req.num_beams > self.num_slots:
                 raise ValueError(f"num_beams {req.num_beams} exceeds "
                                  f"num_slots={self.num_slots}")
+            if self.cp > 1:
+                raise NotImplementedError(
+                    "beam search under context parallelism (cp>1) is not "
+                    "supported — the beam select needs full logprobs, "
+                    "which the cp tick does not gather")
             if self.window is not None:
                 raise NotImplementedError(
                     "beam search + sliding-window block recycling are not "
@@ -441,9 +478,24 @@ class LLMEngine:
         if len(req.prompt) + req.max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         if self._worst_case_blocks(req) > self.mgr.num_blocks:
-            raise ValueError(
-                "request worst case exceeds the WHOLE block pool — it "
-                "could never be admitted (raise num_blocks)")
+            # the request could NEVER be admitted — even a cp-scaled pool
+            # (num_blocks grows ~linearly with the cp axis) cannot hold
+            # its worst case. Finish it gracefully instead of raising:
+            # a raise here would be fine for this caller, but the same
+            # check used to wedge router/batch clients that submit
+            # blindly — surface finish_reason="too_long" through the
+            # normal completion path so the FCFS head never jams on it.
+            rid = self.sched.enqueue(req)
+            self.queue.pop()                  # never actually waits
+            REQUESTS.submit(req, source="engine")
+            req.done = True
+            req.finish_reason = "too_long"
+            self.stats["rejected"] += 1
+            _REJECTED.inc(reason="too_long")
+            _FINISHED.inc(reason="too_long")
+            FLIGHT.record("serving.reject", rid=rid, reason="too_long")
+            REQUESTS.finish(req, "too_long", replica=self.trace_name)
+            return rid
         if req.adapter_id is not None:
             if self.adapter_store is None:
                 raise ValueError(
@@ -1670,6 +1722,11 @@ class LLMEngine:
         bit-exactly (``install_sequence``). Raises for beam/chunk-mid
         requests — only ACTIVE greedy slots are extractable (the router
         extracts after the final prefill chunk activates the slot)."""
+        if self.cp > 1:
+            raise NotImplementedError(
+                "KV handoff under context parallelism (cp>1) is not "
+                "supported — the gather program reads a single-device "
+                "pool; ship from/to cp=1 replicas")
         slots = np.nonzero(self.slot_req == rid)[0]
         if not len(slots) or rid in self.prefilling or rid in self.groups:
             raise ValueError(f"req {rid} holds no active greedy slot")
@@ -1755,6 +1812,11 @@ class LLMEngine:
                 "engine is draining — finishing in-flight requests, "
                 "admitting nothing new")
         req = payload.req
+        if self.cp > 1:
+            raise NotImplementedError(
+                "KV handoff under context parallelism (cp>1) is not "
+                "supported — the install scatter writes a single-device "
+                "pool; ship from/to cp=1 replicas")
         if req.adapter_id is not None:
             raise NotImplementedError(
                 "multi-LoRA sequences do not ride the KV handoff (the "
@@ -1898,6 +1960,16 @@ class LLMEngine:
         _KV_UTIL.set(used / self.mgr.num_blocks if self.mgr.num_blocks
                      else 0.0)
         self.kv.push_prefix_metrics()
+        # context parallelism (ISSUE 18): axis size + per-shard block
+        # occupancy under the contiguous split. The gauge family stays
+        # silent at cp=1 (no shard labels registered) so single-device
+        # dumps are byte-identical to pre-cp runs.
+        if self.cp > 1:
+            _CP_AXIS.set(self.cp)
+            ids = (b for t in self.mgr.tables.values() for b in t)
+            for s, n in enumerate(shard_occupancy(
+                    ids, self.mgr.num_blocks, self.cp)):
+                _CP_SHARD_BLOCKS.set(n, shard=str(s))
         led = self.kv.ledger
         if led.enabled:
             led.publish(bytes_per_block=self._kv_block_bytes(),
@@ -2028,6 +2100,15 @@ class LLMEngine:
             # blocks, no stale scales (exception-atomic).
             fault_point("serving.kv_quant", engine=self,
                         slots=np.nonzero(run_mask)[0])
+        if self.cp > 1:
+            # chaos: the decode tick is about to run the cross-shard
+            # partial gather (psum merge over cp). Fires BEFORE table
+            # growth and the donating tick jit, so an injected exception
+            # aborts the tick with the cache, tables, table_len, and the
+            # ledger untouched — no leaked blocks, assert_quiescent and
+            # reconcile stay clean (exception-atomic).
+            fault_point("serving.cp_gather", engine=self,
+                        slots=np.nonzero(run_mask)[0])
         rows, cols, vals = self._grow_tables(run_mask & ~self.is_beam)
         # growth may have preempted slots — recompute the mask after it
         run_mask = self.active & ~spec_handled
@@ -2048,6 +2129,8 @@ class LLMEngine:
             was_active = run_mask.copy()
             nxt = np.asarray(nxt)             # the one per-tick host fetch
         t2 = time.perf_counter()
+        if self.cp > 1:
+            _CP_GATHER_S.observe(t2 - t1)
         for g in self.groups.values():        # device-resident, lazy gather
             g.logp = logp[np.asarray(g.slots)]
         self.cur += was_active                # vectorised mirrors

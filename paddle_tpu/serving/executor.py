@@ -11,6 +11,8 @@ sites rely on).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,7 +21,13 @@ from paddle_tpu.models.decoding import KVCache, _sample_rows
 from paddle_tpu.models.paged import (PagedKVCache, _BEAM_GROUP_UPDATE_JIT,
                                      _PREFILL_CHUNK_JIT, _PREFILL_JIT,
                                      _PREFIX_COW_JIT, _REWIND_LENS_JIT,
-                                     _TICK_JIT, _VERIFY_CHUNK_JIT)
+                                     _TICK_JIT, _VERIFY_CHUNK_JIT,
+                                     _prefix_cow_update,
+                                     llama_decode_tick,
+                                     llama_prefill_chunk_paged,
+                                     llama_prefill_paged,
+                                     llama_verify_chunk_paged,
+                                     spec_rewind_lens)
 from paddle_tpu.models.speculative import _FWD_ROWS_JIT
 
 # module-level so its compile cache persists across admissions
@@ -27,15 +35,27 @@ _SAMPLE_ROWS_JIT = jax.jit(_sample_rows, static_argnums=(4,))
 
 
 class ModelExecutor:
-    """Jitted prefill/decode/verify programs over one paged KV pool."""
+    """Jitted prefill/decode/verify programs over one paged KV pool.
+
+    ``cp > 1`` (context parallelism, ISSUE 18) shards the pool's physical
+    blocks over a ``cp`` mesh axis — member s owns GLOBAL block ids
+    [s*per, (s+1)*per), per = num_blocks/cp — while weights, block
+    tables, lens and every activation stay replicated. All jitted
+    programs then run inside ``shard_map``: scatters drop non-owned
+    writes, attention emits per-shard online-softmax partials, and the
+    merges (psum for decode, ring/Ulysses for chunk prefill) are
+    bit-identical on every member, so sampling stays replicated and the
+    host engine sees the exact single-device contract."""
 
     def __init__(self, model, *, num_slots, num_blocks, block_size,
                  max_blocks_per_seq, top_k=None, seed=0, draft_model=None,
-                 spec_k=4, max_seq_len=None, kv_dtype=None):
+                 spec_k=4, max_seq_len=None, kv_dtype=None, cp=1):
         cfg = model.cfg
         self.model = model
         self.top_k = top_k
         self.rng = jax.random.PRNGKey(seed)
+        self.cp = int(cp)
+        self.mesh = None
         # kv_dtype="int8": int8 block pools + parallel per-(position,
         # kv-head) f32 scale pools; every jit here quantizes on write and
         # dequantizes on read (ISSUE 17). None = pools in the model dtype.
@@ -44,6 +64,8 @@ class ModelExecutor:
             cfg.num_key_value_heads,
             cfg.hidden_size // cfg.num_attention_heads,
             num_slots, max_blocks_per_seq, cfg.dtype, kv_dtype=kv_dtype)
+        if self.cp > 1:
+            self._init_cp(num_blocks)
         self.draft_model = draft_model
         self._draft_cache = None
         if draft_model is not None:
@@ -54,9 +76,84 @@ class ModelExecutor:
                 dcfg.num_key_value_heads,
                 dcfg.hidden_size // dcfg.num_attention_heads, dcfg.dtype)
 
+    # ------------------------------------------------- context parallelism
+    def _init_cp(self, num_blocks):
+        """Build the cp mesh, lay the pools out sharded on their block
+        axis, and compile per-executor shard_map'd twins of every cache
+        program. Per-executor (not module-level) jits: their traces bake
+        the mesh + PT_CP_IMPL, and they die with the executor, so the
+        ``clear_jit_caches`` env-flip contract is construction-scoped for
+        free."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed._compat import shard_map
+        from paddle_tpu.distributed.mesh import HybridMesh
+
+        cp = self.cp
+        devs = jax.devices()
+        if cp > len(devs):
+            raise ValueError(f"cp={cp} exceeds {len(devs)} devices")
+        if num_blocks % cp:
+            raise ValueError(
+                f"num_blocks={num_blocks} must divide by cp={cp} "
+                "(equal per-shard pools)")
+        self.mesh = HybridMesh(cp=cp, devices=devs[:cp])
+        pool_s = NamedSharding(self.mesh.mesh, P("cp"))
+        rep_s = NamedSharding(self.mesh.mesh, P())
+        c = self.cache
+        self.cache = PagedKVCache(
+            [jax.device_put(p, pool_s) for p in c.k_pools],
+            [jax.device_put(p, pool_s) for p in c.v_pools],
+            jax.device_put(c.block_tables, rep_s),
+            jax.device_put(c.lens, rep_s),
+            tuple(jax.device_put(p, pool_s) for p in c.k_scales),
+            tuple(jax.device_put(p, pool_s) for p in c.v_scales))
+        # pytree-PREFIX spec: each field leaf broadcasts over its subtree
+        cs = PagedKVCache(P("cp"), P("cp"), P(), P(), P("cp"), P("cp"))
+        R = P()
+
+        def smap(fn, in_specs, out_specs):
+            return shard_map(fn, mesh=self.mesh.mesh,
+                             in_specs=in_specs, out_specs=out_specs)
+
+        self._cp_prefill = jax.jit(smap(
+            functools.partial(llama_prefill_paged, cp_axis="cp"),
+            (R, R, R, cs, R, R), (R, cs)))
+        self._cp_prefill_chunk = jax.jit(smap(
+            functools.partial(llama_prefill_chunk_paged, cp_axis="cp"),
+            (R, R, R, R, cs, R, R), (R, cs)), donate_argnums=(4,))
+        self._cp_verify_chunk = jax.jit(smap(
+            functools.partial(llama_verify_chunk_paged, cp_axis="cp"),
+            (R, R, R, R, cs, R, R), (R, cs)), donate_argnums=(4,))
+        self._cp_rewind = jax.jit(smap(
+            spec_rewind_lens, (cs, R, R), cs), donate_argnums=(0,))
+        top_k = self.top_k
+
+        # top_k / want_logp are STATIC in the tick; bake them (beams — the
+        # only want_logp consumer — are refused under cp by the engine) so
+        # shard_map sees purely positional array args
+        def _tick(model, tokens, cache, active, rows, cols, vals, rng,
+                  temps, top_ps, bias):
+            return llama_decode_tick(
+                model, tokens, cache, active, rows, cols, vals, rng,
+                temps, top_ps, top_k, False, None, bias, cp_axis="cp")
+
+        self._cp_tick = jax.jit(smap(
+            _tick, (R, R, cs, R, R, R, R, R, R, R, R), (R, R, cs)),
+            donate_argnums=(2,))
+        self._cp_cow = jax.jit(smap(
+            functools.partial(_prefix_cow_update, cp_axis="cp"),
+            (cs, R, R), cs), donate_argnums=(0,))
+
     def next_key(self):
         self.rng, sub = jax.random.split(self.rng)
         return sub
+
+    def _no_cp_lora(self, lora):
+        if lora is not None and self.cp > 1:
+            raise NotImplementedError(
+                "multi-LoRA under context parallelism (cp > 1) is not "
+                "supported yet — serve adapters with cp=1")
+        return lora
 
     # ------------------------------------------------------------ prefill
     def prefill(self, ids, lens, slots, rows, lora=None):
@@ -64,6 +161,12 @@ class ModelExecutor:
         their cache slots while other slots keep decoding state.
         ``lora`` (optional pytree, see ``models.paged._lora_delta``)
         applies the batched multi-LoRA correction per row."""
+        if self.cp > 1:
+            self._no_cp_lora(lora)
+            logits, self.cache = self._cp_prefill(
+                self.model, jnp.asarray(ids), jnp.asarray(lens),
+                self.cache, jnp.asarray(slots), jnp.asarray(rows))
+            return logits
         logits, self.cache = _PREFILL_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             self.cache, jnp.asarray(slots), jnp.asarray(rows), lora=lora)
@@ -72,6 +175,13 @@ class ModelExecutor:
     def prefill_chunk(self, ids, lens, offs, slots, rows, lora=None):
         """One chunk per row, written from an arbitrary offset over the
         slot's pool prefix (chunked prefill / prefix-cache resume)."""
+        if self.cp > 1:
+            self._no_cp_lora(lora)
+            logits, self.cache = self._cp_prefill_chunk(
+                self.model, jnp.asarray(ids), jnp.asarray(lens),
+                jnp.asarray(offs), self.cache, jnp.asarray(slots),
+                jnp.asarray(rows))
+            return logits
         logits, self.cache = _PREFILL_CHUNK_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(lens),
             jnp.asarray(offs), self.cache, jnp.asarray(slots),
@@ -81,6 +191,13 @@ class ModelExecutor:
     def verify_chunk(self, ids, clens, offs, slot_ids, rows, lora=None):
         """Target forward over each slot's proposal window (spec decode);
         shares the chunked-prefill program shape."""
+        if self.cp > 1:
+            self._no_cp_lora(lora)
+            logits, self.cache = self._cp_verify_chunk(
+                self.model, jnp.asarray(ids), jnp.asarray(clens),
+                jnp.asarray(offs), self.cache, jnp.asarray(slot_ids),
+                jnp.asarray(rows))
+            return logits
         logits, self.cache = _VERIFY_CHUNK_JIT(
             self.model, jnp.asarray(ids), jnp.asarray(clens),
             jnp.asarray(offs), self.cache, jnp.asarray(slot_ids),
@@ -89,6 +206,10 @@ class ModelExecutor:
 
     def rewind_lens(self, slots, lens):
         """Length-pointer-only rewind after a partial spec accept."""
+        if self.cp > 1:
+            self.cache = self._cp_rewind(self.cache, jnp.asarray(slots),
+                                         jnp.asarray(lens))
+            return
         self.cache = _REWIND_LENS_JIT(self.cache, jnp.asarray(slots),
                                       jnp.asarray(lens))
 
@@ -101,6 +222,18 @@ class ModelExecutor:
         the per-slot multi-LoRA pytree; ``bias`` a [num_slots, V]
         grammar-mask logit bias applied before sampling."""
         sub = self.next_key()
+        if self.cp > 1:
+            self._no_cp_lora(lora)
+            if need_logp:
+                raise NotImplementedError(
+                    "beam search (want_logp) under cp > 1 is not supported")
+            nxt, logp, self.cache = self._cp_tick(
+                self.model, jnp.asarray(last_tok), self.cache,
+                jnp.asarray(run_mask), jnp.asarray(rows),
+                jnp.asarray(cols), jnp.asarray(vals), sub,
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                None if bias is None else jnp.asarray(bias))
+            return nxt, logp
         nxt, logp, self.cache = _TICK_JIT(
             self.model, jnp.asarray(last_tok), self.cache,
             jnp.asarray(run_mask), jnp.asarray(rows), jnp.asarray(cols),
@@ -115,17 +248,22 @@ class ModelExecutor:
         width so the jit compiles once; longer plans run in batches."""
         nb = self.cache.num_blocks
         width = 8
+        cow = self._cp_cow if self.cp > 1 else _PREFIX_COW_JIT
         for i in range(0, len(pairs), width):
             chunk = pairs[i:i + width]
             src = np.full(width, nb, np.int32)      # sentinel = no copy
             dst = np.full(width, nb, np.int32)
             for j, (s, d) in enumerate(chunk):
                 src[j], dst[j] = s, d
-            self.cache = _PREFIX_COW_JIT(self.cache, jnp.asarray(src),
-                                         jnp.asarray(dst))
+            self.cache = cow(self.cache, jnp.asarray(src),
+                             jnp.asarray(dst))
 
     def beam_group_update(self, slots, rows, lens_val, copy_src, copy_dst):
         """Install forked beam tables + partial-block copy-on-write."""
+        if self.cp > 1:
+            raise NotImplementedError(
+                "beam search under context parallelism (cp > 1) is not "
+                "supported yet")
         self.cache = _BEAM_GROUP_UPDATE_JIT(
             self.cache, jnp.asarray(slots, jnp.int32), jnp.asarray(rows),
             jnp.asarray(lens_val, jnp.int32), jnp.asarray(copy_src),

@@ -119,6 +119,9 @@ SITES = {
                             "all_to_all; exception-atomic tick abort",
     "serving.kv_quant": "before an int8 pool's quantize-on-write scatter; "
                         "exception-atomic tick abort, no stale scales",
+    "serving.cp_gather": "before a cp>1 decode tick's cross-shard partial "
+                         "gather; exception-atomic tick abort, no leaked "
+                         "blocks, ledger reconciles",
     "serving.prefix_evict": "before a radix prefix-cache leaf eviction; "
                             "pre-mutation, trie/free list untouched",
     "serving.adapter_swap": "before a LoRA adapter host→device upload; "

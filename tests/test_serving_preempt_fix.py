@@ -68,16 +68,22 @@ def test_chunked_prefill_livelock_drains(model):
 
 
 def test_request_bigger_than_pool_refused_at_add(model):
-    """A request whose worst case can NEVER fit the pool is refused at
-    add_request — the in-engine no-progress MemoryError backstop stays as
-    defense-in-depth behind this gate."""
+    """A request whose worst case can NEVER fit the pool finishes
+    immediately with finish_reason="too_long" (it must not wedge the FCFS
+    head waiting for capacity that cannot exist) — the in-engine
+    no-progress MemoryError backstop stays as defense-in-depth behind
+    this gate."""
     rs = np.random.RandomState(12)
     p = rs.randint(0, 64, (24,))
     eng = LLMEngine(model, num_slots=2, block_size=4, max_prompt_len=8,
                     max_seq_len=32, num_blocks=2, preemption=True,
                     prefix_caching=False)
-    with pytest.raises(ValueError):
-        eng.add_request(Request(p, max_new_tokens=4))
+    req = Request(p, max_new_tokens=4)
+    rid = eng.add_request(req)
+    assert req.done and req.finish_reason == "too_long"
+    assert eng.stats["rejected"] == 1
+    res = eng.run()
+    assert res[rid] == []
 
 
 def test_windowed_growth_preemption_no_storm(model):
