@@ -156,6 +156,7 @@ def cpu_legs_main():
                     ("serving_prefix", bench_serving_prefix),
                     ("serving_multilora", bench_serving_multilora),
                     ("serving_degradation", bench_serving_degradation),
+                    ("serving_slo", bench_serving_slo),
                     ("serving_quant", bench_serving_quant),
                     ("serving_longctx", bench_serving_longctx)):
         try:
@@ -170,6 +171,7 @@ def cpu_legs_main():
                          "serving_pallas_", "serving_adapter_",
                          "serving_tenant_", "serving_grammar_",
                          "serving_degrade_", "serving_session_",
+                         "serving_slo_",
                          "serving_quant_", "serving_cp_",
                          "moe_", "router_"))}
     print(json.dumps(out))
@@ -1315,6 +1317,98 @@ def bench_serving_degradation():
         "ladder_on": on, "ladder_off": off,
         "goodput_gain": gain,
         "win": bool(gain is not None and gain > 0),
+        "requests": len(prompts), "max_new_tokens": max_new,
+    }
+
+
+def bench_serving_slo():
+    """SLO-tracker leg (ISSUE 19): two-tenant mixed load — an
+    interactive tenant served normally next to a batch tenant whose
+    every request carries an already-blown deadline. Reports the
+    tracker's throughput overhead (same workload re-run under PT_SLO=0),
+    the metered per-tenant device-second split, the token columns, and
+    whether the multi-window burn-rate alert fired for the abused tenant
+    while leaving the interactive tenant clean. CPU-safe."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import GOODPUT
+    from paddle_tpu.observability.slo import Objective, SLOTracker
+    from paddle_tpu.serving import LLMEngine, Request
+
+    pt.seed(0)
+    kw = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+              num_attention_heads=8, num_key_value_heads=4,
+              max_position_embeddings=256)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=4, **kw))
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 512, (int(l),))
+               for l in rs.randint(8, 32, size=24)]
+    max_new = 16
+
+    def arm(slo_on):
+        saved = os.environ.get("PT_SLO")
+        os.environ["PT_SLO"] = "1" if slo_on else "0"
+        try:
+            tracker = SLOTracker({"*": [
+                Objective("availability", target=0.999),
+                Objective("ttft_p95", target=2.0)]})
+            tracker.poll()       # baseline past earlier legs' counters
+            eng = LLMEngine(model, num_slots=8, block_size=8,
+                            max_prompt_len=32, max_seq_len=64,
+                            preemption=True, slo=tracker)
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                inter = i % 2 == 0
+                eng.add_request(Request(
+                    p, max_new_tokens=max_new,
+                    tenant_id="interactive" if inter else "batch",
+                    deadline_s=None if inter else 1e-9))
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            tracker.poll()
+            led = tracker.ledger
+            dev, total_dev = led.device_seconds, led.device_seconds_total
+            burn = {t: s["burn_short"]
+                    for (t, o), s in tracker.state.items()
+                    if o == "availability"}
+            return {
+                "tokens_per_sec": round(
+                    sum(len(t) for t in out.values()) / dt, 1),
+                "device_seconds": {t: round(v, 4)
+                                   for t, v in sorted(dev.items())},
+                "device_share_interactive": (
+                    round(dev.get("interactive", 0.0) / total_dev, 4)
+                    if total_dev else None),
+                "good_tokens": dict(sorted(led.good_tokens.items())),
+                "reconciled": (abs(sum(dev.values()) - total_dev)
+                               <= 1e-9 * max(total_dev, 1.0)),
+                "burn_short": {t: round(b, 2)
+                               for t, b in sorted(burn.items())},
+                "breaches": [(b["tenant"], b["objective"])
+                             for b in tracker.breaches],
+                "polls": tracker.polls,
+            }
+        finally:
+            GOODPUT.attach_sink(None)
+            if saved is None:
+                os.environ.pop("PT_SLO", None)
+            else:
+                os.environ["PT_SLO"] = saved
+
+    arm(True)                               # warmup / compile
+    on = arm(True)
+    off = arm(False)
+    overhead = (None
+                if not (on["tokens_per_sec"] and off["tokens_per_sec"])
+                else round(1.0 - on["tokens_per_sec"]
+                           / off["tokens_per_sec"], 4))
+    return {
+        "tracker_on": on, "tracker_off": off,
+        "tracker_overhead_frac": overhead,
+        "abuser_breached": any(t == "batch" for t, _ in on["breaches"]),
+        "interactive_clean": all(t != "interactive"
+                                 for t, _ in on["breaches"]),
         "requests": len(prompts), "max_new_tokens": max_new,
     }
 

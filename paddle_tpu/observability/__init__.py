@@ -51,6 +51,18 @@ The memory layer (ISSUE 13) accounts for where the KV pool's blocks are:
     admission-stall forensics, and the ``GET /memory`` endpoint
     (:func:`memory_doc`).
 
+The SLO layer (ISSUE 19) turns the aggregates into objectives:
+
+  * :mod:`paddle_tpu.observability.windows` — :class:`WindowedReads`,
+    the delta-since-last-poll read machinery shared by the degradation
+    ladder and the SLO tracker.
+  * :mod:`paddle_tpu.observability.slo` — :class:`SLOTracker`,
+    declarative per-tenant :class:`Objective` targets with SRE-style
+    multi-window burn-rate alerting, plus :class:`CostLedger`, the
+    usage-metering ledger attributing device-seconds, KV block-seconds
+    and goodput/waste tokens to tenants (``GET /slo`` /
+    ``GET /tenants``). ``PT_SLO=0`` kills the whole layer.
+
 ``python -m paddle_tpu.observability`` prints a generated reference of
 every registered metric instrument.
 """
@@ -81,6 +93,10 @@ from paddle_tpu.observability.health import (HEALTH, HealthEvaluator,
 from paddle_tpu.observability.requests import REQUESTS, RequestTracker
 from paddle_tpu.observability.goodput import GOODPUT, GoodputLedger
 from paddle_tpu.observability.memledger import MemLedger, memory_doc
+from paddle_tpu.observability.windows import WindowedReads
+from paddle_tpu.observability.slo import (CostLedger, Objective, SLOTracker,
+                                          default_objectives, slo_doc,
+                                          slo_enabled, tenants_doc)
 
 __all__ = [
     "METRICS", "MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -96,6 +112,9 @@ __all__ = [
     "HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
     "REQUESTS", "RequestTracker", "GOODPUT", "GoodputLedger",
     "MemLedger", "memory_doc",
+    "WindowedReads",
+    "SLOTracker", "Objective", "CostLedger", "default_objectives",
+    "slo_enabled", "slo_doc", "tenants_doc",
     "enable", "disable", "metrics_snapshot", "dump",
 ]
 

@@ -23,6 +23,7 @@ _INSTRUMENT_MODULES = (
     "paddle_tpu.observability.compile",
     "paddle_tpu.observability.goodput",
     "paddle_tpu.observability.memledger",
+    "paddle_tpu.observability.slo",
     "paddle_tpu.serving.telemetry",
     "paddle_tpu.serving.quant",
     "paddle_tpu.serving.cp",

@@ -39,6 +39,14 @@ flags a fleet whose waste fraction says the devices mostly heat air.
 
 All state lives in the metrics registry — the ledger owns no counters
 of its own, so the conftest registry reset is the only hygiene needed.
+
+Usage metering (ISSUE 19): the ledger is also the tenant-attribution
+choke point. An attached sink (the SLO tracker's cost ledger) receives
+every ``good``/``waste``/``saved`` charge together with the ``tenant=``
+the call site knows (``None`` for batch-level overheads like padding
+rows) — because attribution happens INSIDE the same call that moves the
+counters, per-tenant sums reconcile with the untenanted totals exactly,
+by construction, not by auditing call sites.
 """
 from __future__ import annotations
 
@@ -75,20 +83,38 @@ def _series_total(inst) -> float:
 class GoodputLedger:
     """Thin façade over the three instruments. Methods never allocate
     beyond the counter increment; ``waste(n<=0)`` is a no-op so call
-    sites can pass raw deltas without guarding."""
+    sites can pass raw deltas without guarding. ``tenant=`` is optional
+    attribution metadata forwarded to the attached metering sink (if
+    any) — it never affects the untenanted counters."""
 
-    def good(self, n: int = 1):
+    def __init__(self):
+        self._sink = None
+
+    def attach_sink(self, sink):
+        """Install (or clear, with ``None``) the tenant-attribution
+        sink — an object with ``good(tenant, n)`` / ``waste(tenant,
+        why, n)`` / ``saved(tenant, n)``. One sink per process; the SLO
+        tracker's cost ledger attaches itself at construction."""
+        self._sink = sink
+
+    def good(self, n: int = 1, tenant=None):
         _GOOD.inc(n)
+        if self._sink is not None:
+            self._sink.good(tenant, n)
 
-    def waste(self, why: str, n: int):
+    def waste(self, why: str, n: int, tenant=None):
         if n > 0:
             _WASTE.inc(n, why=why)
+            if self._sink is not None:
+                self._sink.waste(tenant, why, n)
 
-    def saved(self, n: int):
+    def saved(self, n: int, tenant=None):
         """Token-positions admission adopted from the prefix cache —
         device work avoided entirely (no-op for n <= 0)."""
         if n > 0:
             _SAVED.inc(n)
+            if self._sink is not None:
+                self._sink.saved(tenant, n)
 
     def saved_total(self) -> float:
         return _series_total(_SAVED)

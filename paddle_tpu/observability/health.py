@@ -28,6 +28,7 @@ from typing import Callable, List, Optional, Sequence
 from paddle_tpu.observability.metrics import METRICS, Histogram
 
 __all__ = ["HEALTH", "HealthEvaluator", "HealthRule", "install_default_rules",
+           "gauge_max",
            "counter_value", "gauge_value", "counter_ratio", "counter_share",
            "gauge_imbalance", "gauge_deficit", "histogram_quantile",
            "histogram_sum_ratio", "kv_parked_ratio"]
@@ -88,6 +89,24 @@ def gauge_imbalance(name: str, registry=None) -> Callable[[], float]:
         vals = [float(cell[0]) for cell in inst._series.values()]
         mean = sum(vals) / len(vals)
         return (max(vals) - min(vals)) / max(mean, 1.0)
+    return get
+
+
+def gauge_max(name: str, registry=None, *,
+              deficit: bool = False) -> Callable[[], float]:
+    """Worst series of a labeled gauge — max over label series, e.g.
+    the hottest tenant's SLO burn rate. ``deficit=True`` reads
+    ``max(1 - v)`` instead (worst budget CONSUMED when the gauge stores
+    budget remaining). NaN (→ OK) while the gauge is absent or empty."""
+    def get():
+        reg = registry if registry is not None else METRICS
+        inst = reg.get(name)
+        if inst is None or not inst._series:
+            return float("nan")
+        vals = [float(cell[0]) for cell in inst._series.values()]
+        if deficit:
+            vals = [1.0 - v for v in vals]
+        return max(vals)
     return get
 
 
@@ -306,6 +325,20 @@ def install_default_rules(ev: HealthEvaluator,
                         "writes — never feed THIS evaluator back into "
                         "DegradationController(health=...), or the rung "
                         "becomes its own input and latches")
+    ev.rule("serving_slo_burn_rate",
+            gauge_max("serving_slo_burn_rate", registry),
+            warn=6.0, crit=14.4,
+            description="hottest tenant/objective short-window SLO "
+                        "error-budget burn multiple (1.0 = spending "
+                        "exactly the budget): 6x is the tracker's slow-"
+                        "burn gate, 14.4x its fast-burn page threshold")
+    ev.rule("serving_slo_budget_spent",
+            gauge_max("serving_slo_budget_remaining", registry,
+                      deficit=True),
+            warn=0.8, crit=1.0,
+            description="worst tenant/objective fraction of the "
+                        "compliance-window error budget already "
+                        "consumed (1 - serving_slo_budget_remaining)")
     ev.rule("router_hedge_rate",
             gauge_value("router_hedge_rate", registry),
             warn=0.2, crit=0.6,

@@ -15,6 +15,10 @@ process-global registry the way a Prometheus scraper expects:
   * ``GET /memory``        → every live KV pool's memory-ledger snapshot
     (blocks by state, fragmentation, stalls, top holders) plus the
     per-device HBM stats (ISSUE 13)
+  * ``GET /slo``           → every live SLO tracker's objectives, per-
+    tenant burn rates / budget remaining and recent breaches (ISSUE 19)
+  * ``GET /tenants``       → the usage-metering cost ledger: per-tenant
+    device-seconds, KV block-seconds and goodput/waste/saved tokens
   * ``GET /profile?seconds=N`` → run ONE ``jax.profiler`` trace capture
     of N seconds (0 < N <= 600) into ``PT_PROFILE_DIR`` (default
     ``pt_profile``); 400 on a missing/bad ``seconds``, 409 while a
@@ -110,6 +114,15 @@ class _Handler(BaseHTTPRequestHandler):
             body = (json.dumps(memory_doc(), sort_keys=True)
                     + "\n").encode()
             ctype = "application/json"
+        elif path == "/slo":
+            from paddle_tpu.observability.slo import slo_doc
+            body = (json.dumps(slo_doc(), sort_keys=True) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/tenants":
+            from paddle_tpu.observability.slo import tenants_doc
+            body = (json.dumps(tenants_doc(), sort_keys=True)
+                    + "\n").encode()
+            ctype = "application/json"
         elif path == "/profile":
             qs = parse_qs(self.path.partition("?")[2])
             raw = qs.get("seconds", [None])[0]
@@ -142,7 +155,8 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self.send_error(
                 404, "try /metrics, /metrics.json, /healthz, /flight, "
-                     "/requests, /roofline, /memory or /profile?seconds=N")
+                     "/requests, /roofline, /memory, /slo, /tenants or "
+                     "/profile?seconds=N")
             return
         self.send_response(status)
         self.send_header("Content-Type", ctype)

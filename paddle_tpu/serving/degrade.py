@@ -76,15 +76,11 @@ import numpy as np
 
 from paddle_tpu.observability import METRICS
 from paddle_tpu.observability.flight import FLIGHT
-from paddle_tpu.observability.metrics import Histogram
+from paddle_tpu.observability.windows import WindowedReads
 from paddle_tpu.serving.telemetry import (_DEGRADE_LEVEL,
                                           _DEGRADE_TRANSITIONS)
 
 __all__ = ["DegradationController", "SessionSnapshot", "default_signals"]
-
-
-def _nan() -> float:
-    return float("nan")
 
 
 # --------------------------------------------------------------- snapshots
@@ -117,12 +113,24 @@ class SessionSnapshot:
 def default_signals(*, goodput_warn: float = 0.5, goodput_crit: float = 0.25,
                     goodput_min_tokens: int = 64,
                     queue_warn_s: float = 1.0, queue_crit_s: float = 5.0,
-                    kv_util_floor: float = 0.97) -> List[tuple]:
+                    kv_util_floor: float = 0.97,
+                    slo_burn: bool = False,
+                    slo_burn_crit: float = 14.4) -> List[tuple]:
     """The stock signal set. Each signal is ``(name, fn)`` where ``fn``
     receives the controller and returns a target rung 0–4; the ladder
     steers toward the max over all signals. All reads are windowed
     through the controller's snapshot helpers, so targets describe the
-    last poll interval, not process lifetime."""
+    last poll interval, not process lifetime.
+
+    ``slo_burn=True`` adds an OFF-BY-DEFAULT signal that targets L3
+    (shed best-effort tenants) when any tenant's short-window
+    ``serving_slo_burn_rate`` reaches ``slo_burn_crit`` (the tracker's
+    fast-burn threshold). Caveat — this closes a feedback loop: the
+    ladder's own mitigations (rejections at L4, shed tenants at L3)
+    count against availability SLOs, so an aggressive threshold can
+    latch the ladder high on the very errors it causes. That is why it
+    ships disabled; enable it only with an availability objective whose
+    budget tolerates the ladder's remedial rejections."""
 
     def health_sig(c) -> int:
         if c.health is None:
@@ -155,8 +163,20 @@ def default_signals(*, goodput_warn: float = 0.5, goodput_crit: float = 0.25,
         stalls = c.window_counter("serving_kv_stall_total")
         return 2 if (util >= kv_util_floor and stalls > 0) else 0
 
-    return [("health", health_sig), ("goodput", goodput_sig),
+    def slo_burn_sig(c) -> int:
+        # max over tenant/objective series, not the sum — one tenant
+        # burning hot should not be diluted by compliant neighbours
+        inst = c.registry.get("serving_slo_burn_rate")
+        if inst is None or not inst._series:
+            return 0
+        worst = max(cell[0] for cell in inst._series.values())
+        return 3 if worst >= slo_burn_crit else 0
+
+    sigs = [("health", health_sig), ("goodput", goodput_sig),
             ("queue_wait", queue_wait_sig), ("kv_pressure", kv_pressure_sig)]
+    if slo_burn:
+        sigs.append(("slo_burn", slo_burn_sig))
+    return sigs
 
 
 # ------------------------------------------------------------- controller
@@ -196,7 +216,12 @@ class DegradationController:
         self.owner: object = None
         self._up_streak = 0
         self._down_streak = 0
-        self._snap: dict = {}                 # windowed-read snapshots
+        # windowed-read machinery (extracted to observability/windows.py
+        # in ISSUE 19 so the SLO tracker shares it); this controller's
+        # reader owns its own snapshot dict, so a co-resident SLOTracker
+        # polling the same registry never steals the ladder's deltas
+        self.windows = WindowedReads(self.registry)
+        self._snap = self.windows._snap       # windowed-read snapshots
         _DEGRADE_LEVEL.set(0.0)
 
     # ------------------------------------------------------------ switches
@@ -233,60 +258,29 @@ class DegradationController:
         return self.active_level < 4
 
     # ------------------------------------------------------ windowed reads
+    # thin delegations to the shared WindowedReads machinery — kept as
+    # controller methods because custom signals receive the controller
+    # and call these directly (see default_signals and the bench legs)
     def window_counter(self, name: str) -> float:
         """Counter delta (summed over label series) since the previous
         poll. The first read of a name baselines it at the current
         total, so pre-existing counts never trigger the ladder."""
-        inst = self.registry.get(name)
-        total = 0.0 if inst is None else \
-            float(sum(cell[0] for cell in inst._series.values()))
-        key = ("c", name)
-        prev = self._snap.get(key, total)
-        self._snap[key] = total
-        return max(0.0, total - prev)
+        return self.windows.window_counter(name)
 
     def gauge(self, name: str) -> float:
         """Instantaneous gauge read (summed over label series)."""
-        inst = self.registry.get(name)
-        if inst is None:
-            return 0.0
-        return float(sum(cell[0] for cell in inst._series.values()))
+        return self.windows.gauge(name)
 
     def window_goodput(self) -> Tuple[float, float]:
         """(goodput ratio, token volume) over the window — NaN ratio on
         an empty window, so no-traffic polls read as healthy."""
-        good = self.window_counter("serving_goodput_tokens_total")
-        waste = self.window_counter("serving_waste_total")
-        volume = good + waste
-        return (good / volume if volume > 0 else _nan()), volume
+        return self.windows.window_goodput()
 
     def window_quantile(self, name: str, q: float) -> float:
         """Histogram quantile over THIS window's observations: per-
         bucket count deltas vs the previous poll, interpolated exactly
         like ``Histogram.quantile``. NaN when the window saw nothing."""
-        inst = self.registry.get(name)
-        if not isinstance(inst, Histogram):
-            return _nan()
-        n = len(inst.buckets) + 1
-        agg = [0] * n
-        for s in inst._series.values():
-            for i, c in enumerate(s.counts):
-                agg[i] += c
-        key = ("h", name)
-        prev = self._snap.get(key, agg)
-        self._snap[key] = agg
-        delta = [max(0, a - p) for a, p in zip(agg, prev)]
-        count = sum(delta)
-        if count == 0:
-            return _nan()
-        rank, cum = q * count, 0.0
-        for i, bound in enumerate(inst.buckets):
-            prev_cum = cum
-            cum += delta[i]
-            if cum >= rank and delta[i] > 0:
-                lo = inst.buckets[i - 1] if i > 0 else 0.0
-                return lo + (bound - lo) * ((rank - prev_cum) / delta[i])
-        return inst.buckets[-1]
+        return self.windows.window_quantile(name, q)
 
     # -------------------------------------------------------------- polling
     def poll(self) -> int:

@@ -72,10 +72,12 @@ from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
                                           _SPEC_DRAFT_REUSE,
                                           _SPEC_FALLBACKS,
                                           _SPEC_PROPOSED, _SPEC_RATE,
-                                          _SPEC_TOKENS, _TENANT_TOKENS,
+                                          _SPEC_TOKENS, _TENANT_FINISHED,
+                                          _TENANT_REJECTED, _TENANT_TOK_LAT,
+                                          _TENANT_TOKENS, _TENANT_TTFT,
                                           _TICK, _TICK_BREAKDOWN,
                                           _TIMEOUTS, _TOK_LAT, _TOKENS,
-                                          _TTFT)
+                                          _TTFT, tenant_label)
 from paddle_tpu.serving.transfer import (KVPayload, _GATHER_BLOCKS_JIT,
                                          _INSTALL_BLOCKS_JIT)
 from paddle_tpu.serving.types import (EngineDrainingError, OverloadError,
@@ -99,7 +101,8 @@ class LLMEngine:
                  seed=0, prefix_caching=True, preemption=False,
                  max_queue_len=None, clock=None, draft_model=None,
                  spec_k=4, spec_adaptive=True, prefill_only=False,
-                 adapter_store=None, degrade=None, kv_dtype=None, cp=1):
+                 adapter_store=None, degrade=None, slo=None, kv_dtype=None,
+                 cp=1):
         cfg = model.cfg
         self.model = model
         # quantized KV cache (ISSUE 17): kv_dtype="int8" stores the block
@@ -130,6 +133,10 @@ class LLMEngine:
         # chunked-prefill budget, admission shedding, and the session
         # gate. None (the default) means full service, always.
         self.degrade = degrade
+        # per-tenant SLO tracking + usage metering (ISSUE 19): an
+        # optional shared SLOTracker — charged per tick from step(),
+        # polled from the gauge sweep. None means no tracking, ever.
+        self.slo = slo
         self.max_prompt_len = max_prompt_len
         self.max_seq_len = max_seq_len or (max_prompt_len + 256)
         self.max_blocks_per_seq = -(-self.max_seq_len // block_size)
@@ -431,6 +438,8 @@ class LLMEngine:
                 and not self.degrade.accepting_sessions()):
             self.stats["rejected"] += 1
             _REJECTED.inc(reason="degraded")
+            if req.tenant_id is not None:
+                _TENANT_REJECTED.inc(tenant=tenant_label(req.tenant_id))
             raise OverloadError(
                 "degradation ladder at L4 — new sessions rejected, "
                 "retry after the cluster recovers")
@@ -493,6 +502,10 @@ class LLMEngine:
             self.stats["rejected"] += 1
             _REJECTED.inc(reason="too_long")
             _FINISHED.inc(reason="too_long")
+            if req.tenant_id is not None:
+                _TENANT_REJECTED.inc(tenant=tenant_label(req.tenant_id))
+                _TENANT_FINISHED.inc(tenant=tenant_label(req.tenant_id),
+                                     reason="too_long")
             FLIGHT.record("serving.reject", rid=rid, reason="too_long")
             REQUESTS.finish(req, "too_long", replica=self.trace_name)
             return rid
@@ -576,6 +589,9 @@ class LLMEngine:
         self.stats["timeouts" if reason == "timeout" else "cancelled"] += 1
         (_TIMEOUTS if reason == "timeout" else _CANCELLED).inc()
         _FINISHED.inc(reason=reason)
+        if req.tenant_id is not None:
+            _TENANT_FINISHED.inc(tenant=tenant_label(req.tenant_id),
+                                 reason=reason)
         FLIGHT.record("serving.timeout" if reason == "timeout"
                       else "serving.cancel", rid=req_id)
         REQUESTS.finish(req, reason, replica=self.trace_name)
@@ -1015,8 +1031,11 @@ class LLMEngine:
         req.done = True
         req.finish_reason = "beam"
         _FINISHED.inc(reason="beam")
+        if req.tenant_id is not None:
+            _TENANT_FINISHED.inc(tenant=tenant_label(req.tenant_id),
+                                 reason="beam")
         _TOKENS.inc(len(req.tokens))
-        GOODPUT.good(len(req.tokens))
+        GOODPUT.good(len(req.tokens), tenant=req.tenant_id)
         REQUESTS.tokens(req, len(req.tokens))
         REQUESTS.event(req, "kv_peak", replica=self.trace_name,
                        blocks=sum(self.kv.take_peak(s)
@@ -1300,7 +1319,7 @@ class LLMEngine:
                     reuse = int(m if eq.all() else np.argmin(eq))
         self.draft_cur[slot] = reuse
         if reuse:
-            GOODPUT.saved(reuse)
+            GOODPUT.saved(reuse, tenant=req.tenant_id)
             _SPEC_DRAFT_REUSE.inc(reuse)
 
     def _spec_draft(self, staged, seqs):
@@ -1326,7 +1345,7 @@ class LLMEngine:
             ids = np.zeros((ns, CH), np.int32)
             cl = np.zeros(ns, np.int32)
             rp = np.zeros(ns, np.int32)
-            for s, _, _ in staged:
+            for s, rid, _ in staged:
                 if pend_len[s] <= Cs:
                     continue               # already caught up: no writes
                 n = min(pend_len[s] - 1, CH)   # keep >= 1 for the steady feed
@@ -1337,7 +1356,9 @@ class LLMEngine:
                 # re-embedding inside the radix-adopted span is pure
                 # replay (first-time prompt embedding is not waste)
                 GOODPUT.waste("replay_prefill",
-                              min(dc + n, int(self._adopted_span[s])) - dc)
+                              min(dc + n, int(self._adopted_span[s])) - dc,
+                              tenant=getattr(self.requests.get(rid),
+                                             "tenant_id", None))
             self.exe.draft_rows(ids, rp, cl)
             self._acc_phase("spec_draft", int(cl.sum()), 1,
                             self._ctx_causal(cl, rp))
@@ -1349,7 +1370,7 @@ class LLMEngine:
         ids = np.zeros((ns, Cs), np.int32)
         cl = np.zeros(ns, np.int32)
         rp = np.zeros(ns, np.int32)
-        for s, _, _ in staged:
+        for s, rid, _ in staged:
             dc = int(self.draft_cur[s])
             pend = seqs[s][dc:]
             ids[s, :len(pend)] = pend
@@ -1357,7 +1378,9 @@ class LLMEngine:
             rp[s] = dc
             GOODPUT.waste("replay_prefill",
                           min(dc + len(pend),
-                              int(self._adopted_span[s])) - dc)
+                              int(self._adopted_span[s])) - dc,
+                          tenant=getattr(self.requests.get(rid),
+                                         "tenant_id", None))
         dl = self.exe.draft_rows(ids, rp, cl)
         self._acc_phase("spec_draft", int(cl.sum()), 1,
                         self._ctx_causal(cl, rp))
@@ -1489,8 +1512,12 @@ class LLMEngine:
             FLIGHT.record("serving.spec_fallback",
                           error=f"{type(e).__name__}: {e}")
             # every drafted token of this round was burned unverified
-            GOODPUT.waste("chaos_abort",
-                          sum(k_eff for _, _, k_eff in staged))
+            # (charged per slot so the metering ledger bills the tenant
+            # whose draft burned, not __system__)
+            for _, rid, k_eff in staged:
+                GOODPUT.waste("chaos_abort", k_eff,
+                              tenant=getattr(self.requests.get(rid),
+                                             "tenant_id", None))
             # draft frontiers ran ahead of the commit that never came;
             # roll them back so the next round re-feeds from the frontier
             for slot, _, _ in staged:
@@ -1592,7 +1619,9 @@ class LLMEngine:
             _SPEC_PROPOSED.inc(k_eff)
             _SPEC_ACCEPTED.inc(n_acc)
             _SPEC_TOKENS.observe(len(new))
-            GOODPUT.waste("spec_rejected", k_eff - n_acc)
+            GOODPUT.waste("spec_rejected", k_eff - n_acc,
+                          tenant=getattr(self.requests.get(rid),
+                                         "tenant_id", None))
             REQUESTS.spec(self.requests.get(rid), k_eff, n_acc)
             handled[slot] = True
             for tok in new:
@@ -1659,9 +1688,9 @@ class LLMEngine:
         req = self.requests[rid]
         req.tokens.append(token)
         _TOKENS.inc()
-        GOODPUT.good(1)
+        GOODPUT.good(1, tenant=req.tenant_id)
         if req.tenant_id is not None:
-            _TENANT_TOKENS.inc(tenant=str(req.tenant_id))
+            _TENANT_TOKENS.inc(tenant=tenant_label(req.tenant_id))
         g = self._grammar.get(slot)
         if g is not None:
             # advance the mask state past the committed token (EOS keeps
@@ -1674,10 +1703,18 @@ class LLMEngine:
             req._first_tok_t = now
             if req._submit_t is not None:
                 _TTFT.observe(max(0.0, now - req._submit_t))
+                if req.tenant_id is not None:
+                    _TENANT_TTFT.observe(
+                        max(0.0, now - req._submit_t),
+                        tenant=tenant_label(req.tenant_id))
             REQUESTS.event(req, "first_token", replica=self.trace_name,
                            slot=slot)
         elif req._last_tok_t is not None:
             _TOK_LAT.observe(max(0.0, now - req._last_tok_t))
+            if req.tenant_id is not None:
+                _TENANT_TOK_LAT.observe(
+                    max(0.0, now - req._last_tok_t),
+                    tenant=tenant_label(req.tenant_id))
         req._last_tok_t = now
         if req.stream is not None:
             req.stream(req, token)
@@ -1689,6 +1726,9 @@ class LLMEngine:
             req.done = True
             req.finish_reason = "eos" if eos else "length"
             _FINISHED.inc(reason=req.finish_reason)
+            if req.tenant_id is not None:
+                _TENANT_FINISHED.inc(tenant=tenant_label(req.tenant_id),
+                                     reason=req.finish_reason)
             if self.prefix_caching:
                 # commit the GENERATED span too before the blocks park —
                 # decode output becomes matchable (multi-turn chat
@@ -1991,6 +2031,11 @@ class LLMEngine:
         # clock by N.
         if self.degrade is not None and self.degrade.owner in (None, self):
             self.degrade.poll()
+        # SLO burn-rate sweep rides the same cadence and the same
+        # ownership protocol (a Router-claimed tracker is polled by the
+        # router only)
+        if self.slo is not None and self.slo.owner in (None, self):
+            self.slo.poll()
         self._push_roofline()
 
     def _kv_block_bytes(self) -> int:
@@ -2032,6 +2077,13 @@ class LLMEngine:
                 _TICK_BREAKDOWN.observe(ph.get(name, 0.0), phase=name)
             _TICK_BREAKDOWN.observe(max(0.0, total - timed), phase="host")
             _TICK.observe(total)
+            # usage metering (ISSUE 19): bill this tick's device time
+            # and KV occupancy to the tenants holding state — the same
+            # `total` the histogram just observed, so the ledger's
+            # device-seconds reconcile with serving_tick_seconds
+            # tick-for-tick
+            if self.slo is not None:
+                self.slo.charge_tick(self, total)
             acc = self._phase_acc
             acc["prefill"][0] += ph.get("prefill", 0.0)
             acc["spec_draft"][0] += ph.get("draft", 0.0)

@@ -66,7 +66,9 @@ from paddle_tpu.serving.telemetry import (_R_DEATHS, _R_DISPATCH,
                                           _R_TRANSFER_BLOCKS,
                                           _R_TRANSFER_RETRIES,
                                           _R_TRANSFER_SECONDS,
-                                          _R_TRANSFERS, _REJECTED)
+                                          _R_TRANSFERS, _REJECTED,
+                                          _TENANT_FINISHED,
+                                          _TENANT_REJECTED, tenant_label)
 from paddle_tpu.serving.transfer import (DeviceKVTransfer, KVTransferError,
                                          TransportPolicy, validate_payload)
 from paddle_tpu.serving.types import (EngineDrainingError, OverloadError,
@@ -108,7 +110,7 @@ class Router:
 
     def __init__(self, replicas, *, affinity=True, max_queue_len=None,
                  kv_transfer=None, install_imbalance_rule=True,
-                 degrade=None, snapshot_every=None,
+                 degrade=None, slo=None, snapshot_every=None,
                  max_session_restores=4, transport=None, clock=None):
         self.replicas: list[Replica] = []
         for i, r in enumerate(replicas):
@@ -162,6 +164,16 @@ class Router:
             for r in self.replicas:
                 if r.engine.degrade is None:
                     r.engine.degrade = degrade
+        # per-tenant SLO tracker + cost ledger (ISSUE 19): same owner
+        # protocol as the ladder — the router claims the tracker and
+        # polls it once per step so N replicas don't multiply the
+        # alerting cadence; engines still charge their own ticks
+        self.slo = slo
+        if slo is not None:
+            slo.owner = self
+            for r in self.replicas:
+                if r.engine.slo is None:
+                    r.engine.slo = slo
         # session durability: periodic host-side snapshots every N
         # steps. None/0 = OFF — the legacy contract (a request's second
         # replica death fails it) stays the default
@@ -213,6 +225,8 @@ class Router:
                 and not self.degrade.accepting_sessions()):
             self.stats["rejected"] += 1
             _REJECTED.inc(reason="degraded")
+            if req.tenant_id is not None:
+                _TENANT_REJECTED.inc(tenant=tenant_label(req.tenant_id))
             raise OverloadError(
                 "degradation ladder at L4 — new sessions rejected, "
                 "retry after the cluster recovers")
@@ -596,6 +610,10 @@ class Router:
                     continue
                 req.done = True
                 req.finish_reason = "replica_death"
+                if req.tenant_id is not None:
+                    _TENANT_FINISHED.inc(
+                        tenant=tenant_label(req.tenant_id),
+                        reason="replica_death")
                 self._forget(rid)
                 FLIGHT.record("router.requeue_exhausted", rid=rid)
                 REQUESTS.finish(req, "replica_death", replica=rep.name)
@@ -832,3 +850,5 @@ class Router:
         _R_HEDGE_RATE.set(hd / tr if tr else 0.0)
         if self.degrade is not None:
             self.degrade.poll()
+        if self.slo is not None:
+            self.slo.poll()

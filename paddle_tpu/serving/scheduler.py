@@ -23,7 +23,8 @@ from paddle_tpu.serving.telemetry import (_ADAPTER_DEFERRALS, _ADMITTED,
                                           _QUEUE_WAIT, _REJECTED,
                                           _TENANT_ADMITTED,
                                           _TENANT_QUEUE_WAIT,
-                                          _TENANT_THROTTLED, _TENANT_WASTE)
+                                          _TENANT_THROTTLED, _TENANT_WASTE,
+                                          tenant_label)
 from paddle_tpu.serving.types import (EngineDrainingError, QueueFullError,
                                       Request)
 
@@ -270,12 +271,12 @@ class Scheduler:
                 skip.add(t)
                 if ("shed", t) not in counted:
                     counted.add(("shed", t))
-                    _DEGRADE_SHED.inc(tenant=str(t))
+                    _DEGRADE_SHED.inc(tenant=tenant_label(t))
             elif t in self.tenant_rate and self._bucket_level(t, now) <= 0.0:
                 skip.add(t)
                 if ("throttle", t) not in counted:
                     counted.add(("throttle", t))
-                    _TENANT_THROTTLED.inc(tenant=str(t))
+                    _TENANT_THROTTLED.inc(tenant=tenant_label(t))
         return frozenset(skip)
 
     def select_admissions(self, eng):
@@ -351,20 +352,21 @@ class Scheduler:
             if wait is not None:
                 _QUEUE_WAIT.observe(wait)
             if req.tenant_id is not None:
-                _TENANT_ADMITTED.inc(tenant=str(req.tenant_id))
+                _TENANT_ADMITTED.inc(tenant=tenant_label(req.tenant_id))
                 if wait is not None:
-                    _TENANT_QUEUE_WAIT.observe(wait,
-                                               tenant=str(req.tenant_id))
+                    _TENANT_QUEUE_WAIT.observe(
+                        wait, tenant=tenant_label(req.tenant_id))
             # token-level hit accounting: every cached token is prefill
             # device work the pool did NOT have to repeat
-            GOODPUT.saved(ct)
+            GOODPUT.saved(ct, tenant=req.tenant_id)
             if req._resume is not None:
                 # replayed after preemption: every resume token past the
                 # prefix-cache hit is device work already paid for once
-                GOODPUT.waste("replay_prefill", max(0, len(p) - ct))
+                GOODPUT.waste("replay_prefill", max(0, len(p) - ct),
+                              tenant=req.tenant_id)
                 if req.tenant_id is not None:
                     _TENANT_WASTE.inc(max(0, len(p) - ct),
-                                      tenant=str(req.tenant_id),
+                                      tenant=tenant_label(req.tenant_id),
                                       why="replay_prefill")
                 REQUESTS.event(req, "replayed",
                                replica=getattr(eng, "trace_name", None),

@@ -7,7 +7,15 @@ the swappable ``clock`` ctor arg — so deadline tests driving a fake
 clock see deterministic histograms; host work timings (tick, drain) use
 the real monotonic clock. A serve loop exports everything with
 ``paddle_tpu.observability.dump(prefix)``.
+
+Every tenant-labeled write goes through :func:`tenant_label`, the
+cardinality guard: past ``PT_TENANT_LABEL_CAP`` distinct tenants the
+label collapses to ``__overflow__`` (counted in
+``serving_tenant_label_overflow_total``), so a tenant-id-fuzzing client
+cannot grow the registry or the Prometheus export without bound.
 """
+import os
+
 from paddle_tpu.observability import METRICS
 
 # ------------------------------------------------------------- engine
@@ -133,6 +141,63 @@ _TENANT_WASTE = METRICS.counter(
     "serving_tenant_waste_tokens_total",
     "wasted work, by tenant and cause (replay_prefill, spec_rejected)",
     labelnames=("tenant", "why"))
+# per-tenant SLO inputs (ISSUE 19): the SLOTracker computes burn rates
+# from windowed deltas of these — latency objectives from the tenant
+# histograms, availability from finished{reason} + rejections
+_TENANT_TTFT = METRICS.histogram(
+    "serving_tenant_ttft_seconds",
+    "submission → first token (engine clock), by tenant",
+    labelnames=("tenant",))
+_TENANT_TOK_LAT = METRICS.histogram(
+    "serving_tenant_token_latency_seconds",
+    "inter-token gap (engine clock), by tenant",
+    labelnames=("tenant",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
+_TENANT_FINISHED = METRICS.counter(
+    "serving_tenant_finished_total",
+    "requests finished, by tenant and finish_reason",
+    labelnames=("tenant", "reason"))
+_TENANT_REJECTED = METRICS.counter(
+    "serving_tenant_rejections_total",
+    "admissions refused at intake for requests carrying a tenant_id, "
+    "by tenant", labelnames=("tenant",))
+
+# ------------------------------------- tenant label-cardinality guard
+_TENANT_OVERFLOW = METRICS.counter(
+    "serving_tenant_label_overflow_total",
+    "tenant-labeled metric writes collapsed into the __overflow__ label "
+    "because the distinct-tenant cap (PT_TENANT_LABEL_CAP) was reached")
+
+TENANT_OVERFLOW_LABEL = "__overflow__"
+_tenant_labels_seen: set = set()
+
+
+def tenant_label(tenant) -> str:
+    """The label value for one tenant-labeled metric write. Returns
+    ``str(tenant)`` for the first ``PT_TENANT_LABEL_CAP`` (default 64)
+    distinct tenants seen by this process, then collapses every new
+    tenant id to ``__overflow__`` and counts the collapse — bounding
+    registry cardinality against tenant-id fuzzing. The cap is read per
+    call so tests (and operators) can change it mid-flight."""
+    t = str(tenant)
+    if t in _tenant_labels_seen:
+        return t
+    try:
+        cap = int(os.environ.get("PT_TENANT_LABEL_CAP", "64"))
+    except ValueError:
+        cap = 64
+    if len(_tenant_labels_seen) < cap:
+        _tenant_labels_seen.add(t)
+        return t
+    _TENANT_OVERFLOW.inc()
+    return TENANT_OVERFLOW_LABEL
+
+
+def reset_tenant_labels():
+    """Forget the seen-tenant set (test hygiene — the conftest registry
+    reset calls this so one test's tenants can't exhaust another's cap)."""
+    _tenant_labels_seen.clear()
 # adapter cache (batched multi-LoRA): device-resident stacked A/B slots
 _ADAPTER_UPLOADS = METRICS.counter(
     "serving_adapter_uploads_total",

@@ -8,6 +8,7 @@ jax.config.update, and XLA_FLAGS must be set before the CPU client is
 instantiated (it is created lazily, so doing it here is early enough).
 """
 import os
+import sys
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
@@ -73,8 +74,22 @@ def _clear_observability():
     repo. Tests that want dumps set FLIGHT.dir (or pass directory=)
     themselves; capacity/dir are restored afterwards either way. The
     request tracker (ISSUE 9) gets the same treatment: cleared and
-    disabled (its default) on both sides, capacity restored."""
-    from paddle_tpu.observability import FLIGHT, METRICS, REQUESTS, TRACER
+    disabled (its default) on both sides, capacity restored. The SLO
+    layer (ISSUE 19) too: the goodput ledger's metering sink is
+    detached so a tracker built in one test never bills another's
+    tokens, and the tenant label-cardinality seen-set resets."""
+    from paddle_tpu.observability import FLIGHT, GOODPUT, METRICS, \
+        REQUESTS, TRACER
+
+    def _reset_slo_state():
+        GOODPUT.attach_sink(None)
+        # serving.telemetry pulls in jax via the engine stack; only
+        # reset the seen-set if some test already imported it
+        tel = sys.modules.get("paddle_tpu.serving.telemetry")
+        if tel is not None:
+            tel.reset_tenant_labels()
+
+    _reset_slo_state()
     METRICS.reset()
     METRICS.enable()
     TRACER.disable()
@@ -93,6 +108,7 @@ def _clear_observability():
     FLIGHT.clear()
     REQUESTS.disable()
     REQUESTS.clear()
+    _reset_slo_state()
     FLIGHT.dir = saved_dir
     if FLIGHT.capacity != saved_cap:
         FLIGHT.set_capacity(saved_cap)

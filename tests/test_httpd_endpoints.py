@@ -44,8 +44,48 @@ def _clean_roofline():
 def test_unknown_path_404_names_the_routes(srv):
     status, body = _get(f"http://127.0.0.1:{srv.port}/nope")
     assert status == 404
-    for route in ("/metrics", "/healthz", "/roofline", "/profile"):
+    for route in ("/metrics", "/healthz", "/roofline", "/slo",
+                  "/tenants", "/profile"):
         assert route in body
+
+
+def test_slo_endpoint_serves_tracker_scorecards(srv):
+    from paddle_tpu.observability.slo import Objective, SLOTracker
+    base = f"http://127.0.0.1:{srv.port}"
+    status, body = _get(base + "/slo")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    assert doc["trackers"] == []              # none constructed yet
+    t = SLOTracker({"*": [Objective("availability", target=0.99)]},
+                   clock=iter([0.0, 1.0]).__next__)
+    t.poll()
+    status, body = _get(base + "/slo")
+    doc = json.loads(body)
+    assert status == 200
+    (snap,) = [s for s in doc["trackers"] if s["tracker"] == t.seq]
+    assert snap["polls"] == 1
+    assert snap["objectives"]["*"][0]["name"] == "availability"
+    (row,) = snap["status"]
+    assert (row["tenant"], row["objective"]) == ("*", "availability")
+    assert row["breaching"] is False
+
+
+def test_tenants_endpoint_serves_the_cost_ledger(srv):
+    from paddle_tpu.observability import GOODPUT
+    from paddle_tpu.observability.slo import SLOTracker
+    t = SLOTracker()
+    GOODPUT.good(7, tenant="acme")
+    GOODPUT.waste("spec_rejected", 3, tenant="acme")
+    GOODPUT.saved(2, tenant=None)             # bills __system__
+    status, body = _get(f"http://127.0.0.1:{srv.port}/tenants")
+    assert status == 200
+    doc = json.loads(body)
+    (snap,) = [s for s in doc["trackers"] if s["tracker"] == t.seq]
+    assert snap["tenants"]["acme"]["good_tokens"] == 7
+    assert snap["tenants"]["acme"]["waste_tokens"] == {"spec_rejected": 3}
+    assert snap["tenants"]["__system__"]["saved_tokens"] == 2
+    assert snap["good_tokens_total"] == 7
 
 
 def test_roofline_endpoint_serves_the_ledger(srv):

@@ -1,0 +1,417 @@
+"""Per-tenant SLO tracker + usage-metering cost ledger (ISSUE 19):
+burn-rate math checked against hand-computed windows, the multi-window
+AND gate (a short spike alone cannot page), tick-for-tick cost-ledger
+reconciliation under spec decoding + preemption + injected chaos, the
+tenant label-cardinality guard, the PT_SLO=0 kill switch's bit-identity
+promise, and the overload acceptance run — a deadline storm breaches
+the abused tenant (with a flight event) while the idle tenant's budget
+stays untouched."""
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import GOODPUT, METRICS
+from paddle_tpu.observability.flight import FLIGHT
+from paddle_tpu.observability.slo import (IDLE_TENANT, SYSTEM_TENANT,
+                                          CostLedger, Objective, SLOTracker,
+                                          default_objectives, slo_doc,
+                                          slo_enabled, tenants_doc)
+from paddle_tpu.serving import LLMEngine, Request
+from paddle_tpu.serving.telemetry import (_TENANT_FINISHED,
+                                          _TENANT_REJECTED, _TENANT_TTFT,
+                                          TENANT_OVERFLOW_LABEL,
+                                          reset_tenant_labels, tenant_label)
+from paddle_tpu.utils.faults import FAULTS, InjectedFault
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    cfg = LlamaConfig.tiny(num_hidden_layers=1, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _mk(model, **kw):
+    args = dict(num_slots=4, block_size=4, max_prompt_len=16,
+                max_seq_len=48)
+    args.update(kw)
+    return LLMEngine(model, **args)
+
+
+def _prompts(n, rs, lo=3, hi=14):
+    return [rs.randint(0, 64, (int(l),)) for l in rs.randint(lo, hi, size=n)]
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _gauge(name, **labels):
+    return METRICS.get(name).value(**labels)
+
+
+# ------------------------------------------------------------- objectives
+
+def test_objective_validation_and_defaults():
+    with pytest.raises(ValueError, match="unknown objective"):
+        Objective("tail_latency", target=1.0)
+    with pytest.raises(ValueError, match="availability target"):
+        Objective("availability", target=1.0)
+    with pytest.raises(ValueError, match="short_s"):
+        Objective("ttft_p95", target=1.0, short_s=10.0, window_s=5.0)
+    with pytest.raises(ValueError, match="budget"):
+        Objective("ttft_p95", target=1.0, budget=0.0)
+    # budget defaults: 1 - target for availability, 5% for the p95s
+    assert Objective("availability", target=0.999).budget \
+        == pytest.approx(0.001)
+    assert Objective("ttft_p95", target=1.0).budget == 0.05
+    names = [o.name for o in default_objectives()]
+    assert names == ["ttft_p95", "queue_wait_p95", "inter_token_p95",
+                     "availability"]
+    with pytest.raises(ValueError, match="duplicate objective"):
+        SLOTracker([Objective("ttft_p95", target=1.0),
+                    Objective("ttft_p95", target=2.0)])
+
+
+# -------------------------------------------------------- burn-rate math
+
+def test_availability_burn_matches_hand_computed_windows():
+    """Windowed deltas → error rate → burn, against hand arithmetic:
+    20 finishes of which 2 timed out, against a 0.1%% budget, is a
+    rate-0.1 window and exactly burn 100."""
+    clk = _Clock()
+    obj = Objective("availability", target=0.999, window_s=60.0,
+                    short_s=10.0)
+    tr = SLOTracker({"*": [obj]}, clock=clk)
+    tr.poll()                                 # baseline (empty registry)
+    _TENANT_FINISHED.inc(18, tenant="acme", reason="eos")
+    _TENANT_FINISHED.inc(2, tenant="acme", reason="timeout")
+    clk.t = 5.0
+    tr.poll()
+    row = tr.state[("acme", "availability")]
+    assert row["window_bad"] == 2.0 and row["window_total"] == 20.0
+    assert row["burn_short"] == pytest.approx((2 / 20) / 0.001)
+    assert row["burn_long"] == pytest.approx((2 / 20) / 0.001)
+    assert row["compliance"] == pytest.approx(0.9)
+    # budget_remaining = 1 - bad/(budget*total) clamps at 0 when blown
+    assert row["budget_remaining"] == 0.0
+    assert _gauge("serving_slo_burn_rate", tenant="acme",
+                  objective="availability") \
+        == pytest.approx(row["burn_short"])
+    # rejections count as bad with a clamped denominator: a pure-reject
+    # window saturates at error rate 1.0
+    _TENANT_REJECTED.inc(5, tenant="acme")
+    clk.t = 6.0
+    tr.poll()
+    row = tr.state[("acme", "availability")]
+    assert row["window_bad"] == 7.0 and row["window_total"] == 25.0
+
+
+def test_latency_objective_is_exact_on_bucket_bounds():
+    """target=0.1 sits on a default bucket bound: observations <= 0.1
+    are good, the first observation past it lands in the 0.25 bucket
+    and counts bad — 2 bad / 4 total, hand-checkable."""
+    clk = _Clock()
+    obj = Objective("ttft_p95", target=0.1, budget=0.5, window_s=60.0,
+                    short_s=60.0, fast_burn=1.0, slow_burn=1.0)
+    tr = SLOTracker({"lat": [obj]}, clock=clk)
+    tr.poll()
+    for v in (0.04, 0.1, 0.11, 0.3):
+        _TENANT_TTFT.observe(v, tenant="lat")
+    clk.t = 1.0
+    tr.poll()
+    row = tr.state[("lat", "ttft_p95")]
+    assert row["window_bad"] == 2.0 and row["window_total"] == 4.0
+    assert row["burn_short"] == pytest.approx((2 / 4) / 0.5)
+    assert row["breaching"] is True           # gates lowered to 1.0
+
+
+def test_multi_window_and_gate_blocks_short_spikes():
+    """A burst that saturates the short window cannot page while the
+    long window is still healthy; once the long window crosses the slow
+    gate too, the breach fires ONCE (rising edge) with a flight event,
+    and recovery re-arms it."""
+    clk = _Clock()
+    obj = Objective("availability", target=0.99, window_s=100.0,
+                    short_s=10.0)               # budget 0.01
+    tr = SLOTracker({"t": [obj]}, clock=clk)
+    tr.poll()
+    _TENANT_FINISHED.inc(1000, tenant="t", reason="eos")
+    clk.t = 10.0
+    tr.poll()
+    _TENANT_FINISHED.inc(10, tenant="t", reason="timeout")
+    clk.t = 95.0
+    tr.poll()
+    row = tr.state[("t", "availability")]
+    # short window holds only the burst: burn 100 >> fast gate
+    assert row["burn_short"] == pytest.approx(100.0)
+    # long window dilutes it below the slow gate: 10/1010 / 0.01
+    assert row["burn_long"] == pytest.approx(10 / 1010 / 0.01)
+    assert row["breaching"] is False
+    assert tr.breaches == []
+    assert METRICS.get("serving_slo_breaches_total")._series == {}
+    # keep burning: the long window crosses 6x and the alert fires
+    _TENANT_FINISHED.inc(200, tenant="t", reason="timeout")
+    clk.t = 96.0
+    tr.poll()
+    row = tr.state[("t", "availability")]
+    assert row["burn_short"] >= obj.fast_burn
+    assert row["burn_long"] == pytest.approx(210 / 1210 / 0.01)
+    assert row["burn_long"] >= obj.slow_burn
+    assert row["breaching"] is True
+    assert [e["kind"] for e in FLIGHT.events()].count(
+        "serving.slo_breach") == 1
+    assert len(tr.breaches) == 1
+    assert tr.breaches[0]["tenant"] == "t"
+    # still breaching next poll: no re-fire (edge-triggered)
+    _TENANT_FINISHED.inc(50, tenant="t", reason="timeout")
+    clk.t = 97.0
+    tr.poll()
+    assert len(tr.breaches) == 1
+    assert _gauge("serving_slo_breaches_total", tenant="t",
+                  objective="availability") == 1
+    # quiet long enough and the windows drain: re-armed, budget back
+    clk.t = 250.0
+    tr.poll()
+    row = tr.state[("t", "availability")]
+    assert row["breaching"] is False
+    assert row["budget_remaining"] == 1.0
+
+
+# ----------------------------------------------------------- cost ledger
+
+class _AuditTracker(SLOTracker):
+    """charge_tick spy: after EVERY tick the per-tenant rows must sum
+    to the untenanted totals — reconciliation is an invariant of each
+    charge, not a property of the final state."""
+
+    def charge_tick(self, engine, seconds):
+        super().charge_tick(engine, seconds)
+        led = self.ledger
+        tick = METRICS.get("serving_tick_seconds")
+        hist_sum = sum(s.sum for s in tick._series.values())
+        # bit-exact: both accumulate the same floats in the same order
+        assert led.device_seconds_total == hist_sum
+        assert sum(led.device_seconds.values()) == pytest.approx(
+            led.device_seconds_total, rel=1e-12, abs=1e-15)
+        assert sum(led.block_seconds.values()) == pytest.approx(
+            led.block_seconds_total, rel=1e-12, abs=1e-15)
+
+
+def test_cost_ledger_reconciles_tick_for_tick(model, draft):
+    """Spec decoding + preemption + injected spec-verify chaos, two
+    tenants: every tick's device-second shares sum exactly to the tick
+    histogram, and the token columns equal the untenanted GOODPUT
+    counters column by column."""
+    rs = np.random.RandomState(7)
+    prompts = _prompts(6, rs)
+    FAULTS.install("serving.spec_verify", on={2, 5}, exc=InjectedFault)
+    FAULTS.install("serving.preempt", every=5, times=4,
+                   action=lambda ctx: ctx["engine"]._preempt())
+    tr = _AuditTracker()
+    eng = _mk(model, draft_model=draft, spec_k=3, num_slots=2,
+              preemption=True, slo=tr)
+    for i, p in enumerate(prompts):
+        eng.add_request(Request(p, max_new_tokens=8,
+                                tenant_id="acme" if i % 2 else "beta"))
+    eng.run()
+    eng.assert_quiescent()
+    led = tr.ledger
+    assert led.ticks > 0 and led.device_seconds_total > 0
+    assert {"acme", "beta"} <= set(led.tenants())
+    # token columns reconcile exactly (integer arithmetic end to end)
+    assert led.good_total() == GOODPUT.good_total()
+    assert led.waste_total() == GOODPUT.waste_total()
+    assert led.saved_total() == GOODPUT.saved_total()
+    by_why = {}
+    for by in led.waste_tokens.values():
+        for why, n in by.items():
+            by_why[why] = by_why.get(why, 0) + n
+    assert by_why == {k: v for k, v in GOODPUT.waste_by_why().items() if v}
+    assert by_why.get("chaos_abort", 0) > 0       # the chaos really bit
+    assert eng.stats["preemptions"] > 0           # and preemption too
+    # the /tenants document carries the same rows
+    doc = tr.tenants_snapshot()
+    assert doc["good_tokens_total"] == led.good_total()
+    assert doc["tenants"]["acme"]["device_seconds"] > 0
+    assert doc["tenants"]["acme"]["block_seconds"] > 0
+
+
+def test_charge_tick_shares_idle_and_remainder():
+    """Direct unit check of the splitting rule: three resident tenants
+    share a tick in equal row shares that sum BIT-exactly (the last
+    share absorbs the float remainder); an empty tick bills __idle__;
+    untenanted work bills __system__."""
+    tr = SLOTracker()
+    reqs = {1: types.SimpleNamespace(tenant_id="a"),
+            2: types.SimpleNamespace(tenant_id="b"),
+            3: types.SimpleNamespace(tenant_id=None)}
+    eng = types.SimpleNamespace(
+        slot_req=np.array([1, 2, -1]), active=np.array([True, True, True]),
+        prefilling={3: None}, groups={}, requests=reqs,
+        kv=types.SimpleNamespace(
+            ledger=types.SimpleNamespace(enabled=False)))
+    seconds = 0.1          # 0.1/3 is not exact in binary: remainder test
+    tr.charge_tick(eng, seconds)
+    led = tr.ledger
+    assert set(led.device_seconds) == {"a", "b", SYSTEM_TENANT}
+    assert sum(led.device_seconds.values()) == seconds      # bit-exact
+    assert led.device_seconds["a"] == pytest.approx(seconds / 3)
+    empty = types.SimpleNamespace(
+        slot_req=np.array([-1]), active=np.array([True]), prefilling={},
+        groups={}, requests={},
+        kv=types.SimpleNamespace(
+            ledger=types.SimpleNamespace(enabled=False)))
+    tr.charge_tick(empty, 0.25)
+    assert led.device_seconds[IDLE_TENANT] == 0.25
+    assert led.device_seconds_total == pytest.approx(0.35)
+    assert led.ticks == 2
+
+
+def test_goodput_sink_attribution_is_by_construction():
+    """Every GOODPUT charge lands in the tracker's ledger with the
+    tenant the call site passed; untenanted charges bill __system__."""
+    tr = SLOTracker()
+    GOODPUT.good(5, tenant="a")
+    GOODPUT.good(3)                              # batch-level: __system__
+    GOODPUT.waste("pad_rows", 4)
+    GOODPUT.waste("spec_rejected", 2, tenant="a")
+    GOODPUT.waste("spec_rejected", 0, tenant="a")     # no-op, like _WASTE
+    GOODPUT.saved(6, tenant="b")
+    led = tr.ledger
+    assert led.good_tokens == {"a": 5, SYSTEM_TENANT: 3}
+    assert led.waste_tokens == {SYSTEM_TENANT: {"pad_rows": 4},
+                                "a": {"spec_rejected": 2}}
+    assert led.saved_tokens == {"b": 6}
+    assert led.good_total() == GOODPUT.good_total()
+    assert led.waste_total() == GOODPUT.waste_total()
+    assert led.saved_total() == GOODPUT.saved_total()
+
+
+# ----------------------------------------------------- cardinality guard
+
+def test_tenant_label_cardinality_guard(monkeypatch):
+    monkeypatch.setenv("PT_TENANT_LABEL_CAP", "2")
+    reset_tenant_labels()
+    assert tenant_label("t1") == "t1"
+    assert tenant_label("t2") == "t2"
+    assert tenant_label("t3") == TENANT_OVERFLOW_LABEL
+    assert tenant_label(999) == TENANT_OVERFLOW_LABEL
+    assert tenant_label("t1") == "t1"            # seen names keep passing
+    assert _gauge("serving_tenant_label_overflow_total") == 2
+    # the guard protects the ledger rows too
+    tr = SLOTracker()
+    GOODPUT.good(1, tenant="t9")
+    assert tr.ledger.good_tokens == {TENANT_OVERFLOW_LABEL: 1}
+    monkeypatch.setenv("PT_TENANT_LABEL_CAP", "64")
+    reset_tenant_labels()
+    assert tenant_label("t3") == "t3"
+
+
+# ----------------------------------------------------------- kill switch
+
+def test_kill_switch_bit_identical_and_inert(model, monkeypatch):
+    """PT_SLO=0: an engine carrying a tracker emits byte-for-byte the
+    tokens of a tracker-free build, and every tracker surface — ledger,
+    polls, gauges — stays empty."""
+    rs = np.random.RandomState(11)
+    prompts = _prompts(5, rs)
+    eng = _mk(model)
+    for p in prompts:
+        eng.add_request(Request(p, max_new_tokens=8, tenant_id="a"))
+    ref = {rid: list(map(int, t)) for rid, t in eng.run().items()}
+    monkeypatch.setenv("PT_SLO", "0")
+    assert not slo_enabled()
+    tr = SLOTracker()
+    eng2 = _mk(model, slo=tr)
+    for p in prompts:
+        eng2.add_request(Request(p, max_new_tokens=8, tenant_id="a"))
+    got = {rid: list(map(int, t)) for rid, t in eng2.run().items()}
+    assert got == ref
+    assert tr.polls == 0 and tr.state == {}
+    assert tr.ledger.ticks == 0
+    assert tr.ledger.snapshot()["tenants"] == {}
+    for name in ("serving_slo_burn_rate", "serving_slo_budget_remaining",
+                 "serving_tenant_device_seconds_total",
+                 "serving_tenant_kv_block_seconds_total"):
+        assert METRICS.get(name)._series == {}, name
+    assert slo_doc()["enabled"] is False
+    assert tenants_doc()["enabled"] is False
+    # flip back on mid-flight: the very next poll works
+    monkeypatch.delenv("PT_SLO")
+    tr.poll()
+    assert tr.polls == 1
+
+
+# --------------------------------------------------- overload acceptance
+
+def test_deadline_storm_breaches_abused_tenant_only(model):
+    """Acceptance: a tenant whose every request carries an already-blown
+    deadline burns its availability budget and fires the breach (flight
+    event names it); the well-behaved tenant sharing the engine keeps a
+    full budget."""
+    obj = Objective("availability", target=0.999, window_s=3600.0,
+                    short_s=300.0)
+    tr = SLOTracker({"*": [obj]})
+    tr.poll()                  # baseline before any traffic
+    rs = np.random.RandomState(13)
+    eng = _mk(model, slo=tr)
+    for p in _prompts(4, rs):
+        eng.add_request(Request(p, max_new_tokens=6, tenant_id="calm"))
+    for p in _prompts(4, rs):
+        eng.add_request(Request(p, max_new_tokens=6, tenant_id="abuser",
+                                deadline_s=1e-9))
+    eng.run()                  # engine polls the tracker per tick
+    eng.assert_quiescent()
+    tr.poll()                  # one final sweep past the last finish
+    abused = tr.state[("abuser", "availability")]
+    calm = tr.state[("calm", "availability")]
+    assert eng.stats["timeouts"] == 4
+    assert abused["breaching"] is True
+    assert abused["budget_remaining"] == 0.0
+    assert abused["burn_short"] >= obj.fast_burn
+    assert abused["burn_long"] >= obj.slow_burn
+    assert calm["breaching"] is False
+    assert calm["budget_remaining"] == 1.0
+    assert calm["compliance"] == 1.0
+    events = [e for e in FLIGHT.events() if e["kind"] == "serving.slo_breach"]
+    # the fleet-wide scorecard breaches too (half its finishes timed
+    # out) — what matters is that no event ever names the calm tenant
+    assert {e["tenant"] for e in events} == {"abuser", "*"}
+    assert _gauge("serving_slo_budget_remaining", tenant="calm",
+                  objective="availability") == 1.0
+    assert _gauge("serving_slo_breaches_total", tenant="abuser",
+                  objective="availability") == 1
+    # the scorecard document reflects the verdict
+    (snap,) = [s for s in slo_doc()["trackers"] if s["tracker"] == tr.seq]
+    assert any(r["tenant"] == "abuser" and r["breaching"]
+               for r in snap["status"])
+
+
+def test_cost_ledger_standalone_is_plain_dicts():
+    led = CostLedger()
+    led.good("a", 2)
+    led.waste("a", "pad_rows", 1)
+    led.saved(None, 3)
+    assert led.tenants() == sorted(["a", SYSTEM_TENANT])
+    snap = led.snapshot()
+    assert snap["good_tokens_total"] == 2
+    assert snap["waste_tokens_total"] == 1
+    assert snap["saved_tokens_total"] == 3
