@@ -1218,6 +1218,44 @@ def llama_decode_tick(model, tokens, cache: PagedKVCache, active,
     return nxt, logp, cache
 
 
+def llama_decode_tick_async(model, tokens, cache: PagedKVCache, active,
+                            stop, gen, max_gen, rng, temps, top_ps,
+                            eos_id, top_k=None):
+    """The pipelined twin of :func:`llama_decode_tick` (ISSUE 20): the
+    token array it returns stays ON DEVICE and feeds the next call's
+    ``tokens`` directly — the engine dispatches up to ``async_depth`` of
+    these back-to-back and fetches results one tick late, hiding host
+    emission under the in-flight device work.
+
+    Because the host has not seen tick N's token when tick N+1
+    dispatches, EOS/max-gen stop is evaluated IN THE JIT: ``stop`` is
+    the accumulated device-side stop mask, and a row that sampled EOS
+    (or hit ``max_gen``) at tick N is masked out of tick N+1's compute
+    (``ran = active & ~stop``) before the host ever sees the token —
+    over-dispatched ticks where every row is stopped run as all-masked
+    no-ops the engine bills to nothing. ``eos_id`` is a traced int32
+    (-1 when the engine has no EOS: token ids are non-negative, so the
+    compare never fires).
+
+    No table updates, grammar bias, LoRA, or beam logp: the engine
+    drains the window and takes the synchronous tick for any tick that
+    needs them, so this program stays a pure decode-cruise fast path.
+    Returns (nxt, ran, stop', gen', cache) — ``ran`` is the mask of
+    rows that actually computed this tick, which is exactly the rows
+    the synchronous loop would have run."""
+    from paddle_tpu.models.decoding import _sample_rows
+    _note_trace("tick:async")
+    ran = active & ~stop
+    logits, cache = llama_decode_step_paged(model, tokens, cache, ran,
+                                            None)
+    nxt = _sample_rows(logits.astype(jnp.float32), rng, temps, top_ps,
+                       top_k, None)
+    nxt = jnp.where(ran, nxt.astype(jnp.int32), tokens)
+    new_gen = gen + ran.astype(gen.dtype)
+    stopped = ran & ((nxt == eos_id) | (new_gen >= max_gen))
+    return nxt, ran, stop | stopped, new_gen, cache
+
+
 # The forwards above are structure-agnostic via _backbone/_model_logits/
 # _mlp_out, so they are ALSO the paged entry points for the MoE families
 # (Mixtral, Qwen2-MoE): expert routing runs inside the same jitted
@@ -1235,6 +1273,22 @@ _PREFILL_JIT = jax.jit(llama_prefill_paged)
 _DECODE_JIT = jax.jit(llama_decode_step_paged)
 _TICK_JIT = jax.jit(llama_decode_tick, static_argnums=(10, 11),
                     donate_argnums=(2,))
+# The async tick donates the cache only on accelerator backends: PJRT's
+# CPU client executes a computation inline on the dispatching thread
+# when it must alias a donated input, which serializes the depth-K
+# pipeline the tick exists to feed (dispatch would block for the full
+# tick). On CPU the extra cache copy buys a dispatch that actually
+# returns; on TPU dispatch is async regardless and donation keeps the
+# KV pool single-buffered in HBM.
+def _async_tick_donate():
+    try:
+        return () if jax.default_backend() == "cpu" else (2,)
+    except RuntimeError:         # backend init failed — donate-free is safe
+        return ()
+
+
+_ASYNC_TICK_JIT = jax.jit(llama_decode_tick_async, static_argnums=(11,),
+                          donate_argnums=_async_tick_donate())
 
 
 # jits registered by downstream serving modules (serving/quant.py,
@@ -1249,9 +1303,9 @@ def clear_jit_caches():
     context changes under the same call signature — flipping
     ``PT_GROUPED_GEMM`` or ``PT_MULTILORA_IMPL``, or entering/leaving a
     mesh re-routes layers, but the jit caches key on shapes only."""
-    for f in (_PREFILL_JIT, _DECODE_JIT, _TICK_JIT, _PREFILL_CHUNK_JIT,
-              _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT, _PREFIX_COW_JIT,
-              *_EXTRA_CLEAR):
+    for f in (_PREFILL_JIT, _DECODE_JIT, _TICK_JIT, _ASYNC_TICK_JIT,
+              _PREFILL_CHUNK_JIT, _VERIFY_CHUNK_JIT, _REWIND_LENS_JIT,
+              _PREFIX_COW_JIT, *_EXTRA_CLEAR):
         f.clear_cache()
 
 

@@ -22,6 +22,9 @@ work into
                             capacity
       ``chaos_abort``       drafted-but-never-verified tokens when a
                             fault aborts a spec tick
+      ``async_overrun``     async-pipeline ticks that ran on device for
+                            a slot the host had already torn down by
+                            the time the window drained
 
 A third, token-level column closes the books: **saved** —
 ``serving_goodput_saved_tokens_total`` — prefill token-positions the
@@ -55,7 +58,7 @@ from paddle_tpu.observability.metrics import METRICS
 __all__ = ["GOODPUT", "GoodputLedger", "WASTE_WHYS"]
 
 WASTE_WHYS = ("spec_rejected", "replay_prefill", "pad_rows",
-              "moe_capacity_drop", "chaos_abort")
+              "moe_capacity_drop", "chaos_abort", "async_overrun")
 
 _GOOD = METRICS.counter(
     "serving_goodput_tokens_total",
@@ -65,7 +68,7 @@ _WASTE = METRICS.counter(
     "serving_waste_total",
     "device token-positions computed then thrown away, by cause "
     "(spec_rejected, replay_prefill, pad_rows, moe_capacity_drop, "
-    "chaos_abort)",
+    "chaos_abort, async_overrun)",
     labelnames=("why",))
 _RATIO = METRICS.gauge(
     "serving_goodput_ratio",

@@ -301,16 +301,48 @@ def record_serving_throughput(phase: str, *, seconds: float, tokens: float,
     return report
 
 
+def serving_tick_anatomy() -> dict:
+    """Overlap-aware tick anatomy (ISSUE 20): cumulative wall-seconds
+    per tick phase from the breakdown histogram, with host time split
+    into *exposed* (the breakdown's ``host`` remainder — device idle
+    while the host works) and *hidden* (host work done under an
+    in-flight async dispatch, ``serving_tick_host_hidden_seconds``;
+    zero for synchronous engines). ``overlap_fraction`` is the share of
+    total host work the pipeline hid."""
+    def _hist_sum(name, **labels):
+        m = METRICS.get(name)
+        if m is None:
+            return 0.0
+        try:
+            return float(m.value(**labels)["sum"])
+        except (KeyError, TypeError):
+            return 0.0
+
+    phases = {p: _hist_sum("serving_tick_breakdown_seconds", phase=p)
+              for p in ("prefill", "draft", "verify", "sample", "host")}
+    hidden = _hist_sum("serving_tick_host_hidden_seconds")
+    exposed = phases["host"]
+    host_total = exposed + hidden
+    return {
+        "ticks_seconds": _hist_sum("serving_tick_seconds"),
+        "phases_seconds": phases,
+        "host_exposed_seconds": exposed,
+        "host_hidden_seconds": hidden,
+        "overlap_fraction": hidden / host_total if host_total else 0.0,
+    }
+
+
 def serving_roofline_report() -> dict:
     """The ``/roofline`` document: machine roofs + the last per-phase
-    reports the choke point recorded."""
+    reports the choke point recorded + the overlap-aware tick anatomy."""
     with _REPORTS_LOCK:
         machine = _REPORTS.get("_machine", {
             "peak_flops": 0.0, "peak_hbm_bps": 0.0,
             "balance_flops_per_byte": 0.0})
         phases = {k: dict(v) for k, v in _REPORTS.items()
                   if k != "_machine"}
-    return {"machine": machine, "phases": phases}
+    return {"machine": machine, "phases": phases,
+            "tick_anatomy": serving_tick_anatomy()}
 
 
 def reset_serving_roofline():

@@ -62,7 +62,8 @@ from paddle_tpu.serving.scheduler import Scheduler
 from paddle_tpu.serving.cp import (_CP_AXIS, _CP_GATHER_S,
                                    _CP_SHARD_BLOCKS, shard_occupancy)
 from paddle_tpu.serving.degrade import SessionSnapshot
-from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
+from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _ASYNC_DEPTH,
+                                          _ASYNC_DRAINS, _CANCELLED,
                                           _DRAIN, _FINISHED,
                                           _GRAMMAR_SPEC_REJECTS,
                                           _GRAMMAR_TOKENS, _KV_IN_USE,
@@ -76,7 +77,8 @@ from paddle_tpu.serving.telemetry import (_ACTIVE_SLOTS, _CANCELLED,
                                           _TENANT_REJECTED, _TENANT_TOK_LAT,
                                           _TENANT_TOKENS, _TENANT_TTFT,
                                           _TICK, _TICK_BREAKDOWN,
-                                          _TIMEOUTS, _TOK_LAT, _TOKENS,
+                                          _TICK_HIDDEN, _TIMEOUTS,
+                                          _TOK_LAT, _TOKENS,
                                           _TTFT, tenant_label)
 from paddle_tpu.serving.transfer import (KVPayload, _GATHER_BLOCKS_JIT,
                                          _INSTALL_BLOCKS_JIT)
@@ -102,7 +104,7 @@ class LLMEngine:
                  max_queue_len=None, clock=None, draft_model=None,
                  spec_k=4, spec_adaptive=True, prefill_only=False,
                  adapter_store=None, degrade=None, slo=None, kv_dtype=None,
-                 cp=1):
+                 cp=1, async_depth=0):
         cfg = model.cfg
         self.model = model
         # quantized KV cache (ISSUE 17): kv_dtype="int8" stores the block
@@ -126,6 +128,23 @@ class LLMEngine:
         if cp < 1:
             raise ValueError(f"cp must be >= 1, got {cp}")
         self.cp = cp
+        # async pipelined decode (ISSUE 20): async_depth=K keeps up to K
+        # decode ticks dispatched-but-unfetched; the tick's output token
+        # array stays ON DEVICE feeding the next tick's last_tok while
+        # the previous tick's tokens are fetched/emitted on the host,
+        # hidden under the in-flight dispatch (PR 3's deferred-sync
+        # contract, serving-side). PT_ASYNC_DECODE=0 is the kill switch —
+        # checked HERE (construction) so depth collapses to 0 and the
+        # engine traces EXACTLY the synchronous pre-PR programs.
+        async_depth = int(async_depth)
+        if async_depth and os.environ.get(
+                "PT_ASYNC_DECODE", "1").strip().lower() in (
+                    "0", "off", "false"):
+            async_depth = 0
+        if async_depth < 0:
+            raise ValueError(
+                f"async_depth must be >= 0, got {async_depth}")
+        self.async_depth = async_depth
         self.num_slots = num_slots
         self.block_size = block_size
         # graceful degradation (ISSUE 16): an optional shared
@@ -340,6 +359,31 @@ class LLMEngine:
                            ("prefill", "decode", "spec_draft", "spec_verify")}
         self._tick_phase: dict[str, float] = {}
 
+        # ---- async pipeline window (ISSUE 20) ----
+        # _async_win: oldest-first list of dispatched-but-unfetched ticks,
+        # each {"nxt": device tokens, "ran": device mask, "rng_before":
+        # the executor rng BEFORE that tick's split}. _async_dev holds
+        # the device-resident loop state (tokens/stop/gen/max_gen/active)
+        # threading tick N's outputs into tick N+1 without a host round
+        # trip; None whenever the window is empty. _async_rewound guards
+        # the one-shot rng rewind when draining a fully-masked tick.
+        self._async_win: list[dict] = []
+        self._async_dev = None
+        self._async_rewound = False
+        self._async_draining = False
+        # gauge-sweep throttle (PT_GAUGE_EVERY_S): wall-clock of the last
+        # sweep, a force flag set at drain/finish boundaries so run()-end
+        # gauges are exact, and a sweep counter the bench leg reads.
+        self._gauge_t = None
+        self._gauge_force = False
+        self._gauge_sweeps = 0
+        # hidden host time accumulated this tick (drain work overlapped
+        # with in-flight device dispatch); observed once per step().
+        self._hidden_acc = 0.0
+        # spec-decode D2H accounting: bytes fetched by pick_all this
+        # engine lifetime (satellite: non-greedy rows gathered on device)
+        self._spec_fetch_bytes = 0
+
     # ------------------------------------------- pre-split attribute surface
     # The monolithic serving.py exposed all of this directly on the
     # engine; tests and external callers still poke it, so every moved
@@ -552,7 +596,8 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         return (bool(self.queue) or bool(self.active.any())
-                or bool(self.groups) or bool(self.prefilling))
+                or bool(self.groups) or bool(self.prefilling)
+                or bool(self._async_win))
 
     def outstanding(self) -> int:
         """Requests accepted but not yet finished (queued, prefilling, or
@@ -573,6 +618,13 @@ class LLMEngine:
         Returns False for unknown/finished requests."""
         req = self.requests.get(req_id)
         if req is None or req.done:
+            return False
+        # in-flight async ticks may already hold this request's next
+        # tokens: drain so the emitted stream (and the ledger) is exact
+        # before its slot state is torn down. The drain can finish the
+        # request (EOS/length in the window) — re-check afterwards.
+        self._drain_async("cancel")
+        if req.done:
             return False
         g = self.groups.get(req_id)
         sids = list(g.sid.values()) if g is not None else None
@@ -644,6 +696,9 @@ class LLMEngine:
         req = self.requests.get(rid)
         if req is None or req.done:
             return None
+        self._drain_async("boundary")
+        if req.done:
+            return None
         g = self.groups.get(rid)
         sids = list(g.sid.values()) if g is not None else None
         if not self._detach(rid):
@@ -673,6 +728,7 @@ class LLMEngine:
                     self.cancel(r.req_id)
             while self.has_work():
                 self.step()
+            self._refresh_gauges(force=True)
         _DRAIN.observe(time.monotonic() - t0)
         return {rid: r.tokens for rid, r in self.requests.items()}
 
@@ -1190,14 +1246,20 @@ class LLMEngine:
 
     # --------------------------------------------------------- preemption
     def _preempt(self, protect_rid=None) -> bool:
+        # preemption rewrites a victim's resume prompt from req.tokens —
+        # tokens still in flight in the async window must land first or
+        # the replayed stream would silently drop them
+        self._drain_async("boundary")
         return self.sched.preempt(self, protect_rid)
 
     _protect = staticmethod(Scheduler._protect)
 
     def _preempt_prefilling(self, protect_rid=None) -> bool:
+        self._drain_async("boundary")
         return self.sched.preempt_prefilling(self, protect_rid)
 
     def _preempt_from(self, cand) -> bool:
+        self._drain_async("boundary")
         return self.sched.preempt_from(self, cand)
 
     def _allocate_or_preempt(self, rid: int, n_tokens: int, protect=None):
@@ -1331,7 +1393,6 @@ class LLMEngine:
         distributions the accept rule needs."""
         ns = self.num_slots
         kmax = max(k for _, _, k in staged)
-        all_greedy = all(float(self.temps[s]) == 0.0 for s, _, _ in staged)
         Cs = self.spec_k + 1
 
         # ---- catch-up: wide chunks until every pending suffix fits the
@@ -1405,15 +1466,24 @@ class LLMEngine:
             return int(self._spec_rs.choice(q.size, p=q))
 
         def pick_all(logits_2d, rows_feeding):
-            if all_greedy:       # fetch [ns] ints, never the [ns, V] block
+            ng = [s for s in rows_feeding if float(self.temps[s]) != 0.0]
+            greedy = [s for s in rows_feeding
+                      if float(self.temps[s]) == 0.0]
+            if greedy:           # fetch [ns] ints, never the [ns, V] block
                 am = np.asarray(jnp.argmax(
                     logits_2d.astype(jnp.float32), axis=-1))
-                for s in rows_feeding:
+                self._spec_fetch_bytes += am.nbytes
+                for s in greedy:
                     props[s].append(int(am[s]))
-            else:
-                full = np.asarray(logits_2d.astype(jnp.float32))
-                for s in rows_feeding:
-                    props[s].append(pick(s, full[s]))
+            if ng:
+                # gather ONLY the non-greedy rows on device before the
+                # host fetch — one temperature slot no longer taxes every
+                # greedy slot's D2H with the full [ns, V] block
+                sub = np.asarray(
+                    logits_2d[jnp.asarray(ng)].astype(jnp.float32))
+                self._spec_fetch_bytes += sub.nbytes
+                for i, s in enumerate(ng):
+                    props[s].append(pick(s, sub[i]))
 
         pick_all(dlast, [s for s, _, _ in staged])
         # ---- autoregressive proposal rounds (single-token feeds)
@@ -1725,6 +1795,7 @@ class LLMEngine:
         if eos or self.gen[slot] >= self.max_gen[slot]:
             req.done = True
             req.finish_reason = "eos" if eos else "length"
+            self._gauge_force = True     # finish boundary: exact sweep
             _FINISHED.inc(reason=req.finish_reason)
             if req.tenant_id is not None:
                 _TENANT_FINISHED.inc(tenant=tenant_label(req.tenant_id),
@@ -1762,6 +1833,7 @@ class LLMEngine:
         bit-exactly (``install_sequence``). Raises for beam/chunk-mid
         requests — only ACTIVE greedy slots are extractable (the router
         extracts after the final prefill chunk activates the slot)."""
+        self._drain_async("boundary")
         if self.cp > 1:
             raise NotImplementedError(
                 "KV handoff under context parallelism (cp>1) is not "
@@ -1830,6 +1902,11 @@ class LLMEngine:
         req = self.requests.get(rid)
         if req is None or req.done:
             return None
+        # the snapshot's token list and rng must be mutually consistent:
+        # land any in-flight async ticks before capturing either
+        self._drain_async("boundary")
+        if req.done:
+            return None
         fault_point("serving.snapshot", engine=self, rid=rid)
         snap = SessionSnapshot(
             req_id=rid, prompt=req.prompt, tokens=tuple(req.tokens),
@@ -1847,6 +1924,7 @@ class LLMEngine:
         state changed) when no slot or not enough blocks are free —
         the router retries later. Exception-atomic: host bookkeeping is
         undone if allocation fails; the donating scatter runs last."""
+        self._drain_async("boundary")
         if self._draining:
             raise EngineDrainingError(
                 "engine is draining — finishing in-flight requests, "
@@ -1989,10 +2067,25 @@ class LLMEngine:
                 kv_read_positions=ctx, geom=geom,
                 peak_flops=self._peak_flops, peak_hbm_bps=self._peak_hbm)
 
-    def _refresh_gauges(self):
+    def _refresh_gauges(self, force=False):
         """Point-in-time engine state → gauges (queue depth, active
         slots, KV-pool utilization). Called after every tick and intake
-        mutation; cheap enough to never matter."""
+        mutation. ``PT_GAUGE_EVERY_S`` (default 0 = every tick, so dumps
+        and tests are unchanged) wall-clock-throttles the sweep for
+        host-bound decode loops; drain/finish boundaries and run()-end
+        pass ``force=True`` so final gauge values are always exact."""
+        if not force:
+            try:
+                every = float(os.environ.get("PT_GAUGE_EVERY_S", "0") or 0)
+            except ValueError:
+                every = 0.0
+            if every > 0.0 and self._gauge_t is not None \
+                    and time.monotonic() - self._gauge_t < every:
+                return
+        self._gauge_t = time.monotonic()
+        self._gauge_sweeps += 1
+        if self.async_depth:
+            _ASYNC_DEPTH.set(self.async_depth)
         _QUEUE_DEPTH.set(len(self.queue))
         _ACTIVE_SLOTS.set(int(self.active.sum()))
         used = self.mgr.num_blocks - self.mgr.free_blocks
@@ -2089,9 +2182,219 @@ class LLMEngine:
             acc["spec_draft"][0] += ph.get("draft", 0.0)
             acc["spec_verify"][0] += ph.get("verify", 0.0)
             acc["decode"][0] += ph.get("sample", 0.0)
-            self._refresh_gauges()
+            # overlap-aware anatomy (ISSUE 20): host work done under an
+            # in-flight device dispatch was folded into the "sample"
+            # slice above (it is device-overlapped wall time, mirroring
+            # PR 4's overlap-aware MFU) — surface it separately here so
+            # "host" reports only EXPOSED host time while the five-phase
+            # sum still equals the tick total
+            if self.async_depth:
+                _TICK_HIDDEN.observe(self._hidden_acc)
+                self._hidden_acc = 0.0
+            force, self._gauge_force = self._gauge_force, False
+            self._refresh_gauges(force=force)
 
     def _step_impl(self):
+        """Exception-atomicity shim around :meth:`_step_inner` for the
+        async pipeline (ISSUE 20): a fault raised mid-tick while
+        dispatched-but-undrained ticks are in flight must not strand
+        their tokens — drain the window (their emissions are exactly the
+        tokens the synchronous engine produced in the preceding ticks,
+        so the stream stays bit-identical), then re-raise. With an empty
+        window this adds nothing to the sync path."""
+        try:
+            return self._step_inner()
+        except BaseException:
+            if self._async_win:
+                self._drain_async("exception")
+            raise
+
+    # ------------------------------- async pipelined decode (ISSUE 20)
+    def _spec_would_run(self) -> bool:
+        """Mirror of the sync tick's speculative-decode gate: True when
+        the next tick would draft-and-verify (host sampling every tick —
+        the window must drain for it)."""
+        return (self.draft_model is not None
+                and os.environ.get("PT_SPEC_DECODE", "1") != "0"
+                and (self.degrade is None or self.degrade.spec_enabled())
+                and bool((self.active & ~self.is_beam
+                          & (self.max_gen - self.gen >= 2)).any()))
+
+    def _async_block_reason(self):
+        """Why the NEXT tick cannot cruise in the async pipeline — None
+        means pure decode (dispatch without fetching). Any non-None
+        reason drains the window first, then the tick runs the ordinary
+        synchronous path, so block-table mutations, host sampling, and
+        the ledger stay tick-exact:
+
+        mode     prefill-only replica / context-parallel engine
+        admit    requests waiting for admission (scheduler runs host-side)
+        prefill  chunked prefill in flight
+        beam     beam groups need host select+fork every tick
+        finish   no plain active slots (drain emits the tail, run() ends)
+        grammar  constrained slots need the host automaton per token
+        adapter  multi-LoRA rows compose per-slot corrections host-side
+        window   sliding-window recycling mutates tables per tick
+        spec     draft-and-verify samples on the host this tick
+        growth   a slot would cross a block boundary within the window
+        """
+        if self.prefill_only or self.cp > 1:
+            return "mode"
+        if self.queue:
+            return "admit"
+        if self.prefilling:
+            return "prefill"
+        if self.groups or self.is_beam.any():
+            return "beam"
+        act = self.active & ~self.is_beam
+        if not act.any():
+            return "finish"
+        if self._grammar:
+            return "grammar"
+        if self._adapter_pins:
+            return "adapter"
+        if self.window is not None:
+            return "window"
+        if self._spec_would_run():
+            return "spec"
+        # the host ``cur`` mirror lags by the window length: the tick
+        # about to dispatch writes position cur + len(win), which must
+        # already have a table entry (cruise never mutates tables)
+        d = len(self._async_win)
+        if (((self.cur[act] + d) // self.block_size)
+                >= self.table_len[act]).any():
+            return "growth"
+        return None
+
+    def _async_step(self):
+        """One cruise tick of the depth-K pipeline: dispatch the next
+        decode tick with the PREVIOUS tick's token array still on device
+        (no fetch-reupload round trip), then — once the window exceeds
+        ``async_depth`` — fetch and emit the OLDEST tick's tokens, hidden
+        under the in-flight dispatch. EOS/max-gen stop is evaluated
+        inside the tick jit via the device stop mask, so a slot that
+        finished at tick N is masked out of tick N+1 even though the
+        host has not seen its token yet."""
+        act = self.active & ~self.is_beam
+        # chaos parity with the sync tick: these sites fire BEFORE the
+        # dispatch, so an injected exception aborts with the cache,
+        # tables, and ledger untouched (the shim drains the window)
+        if self._is_moe:
+            fault_point("serving.moe_dispatch", engine=self,
+                        slots=np.nonzero(act)[0])
+        if self.exe.cache.k_scales:
+            fault_point("serving.kv_quant", engine=self,
+                        slots=np.nonzero(act)[0])
+        dev = self._async_dev
+        if dev is None:
+            # window start: seed the device-resident loop state from the
+            # host mirrors (exact — the window was just drained)
+            dev = self._async_dev = {
+                "tokens": jnp.asarray(self.last_tok),
+                "stop": jnp.zeros(self.num_slots, bool),
+                "gen": jnp.asarray(self.gen),
+                "max_gen": jnp.asarray(self.max_gen),
+            }
+        eos = -1 if self.eos_token_id is None else int(self.eos_token_id)
+        rng_before = self.exe.rng
+        t0 = time.perf_counter()
+        with self._tick_timer("sample"):
+            nxt, ran, stop, gen = self.exe.decode_tick_async(
+                dev["tokens"], jnp.asarray(act), dev["stop"], dev["gen"],
+                dev["max_gen"], self.temps, self.top_ps, eos)
+        self.stats["device_s"] += time.perf_counter() - t0
+        dev["tokens"], dev["stop"], dev["gen"] = nxt, stop, gen
+        self._async_rewound = False
+        self._async_win.append(
+            {"nxt": nxt, "ran": ran, "rng_before": rng_before})
+        self.stats["ticks"] += 1
+        emitted = []
+        if len(self._async_win) > self.async_depth:
+            # steady state: drain exactly the oldest tick. The guard
+            # keeps a stream-callback cancel() from recursively draining
+            # the window out from under us (it detaches immediately; the
+            # dead slot's in-flight rows bill GOODPUT async_overrun).
+            self._async_draining = True
+            try:
+                emitted += self._drain_one()
+            finally:
+                self._async_draining = False
+        return emitted
+
+    def _drain_one(self):
+        """Fetch + emit the oldest dispatched tick. The host mirrors
+        (``cur``/``gen``/``last_tok``) advance HERE, at drain — so at
+        every drain boundary they hold exactly the values the
+        synchronous engine would. A fully-masked tick (every slot
+        stopped on device before the host noticed) emits nothing and
+        rewinds the executor rng to its pre-split state: the sync engine
+        never ran that tick, so it never consumed that key."""
+        e = self._async_win.pop(0)
+        t0 = time.monotonic()
+        nxt = np.asarray(e["nxt"])
+        ran = np.asarray(e["ran"])
+        t1 = time.monotonic()
+        # the fetch blocks until that tick's device work completes:
+        # device-overlapped wall time, billed to the "sample" slice
+        self._tick_phase["sample"] = (self._tick_phase.get("sample", 0.0)
+                                      + t1 - t0)
+        self.stats["device_s"] += t1 - t0
+        if not ran.any():
+            if not self._async_rewound:
+                self.exe.rng = e["rng_before"]
+                self._async_rewound = True
+            return []
+        # roofline billed at drain, where cur is tick-exact: one weight
+        # pass, each ran slot read its block-rounded context (same
+        # accounting as the sync tick)
+        self._acc_phase("decode", int(ran.sum()), 1, self._ctx_blocks(ran))
+        live = ran & (self.slot_req >= 0)
+        over = int(ran.sum() - live.sum())
+        if over:
+            # rows that ran on device for a slot the host has since torn
+            # down (cancel from a stream callback mid-window): the sync
+            # engine never computed these tokens — wasted work, never
+            # emitted
+            GOODPUT.waste("async_overrun", over)
+        self.cur += live
+        t2 = time.monotonic()
+        emitted = []
+        for slot in np.nonzero(live)[0]:
+            emitted += self._emit(int(slot), int(nxt[slot]))
+        t3 = time.monotonic()
+        self.stats["host_s"] += t3 - t2
+        if self._async_win:
+            # successors are still in flight: this host work is hidden
+            # under device dispatch. Fold it into the "sample" slice
+            # (device-overlapped time) and surface it in the hidden-host
+            # histogram; the final entry's emit is exposed host time and
+            # falls through to the "host" remainder.
+            self._hidden_acc += t3 - t2
+            self._tick_phase["sample"] = (
+                self._tick_phase.get("sample", 0.0) + t3 - t2)
+        return emitted
+
+    def _drain_async(self, why: str):
+        """Drain the whole window (fetch + emit every dispatched tick),
+        leaving the host mirrors tick-exact and the device loop state
+        discarded (the next cruise re-seeds from the mirrors). No-op
+        when the window is empty or a drain is already on the stack
+        (stream-callback re-entrancy)."""
+        if not self._async_win or self._async_draining:
+            return []
+        self._async_draining = True
+        try:
+            emitted = []
+            while self._async_win:
+                emitted += self._drain_one()
+            self._async_dev = None
+            _ASYNC_DRAINS.inc(why=why)
+            self._gauge_force = True
+            return emitted
+        finally:
+            self._async_draining = False
+
+    def _step_inner(self):
         """One engine tick: advance in-flight beam groups (select + fork,
         or their final selection), admit waiting requests into free slots
         (their prefill runs now, interleaved with decode), then one decode
@@ -2104,6 +2407,15 @@ class LLMEngine:
         fault_point("serving.preempt", engine=self)
         self._expire()
         emitted = []
+        if self.async_depth:
+            why = self._async_block_reason()
+            if why is None:
+                return self._async_step()
+            if self._async_win:
+                # boundary: land every in-flight tick before the host
+                # mutates tables/slots — the drained emissions belong to
+                # this step's return
+                emitted += self._drain_async(why)
         for rid in list(self.groups):
             emitted += self._beam_advance(rid, self.groups[rid])
         admits, beam_admits = self._admit()
@@ -2198,4 +2510,6 @@ class LLMEngine:
         """Drain queue + slots; returns {req_id: generated token list}."""
         while self.has_work():
             self.step()
+        # end-of-run gauges must be exact even under PT_GAUGE_EVERY_S
+        self._refresh_gauges(force=True)
         return {rid: r.tokens for rid, r in self.requests.items()}

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.models.decoding import KVCache, _sample_rows
-from paddle_tpu.models.paged import (PagedKVCache, _BEAM_GROUP_UPDATE_JIT,
+from paddle_tpu.models.paged import (PagedKVCache, _ASYNC_TICK_JIT,
+                                     _BEAM_GROUP_UPDATE_JIT,
                                      _PREFILL_CHUNK_JIT, _PREFILL_JIT,
                                      _PREFIX_COW_JIT, _REWIND_LENS_JIT,
                                      _TICK_JIT, _VERIFY_CHUNK_JIT,
@@ -241,6 +242,22 @@ class ModelExecutor:
             jnp.asarray(top_ps), self.top_k, need_logp, lora=lora,
             logit_bias=(None if bias is None else jnp.asarray(bias)))
         return nxt, logp
+
+    def decode_tick_async(self, tokens, active, stop, gen, max_gen,
+                          temps, top_ps, eos_id):
+        """Depth-K pipelined tick (ISSUE 20): ``tokens``/``stop``/``gen``
+        are DEVICE arrays threaded from the previous call — the sampled
+        token array feeds the next call without a host round trip, and
+        EOS/max-gen stop is evaluated in the jit via the stop mask. No
+        table updates, grammar bias, LoRA, or beam logp: the engine
+        drains its window and takes :meth:`decode_tick` for any tick
+        needing them. Returns (nxt, ran, stop', gen'), all on device."""
+        sub = self.next_key()
+        nxt, ran, stop, gen, self.cache = _ASYNC_TICK_JIT(
+            self.model, tokens, self.cache, active, stop, gen, max_gen,
+            sub, jnp.asarray(temps), jnp.asarray(top_ps),
+            jnp.int32(eos_id), self.top_k)
+        return nxt, ran, stop, gen
 
     def apply_block_copies(self, pairs):
         """Radix prefix cache COW plan: copy each (src, dst) pool block

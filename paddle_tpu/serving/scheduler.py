@@ -286,6 +286,12 @@ class Scheduler:
         order is ``_pick_index`` — legacy FCFS without tenants, weighted
         fair share with them; a blocked candidate stops admission for the
         tick (capacity pressure must not starve the fair winner)."""
+        # drain-before-admit seam (ISSUE 20): admission mutates slot
+        # state and block tables the async pipeline's in-flight ticks
+        # already captured — the engine must land every dispatched tick
+        # before the scheduler touches a slot
+        assert not getattr(eng, "_async_win", None), \
+            "admission with dispatched-but-undrained async ticks in flight"
         kv = eng.kv
         free_slots = list(np.nonzero(eng.slot_req < 0)[0])
         admits, beam_admits = [], []

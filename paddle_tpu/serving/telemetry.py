@@ -68,6 +68,32 @@ _TICK_BREAKDOWN = METRICS.histogram(
 _DRAIN = METRICS.histogram(
     "serving_drain_seconds", "wall time of graceful drain",
     buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
+# async pipelined decode (ISSUE 20): depth-K deferred-sync decode —
+# the depth gauge + hidden histogram ship only when async_depth > 0,
+# so depth-0 engines export byte-identical dumps to pre-async runs.
+# Under async, the breakdown's `host` phase reports only EXPOSED host
+# time; host work performed while dispatched ticks were still in
+# flight lands here instead (mirror of the trainer's overlap-aware
+# MFU split). One observation per tick, so count == tick count and
+# the five-phase sum == serving_tick_seconds contract keeps holding.
+_ASYNC_DEPTH = METRICS.gauge(
+    "serving_async_depth",
+    "configured decode pipeline depth (dispatched-but-unfetched ticks "
+    "kept in flight; 0 = fully synchronous)")
+_ASYNC_DRAINS = METRICS.counter(
+    "serving_async_drains_total",
+    "async decode windows drained before a tick the pipeline cannot "
+    "cover, by cause (admit, prefill, beam, grammar, adapter, spec, "
+    "growth, finish, cancel, exception, boundary)",
+    labelnames=("why",))
+_TICK_HIDDEN = METRICS.histogram(
+    "serving_tick_host_hidden_seconds",
+    "per-tick host work (token emission, stream callbacks, finish "
+    "bookkeeping) performed while async-dispatched device ticks were "
+    "still in flight — hidden time, excluded from the breakdown's "
+    "exposed `host` phase",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
 # speculative decoding (ISSUE 5): proposal/acceptance accounting plus the
 # per-tick commit size — tokens_per_tick > 1 is the whole point
 _SPEC_PROPOSED = METRICS.counter(

@@ -93,9 +93,12 @@ def test_roofline_endpoint_serves_the_ledger(srv):
     status, body = _get(base + "/roofline")
     assert status == 200
     doc = json.loads(body)
-    assert doc == {"machine": {"peak_flops": 0.0, "peak_hbm_bps": 0.0,
-                               "balance_flops_per_byte": 0.0},
-                   "phases": {}}                    # nothing recorded yet
+    assert doc["machine"] == {"peak_flops": 0.0, "peak_hbm_bps": 0.0,
+                              "balance_flops_per_byte": 0.0}
+    assert doc["phases"] == {}                      # nothing recorded yet
+    # overlap-aware anatomy (ISSUE 20) rides along, all-zero at rest
+    assert doc["tick_anatomy"]["host_hidden_seconds"] == 0.0
+    assert doc["tick_anatomy"]["overlap_fraction"] == 0.0
     g = ModelGeometry(num_layers=2, hidden=8, intermediate=16, vocab=32,
                       heads=2, kv_heads=1, head_dim=4)
     record_serving_throughput("decode", seconds=1.0, tokens=4,

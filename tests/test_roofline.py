@@ -290,6 +290,47 @@ def test_tick_breakdown_reconciles_tick_for_tick():
         assert hist.value(phase=p)["sum"] > 0.0
 
 
+def test_tick_breakdown_reconciles_at_async_depth():
+    """ISSUE 20: the five-phase reconciliation must hold tick-for-tick
+    at ``async_depth>0`` too — device-overlapped drain/emit work folds
+    into the "sample" slice, only exposed host time lands in "host",
+    and every tick observes ``serving_tick_host_hidden_seconds`` exactly
+    once, so the hidden column reconciles against the tick count."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import LLMEngine, Request
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=32,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           vocab_size=64)
+    eng = LLMEngine(LlamaForCausalLM(cfg), num_slots=4, block_size=8,
+                    max_prompt_len=16, max_seq_len=64, async_depth=2)
+    rs = np.random.RandomState(0)
+    for l in (4, 7, 11, 5):
+        eng.add_request(Request(rs.randint(0, 64, (l,)), max_new_tokens=8))
+    hid = METRICS.get("serving_tick_host_hidden_seconds")
+    ticks = 0
+    while eng.has_work():
+        eng.step()
+        ticks += 1
+        parts, tick = _sums()
+        assert tick["count"] == ticks
+        for p in _BREAKDOWN_PHASES:
+            assert parts[p]["count"] == ticks, \
+                f"phase {p} missed a tick ({parts[p]['count']} vs {ticks})"
+        total = sum(parts[p]["sum"] for p in _BREAKDOWN_PHASES)
+        assert math.isclose(total, tick["sum"], rel_tol=1e-9), \
+            f"tick {ticks}: breakdown sum {total} != tick sum {tick['sum']}"
+        assert hid.value()["count"] == ticks
+    eng.assert_quiescent()
+    assert ticks > 2
+    doc = serving_roofline_report()
+    anat = doc["tick_anatomy"]
+    assert anat["ticks_seconds"] == pytest.approx(_sums()[1]["sum"])
+    assert anat["host_hidden_seconds"] == pytest.approx(hid.value()["sum"])
+    assert anat["host_exposed_seconds"] == \
+        pytest.approx(_sums()[0]["host"]["sum"])
+    assert 0.0 <= anat["overlap_fraction"] <= 1.0
+
+
 def test_bench_shaped_engine_exports_bandwidth_bound_decode_mbu(monkeypatch):
     """The acceptance criterion: under PT_ROOFLINE_KIND="TPU v5e" the
     bench-shaped engine run exports a nonzero ``serving_mbu{decode}``
